@@ -1,0 +1,134 @@
+"""Dataset loading: MNIST idx, CIFAR-10 binary, and synthetic fallback.
+
+The reference hard-codes cluster AFS paths (dmnist/cent/cent.cpp:53,
+dcifar10/common/custom.hpp:11-12) and reads MNIST via libtorch's built-in
+loader / CIFAR-10 via an OpenCV JPEG walker (custom.hpp:26-122). Here:
+
+  * `load_mnist(dir)` reads the standard idx files (train-images-idx3-ubyte
+    etc., gz or raw) and applies the reference's Normalize(0.1307, 0.3081)
+    (cent.cpp:55).
+  * `load_cifar10(dir)` reads the canonical binary batches
+    (data_batch_{1..5}.bin / test_batch.bin) or the python-pickle version,
+    scaled to [0,1] float32 like OpenCV's CV_32FC3 convertTo path.
+  * `synthetic_dataset(...)` builds a deterministic, *learnable* stand-in
+    (random inputs labeled by a fixed random teacher network) so every
+    algorithm, test, and benchmark runs hermetically when no dataset is on
+    disk (this environment has no network egress).
+
+All loaders return numpy arrays (images NHWC float32, labels int32); the
+training layer owns device placement.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct as _struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path):
+        return open(path, "rb")
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    raise FileNotFoundError(path)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        data = f.read()
+    magic, = _struct.unpack(">I", data[:4])
+    ndim = magic & 0xFF
+    dims = _struct.unpack(">" + "I" * ndim, data[4 : 4 + 4 * ndim])
+    return np.frombuffer(data, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def load_mnist(
+    data_dir: str, split: str = "train", normalize: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    prefix = "train" if split == "train" else "t10k"
+    images = _read_idx(os.path.join(data_dir, f"{prefix}-images-idx3-ubyte"))
+    labels = _read_idx(os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte"))
+    x = images.astype(np.float32)[..., None] / 255.0
+    if normalize:
+        x = (x - MNIST_MEAN) / MNIST_STD
+    return x, labels.astype(np.int32)
+
+
+def load_cifar10(data_dir: str, split: str = "train") -> Tuple[np.ndarray, np.ndarray]:
+    bin_names = (
+        [f"data_batch_{i}.bin" for i in range(1, 6)]
+        if split == "train"
+        else ["test_batch.bin"]
+    )
+    if os.path.exists(os.path.join(data_dir, bin_names[0])):
+        xs, ys = [], []
+        for name in bin_names:
+            raw = np.fromfile(os.path.join(data_dir, name), np.uint8).reshape(-1, 3073)
+            ys.append(raw[:, 0])
+            xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        return x, np.concatenate(ys).astype(np.int32)
+
+    # python pickle version (cifar-10-batches-py)
+    py_names = (
+        [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]
+    )
+    xs, ys = [], []
+    for name in py_names:
+        with open(os.path.join(data_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xs.append(
+            np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        )
+        ys.append(np.asarray(d[b"labels"], np.int64))
+    x = np.concatenate(xs).astype(np.float32) / 255.0
+    return x, np.concatenate(ys).astype(np.int32)
+
+
+def synthetic_dataset(
+    n: int,
+    image_shape: Tuple[int, int, int] = (28, 28, 1),
+    num_classes: int = 10,
+    seed: int = 0,
+    split: str = "train",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable classification task.
+
+    Inputs are unit Gaussians; labels come from a fixed random linear teacher
+    over the flattened input, so models genuinely reduce loss and the event
+    dynamics (norm drift, threshold adaptation) exercise realistically.
+    `split` offsets the sample stream so train/test are disjoint.
+    """
+    rng = np.random.default_rng(seed)
+    teacher = rng.standard_normal((int(np.prod(image_shape)), num_classes)).astype(
+        np.float32
+    )
+    offset = 0 if split == "train" else 1_000_003
+    sample_rng = np.random.default_rng(seed + 17 + offset)
+    x = sample_rng.standard_normal((n,) + tuple(image_shape)).astype(np.float32)
+    logits = x.reshape(n, -1) @ teacher
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return x, y
+
+
+def load_or_synthesize(
+    dataset: str, data_dir: Optional[str], split: str, n_synth: int = 4096, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Try real data, fall back to the synthetic stand-in of matching shape."""
+    shape = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
+    if data_dir:
+        try:
+            if dataset == "mnist":
+                return load_mnist(data_dir, split)
+            if dataset == "cifar10":
+                return load_cifar10(data_dir, split)
+        except (FileNotFoundError, OSError):
+            pass
+    return synthetic_dataset(n_synth, shape, seed=seed, split=split)

@@ -1,0 +1,68 @@
+"""Distributed data sharding — the reference's samplers as array ops.
+
+torch's DistributedSequentialSampler / DistributedRandomSampler with
+allow_duplicates=false (/root/reference/dmnist/decent/decent.cpp:81-82,
+dmnist/cent/cent.cpp:59-60, dcifar10/event/event.cpp:102-105) give each of N
+ranks a disjoint 1/N slice of the dataset. Here a shard plan is materialized
+up front as index arrays in the stacked layout [n_ranks, steps, batch], so an
+entire epoch of per-rank batches is a single gather — friendly to
+`jax.device_put` once and `lax.scan` over steps.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def _per_rank_count(n: int, n_ranks: int) -> int:
+    """Samples per rank, dropping the remainder (allow_duplicates=false)."""
+    return n // n_ranks
+
+
+def shard_sequential(n: int, n_ranks: int) -> np.ndarray:
+    """[n_ranks, per_rank] contiguous index slices (sequential sampler)."""
+    per = _per_rank_count(n, n_ranks)
+    return np.arange(n_ranks * per, dtype=np.int64).reshape(n_ranks, per)
+
+
+def shard_random(n: int, n_ranks: int, seed: int = 0, epoch: int = 0) -> np.ndarray:
+    """[n_ranks, per_rank] disjoint shards of a global permutation
+    (random sampler); reshuffled per epoch via the seed mix."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    per = _per_rank_count(n, n_ranks)
+    perm = rng.permutation(n)[: n_ranks * per]
+    return perm.reshape(n_ranks, per).astype(np.int64)
+
+
+def batched_epoch(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_ranks: int,
+    batch_size: int,
+    *,
+    random: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One epoch of per-rank batches in stacked layout.
+
+    Returns (xb, yb) with shapes [n_ranks, steps, batch, ...] and
+    [n_ranks, steps, batch]. Trailing partial batches are dropped, matching
+    the reference loaders' full-batch iteration.
+    """
+    shards = (
+        shard_random(len(x), n_ranks, seed, epoch)
+        if random
+        else shard_sequential(len(x), n_ranks)
+    )
+    per = shards.shape[1]
+    steps = per // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"batch_size {batch_size} larger than per-rank shard {per} "
+            f"({len(x)} samples / {n_ranks} ranks)"
+        )
+    idx = shards[:, : steps * batch_size].reshape(n_ranks, steps, batch_size)
+    return x[idx], y[idx]

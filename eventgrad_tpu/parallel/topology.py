@@ -1,0 +1,94 @@
+"""Neighbor topologies on named mesh axes.
+
+TPU-native replacement for the reference's MPI ring arithmetic
+(`left = (rank-1+N) % N`, `right = (rank+1) % N`,
+/root/reference/dmnist/event/event.cpp:113-122,
+/root/reference/dmnist/decent/decent.cpp:56-64): instead of integer rank
+bookkeeping, a topology names mesh axes and enumerates neighbor *shifts*.
+Each shift compiles to a single `jax.lax.ppermute` that rides the ICI
+links of the physical TPU torus.
+
+A `Ring` has two neighbors (offset -1 and +1 on one axis) and reproduces
+the reference exactly. A `Torus` generalizes to 4 neighbors on two axes —
+the BASELINE stress configuration (v4-256 2D torus) — with uniform
+1/(1+n_neighbors) mixing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class NeighborSpec:
+    """One neighbor direction: a shift of `offset` along mesh axis `axis`.
+
+    `offset=-1` means "the value I receive comes from my left neighbor"
+    (rank r receives from rank r-1 mod n, matching the reference's `left`).
+    """
+
+    axis: str
+    offset: int
+
+    @property
+    def name(self) -> str:
+        sign = "m" if self.offset < 0 else "p"
+        return f"{self.axis}_{sign}{abs(self.offset)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A named-axis layout of ranks plus the gossip neighbor set."""
+
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        if len(self.axes) != len(self.shape):
+            raise ValueError(f"axes {self.axes} vs shape {self.shape} length mismatch")
+        if any(s < 1 for s in self.shape):
+            raise ValueError(f"invalid topology shape {self.shape}")
+
+    @property
+    def n_ranks(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def neighbors(self) -> Tuple[NeighborSpec, ...]:
+        """Neighbor shifts, one per gossip partner.
+
+        On an axis of size 1 there are no neighbors in that direction;
+        on an axis of size 2, -1 and +1 are the same rank but the reference
+        still sends both messages (two puts), so we keep both shifts.
+        """
+        specs = []
+        for axis, size in zip(self.axes, self.shape):
+            if size > 1:
+                specs.append(NeighborSpec(axis, -1))
+                specs.append(NeighborSpec(axis, +1))
+        return tuple(specs)
+
+    @property
+    def n_neighbors(self) -> int:
+        return len(self.neighbors)
+
+    @property
+    def mix_weight(self) -> float:
+        """Uniform gossip mixing weight: 1/3 on a ring (event.cpp:469-471),
+        1/5 on a 2D torus."""
+        return 1.0 / (1.0 + self.n_neighbors)
+
+    def axis_size(self, axis: str) -> int:
+        return self.shape[self.axes.index(axis)]
+
+
+def Ring(n: int, axis: str = "ring") -> Topology:
+    """1-D ring of `n` ranks — the reference's only topology."""
+    return Topology(axes=(axis,), shape=(n,))
+
+
+def Torus(nx: int, ny: int, axes: Tuple[str, str] = ("x", "y")) -> Topology:
+    """2-D torus (nx × ny) with 4 neighbors per rank."""
+    return Topology(axes=tuple(axes), shape=(nx, ny))

@@ -1,0 +1,179 @@
+"""Per-rank train steps for all four algorithm families.
+
+One builder per reference executable:
+
+  algo="allreduce"    — E1 `cent`: psum-mean of gradients, then SGD
+                        (/root/reference/dmnist/cent/cent.cpp:130-145).
+  algo="dpsgd"        — E2 `decent`: ppermute params to both ring neighbors,
+                        mix (p+l+r)/3 between backward and step — exact
+                        D-PSGD ordering (decent.cpp:173-246).
+  algo="eventgrad"    — E3/E4 `event`: per-parameter event bits gate a
+                        masked exchange; receivers hold stale buffers
+                        (event.cpp:306-488).
+  algo="sp_eventgrad" — E5 `spevent`: fired parameters ship top-k
+                        (value, index) payloads scattered into persistent
+                        neighbor replicas (spevent.cpp:339-542).
+
+The returned `step(state, batch)` is pure per-rank SPMD code (collectives on
+named axes); lift it with `parallel.spmd` under either a real mesh or the
+single-chip vmap simulator, and wrap in `jax.jit` with donated state.
+
+Loss: softmax cross-entropy on the model output. For models that already
+emit log-probabilities this equals the reference's double-log_softmax
+(nll_loss∘log_softmax of a log_softmax output, event.cpp:291) because
+log_softmax is idempotent; for logit models (MLP/ResNet) it equals
+nll_loss∘log_softmax (cent.cpp:119) and cross_entropy
+(dcifar10/event/event.cpp:268) respectively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from eventgrad_tpu.data.augment import pad_flip_crop
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import EventConfig, decide_and_update
+from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
+from eventgrad_tpu.parallel.topology import Topology
+from eventgrad_tpu.utils import trees
+
+ALGOS = ("allreduce", "dpsgd", "eventgrad", "sp_eventgrad")
+
+
+def _xent(output: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(output, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def _param_bytes(params: Any) -> int:
+    return 4 * trees.tree_count_params(params)
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    topo: Topology,
+    algo: str = "dpsgd",
+    event_cfg: Optional[EventConfig] = None,
+    sparse_cfg: Optional[SparseConfig] = None,
+    augment: bool = False,
+    sync_bn: bool = False,
+) -> Callable:
+    """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B])."""
+    if algo not in ALGOS:
+        raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
+    event_cfg = event_cfg or EventConfig()
+    sparse_cfg = sparse_cfg or SparseConfig()
+    n_nb = topo.n_neighbors
+
+    def step(state, batch):
+        x, y = batch
+        rng, k_aug, k_drop = jax.random.split(state.rng, 3)
+        pass_num = state.pass_num + 1
+
+        if augment:
+            x = pad_flip_crop(k_aug, x)
+
+        has_bn = bool(jax.tree.leaves(state.batch_stats))
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+                out, updated = model.apply(
+                    variables,
+                    x,
+                    train=True,
+                    rngs={"dropout": k_drop},
+                    mutable=["batch_stats"],
+                )
+                new_stats = updated["batch_stats"]
+            else:
+                out = model.apply(variables, x, train=True, rngs={"dropout": k_drop})
+                new_stats = state.batch_stats
+            return _xent(out, y), (out, new_stats)
+
+        (loss, (out, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+
+        params = state.params
+        event_state = state.event
+        sparse_state = state.sparse
+        total_bytes = jnp.float32(_param_bytes(params))
+        fired_frac = jnp.float32(1.0)
+        sent_bytes = jnp.float32(n_nb) * total_bytes
+
+        if algo == "allreduce":
+            # E1: average gradients across all ranks, params stay replicated.
+            grads = collectives.allreduce_mean(grads, topo)
+            mixed = params
+            sent_bytes = total_bytes  # one all-reduce share per chip per step
+
+        elif algo == "dpsgd":
+            bufs = collectives.neighbor_vals(params, topo)
+            mixed = collectives.mix(params, bufs, topo)
+
+        elif algo == "eventgrad":
+            fire, event_state = decide_and_update(
+                params, event_state, pass_num, event_cfg, n_nb
+            )
+            bufs, _ = collectives.masked_neighbor_vals(
+                params, fire, event_state.bufs, topo
+            )
+            event_state = event_state.replace(bufs=bufs)
+            mixed = collectives.mix(params, bufs, topo)
+            fired = [
+                (f.astype(jnp.float32), p.size)
+                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
+            ]
+            sent_bytes = jnp.float32(n_nb) * 4.0 * sum(f * n for f, n in fired)
+            fired_frac = sum(f for f, _ in fired) / len(fired)
+
+        elif algo == "sp_eventgrad":
+            fire, event_state = decide_and_update(
+                params, event_state, pass_num, event_cfg, n_nb
+            )
+            sparse_state = sparse_exchange(params, fire, sparse_state, topo, sparse_cfg)
+            mixed = collectives.mix(params, sparse_state.replicas, topo)
+            fired = [
+                (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
+                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
+            ]
+            # values + int32 indices: 8 bytes per selected element per neighbor
+            sent_bytes = jnp.float32(n_nb) * 8.0 * sum(f * k for f, k in fired)
+            fired_frac = sum(f for f, _ in fired) / len(fired)
+
+        # optimizer applies gradients (computed at pre-mix params) to the
+        # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
+        updates, opt_state = tx.update(grads, state.opt_state, mixed)
+        params = optax.apply_updates(mixed, updates)
+
+        if sync_bn and has_bn:
+            new_stats = collectives.allreduce_mean(new_stats, topo)
+
+        new_state = state.replace(
+            params=params,
+            opt_state=opt_state,
+            batch_stats=new_stats,
+            pass_num=pass_num,
+            rng=rng,
+            event=event_state,
+            sparse=sparse_state,
+        )
+        metrics = {
+            "loss": loss,
+            "correct": jnp.sum(jnp.argmax(out, axis=-1) == y).astype(jnp.int32),
+            "fired_frac": fired_frac,
+            "sent_bytes": sent_bytes,
+            "num_events": (
+                event_state.num_events if event_state is not None else jnp.int32(0)
+            ),
+        }
+        return new_state, metrics
+
+    return step

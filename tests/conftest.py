@@ -1,0 +1,20 @@
+"""Test harness: emulate an 8-device mesh on CPU.
+
+The environment pins JAX_PLATFORMS=axon (the real TPU tunnel) and pre-imports
+jax via PYTHONPATH sitecustomize, so plain env vars are not enough; we must
+also flip the config before any backend initializes. XLA_FLAGS still has to
+be set before the CPU client spins up.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"

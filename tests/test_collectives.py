@@ -1,0 +1,119 @@
+"""Collective semantics on both lifting paths (vmap sim and shard_map mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.spmd import build_mesh, spmd
+from eventgrad_tpu.parallel.topology import Ring, Torus
+
+
+def _lift(fn, topo, backend):
+    if backend == "vmap":
+        return spmd(fn, topo)
+    return spmd(fn, topo, mesh=build_mesh(topo))
+
+
+BACKENDS = ["vmap", "shard_map"]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_recv_from_ring_shift(backend):
+    topo = Ring(4)
+
+    def fn(x):
+        left = collectives.recv_from(x, topo, topo.neighbors[0])
+        right = collectives.recv_from(x, topo, topo.neighbors[1])
+        return left, right
+
+    x = jnp.arange(4.0)
+    left, right = _lift(fn, topo, backend)(x)
+    # rank r receives rank r-1's value from the left, r+1's from the right
+    np.testing.assert_allclose(left, [3.0, 0.0, 1.0, 2.0])
+    np.testing.assert_allclose(right, [1.0, 2.0, 3.0, 0.0])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_allreduce_mean_matches_numpy(backend):
+    topo = Ring(8)
+    x = jnp.arange(8.0) * 2.0
+
+    def fn(x):
+        return collectives.allreduce_mean(x, topo)
+
+    out = _lift(fn, topo, backend)(x)
+    np.testing.assert_allclose(out, np.full(8, np.arange(8.0).mean() * 2.0))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_dpsgd_mixing_on_ring(backend):
+    """p <- (p + left + right)/3, per decent.cpp:232-234."""
+    topo = Ring(4)
+
+    def fn(p):
+        bufs = collectives.neighbor_vals(p, topo)
+        return collectives.mix(p, bufs, topo)
+
+    p = jnp.array([0.0, 3.0, 6.0, 9.0])
+    out = _lift(fn, topo, backend)(p)
+    expect = [(0 + 9 + 3) / 3, (3 + 0 + 6) / 3, (6 + 3 + 9) / 3, (9 + 6 + 0) / 3]
+    np.testing.assert_allclose(out, expect)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_torus_four_neighbor_mix(backend):
+    topo = Torus(4, 2)
+
+    def fn(p):
+        bufs = collectives.neighbor_vals(p, topo)
+        return collectives.mix(p, bufs, topo)
+
+    p = jnp.arange(8.0)
+    out = _lift(fn, topo, backend)(p)
+
+    grid = np.arange(8.0).reshape(4, 2)
+    expect = np.zeros_like(grid)
+    for i in range(4):
+        for j in range(2):
+            vals = [
+                grid[i, j],
+                grid[(i - 1) % 4, j],
+                grid[(i + 1) % 4, j],
+                grid[i, (j - 1) % 2],
+                grid[i, (j + 1) % 2],
+            ]
+            expect[i, j] = sum(vals) / 5
+    np.testing.assert_allclose(out, expect.reshape(-1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_masked_exchange_keeps_stale_buffer(backend):
+    topo = Ring(4)
+
+    def fn(p, fire, last):
+        bufs, fires = collectives.masked_neighbor_vals(p, fire, (last, last), topo)
+        return bufs
+
+    p = jnp.array([1.0, 2.0, 3.0, 4.0])
+    # only ranks 0 and 2 fire
+    fire = jnp.array([True, False, True, False])
+    last = jnp.full(4, -7.0)
+    left_buf, right_buf = _lift(fn, topo, backend)(p, fire, last)
+    # from the left: rank r sees rank r-1's payload iff r-1 fired, else stale
+    np.testing.assert_allclose(left_buf, [-7.0, 1.0, -7.0, 3.0])
+    # from the right: rank r sees rank r+1's payload iff r+1 fired, else stale
+    np.testing.assert_allclose(right_buf, [-7.0, 3.0, -7.0, 1.0])
+
+
+def test_pytree_exchange_vmap():
+    topo = Ring(4)
+    tree = {"a": jnp.arange(4.0), "b": jnp.arange(8.0).reshape(4, 2)}
+
+    def fn(t):
+        return collectives.neighbor_vals(t, topo)
+
+    left, right = spmd(fn, topo)(tree)
+    np.testing.assert_allclose(left["a"], [3.0, 0.0, 1.0, 2.0])
+    np.testing.assert_allclose(right["b"][0], [2.0, 3.0])

@@ -1,0 +1,25 @@
+import pytest
+
+from eventgrad_tpu.parallel.topology import Ring, Torus
+
+
+def test_ring_neighbors():
+    topo = Ring(4)
+    assert topo.n_ranks == 4
+    offsets = [(nb.axis, nb.offset) for nb in topo.neighbors]
+    assert offsets == [("ring", -1), ("ring", 1)]
+    assert topo.mix_weight == pytest.approx(1 / 3)
+
+
+def test_torus_neighbors():
+    topo = Torus(4, 2)
+    assert topo.n_ranks == 8
+    assert topo.n_neighbors == 4
+    assert topo.mix_weight == pytest.approx(1 / 5)
+
+
+def test_degenerate_axis_has_no_neighbors():
+    topo = Ring(1)
+    assert topo.n_neighbors == 0
+    topo = Torus(4, 1)
+    assert topo.n_neighbors == 2  # only the size-4 axis gossips
