@@ -1,0 +1,83 @@
+"""EventGraD sender state machine vs a hand-computed trace of
+/root/reference/dmnist/event/event.cpp:324-391 semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.parallel.events import EventConfig, EventState, decide_and_update
+from eventgrad_tpu.parallel.topology import Ring
+
+
+def _state(params, topo, cfg):
+    return EventState.init(params, topo, cfg)
+
+
+def test_adaptive_trace_single_param():
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=True, horizon=0.5, warmup_passes=0, history=2)
+    params = {"w": jnp.array([3.0, 4.0])}  # norm 5
+    st = _state(params, topo, cfg)
+
+    # pass 1: vd = |5-0| = 5 >= thres 0*0.5 -> fire
+    fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
+    assert bool(fire["w"])
+    np.testing.assert_allclose(st.slopes["w"], [0.0, 5.0])  # slope = 5/1
+    np.testing.assert_allclose(st.thres["w"], 2.5)  # mean of history
+    np.testing.assert_allclose(st.last_sent_norm["w"], 5.0)
+    np.testing.assert_allclose(st.last_sent_iter["w"], 1.0)
+    assert int(st.num_events) == 2  # ring: counts both neighbors (event.cpp:344)
+
+    # pass 2: norm 5.5 -> vd 0.5 < thres 2.5*0.5=1.25 -> no fire, decay only
+    params2 = {"w": jnp.array([3.3, 4.4])}  # norm 5.5
+    fire, st = decide_and_update(params2, st, jnp.int32(2), cfg, topo.n_neighbors)
+    assert not bool(fire["w"])
+    np.testing.assert_allclose(st.thres["w"], 1.25)
+    np.testing.assert_allclose(st.last_sent_norm["w"], 5.0)
+    assert int(st.num_events) == 2
+
+    # pass 3: norm 7 -> vd 2 >= thres 0.625 -> fire; slope = 2/(3-1) = 1
+    params3 = {"w": jnp.array([jnp.sqrt(49.0), 0.0])}
+    fire, st = decide_and_update(params3, st, jnp.int32(3), cfg, topo.n_neighbors)
+    assert bool(fire["w"])
+    np.testing.assert_allclose(st.slopes["w"], [5.0, 1.0])
+    np.testing.assert_allclose(st.thres["w"], 3.0)
+    np.testing.assert_allclose(st.last_sent_iter["w"], 3.0)
+    assert int(st.num_events) == 4
+
+
+def test_constant_threshold_mode():
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=False, constant=10.0, warmup_passes=0)
+    params = {"w": jnp.array([3.0, 4.0])}
+    st = _state(params, topo, cfg)
+
+    fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
+    assert not bool(fire["w"])  # vd 5 < 10
+    np.testing.assert_allclose(st.thres["w"], 10.0)
+
+    cfg0 = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
+    st0 = _state(params, topo, cfg0)
+    fire, _ = decide_and_update(params, st0, jnp.int32(1), cfg0, topo.n_neighbors)
+    assert bool(fire["w"])  # threshold 0 always fires -> exact D-PSGD
+
+
+def test_warmup_always_fires():
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=False, constant=1e9, warmup_passes=30)
+    params = {"w": jnp.zeros(3)}  # vd = 0 every pass
+    st = _state(params, topo, cfg)
+    for p in range(1, 30):  # pass_num < 30 fires (event.cpp:343 strict <)
+        fire, st = decide_and_update(params, st, jnp.int32(p), cfg, topo.n_neighbors)
+        assert bool(fire["w"]), p
+    fire, st = decide_and_update(params, st, jnp.int32(30), cfg, topo.n_neighbors)
+    assert not bool(fire["w"])
+
+
+def test_multi_param_independent_state():
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=False, constant=4.0, warmup_passes=0)
+    params = {"a": jnp.array([3.0, 4.0]), "b": jnp.array([1.0, 0.0])}
+    st = _state(params, topo, cfg)
+    fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
+    assert bool(fire["a"]) and not bool(fire["b"])
+    assert int(st.num_events) == 2

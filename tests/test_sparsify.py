@@ -1,0 +1,51 @@
+"""Top-k payload selection and scatter semantics (spevent.cpp:339-542)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.parallel.sparsify import (
+    SparseConfig,
+    SparseState,
+    scatter_into,
+    topk_payload,
+)
+from eventgrad_tpu.parallel.topology import Ring
+
+
+def test_k_for_ceil_rule():
+    cfg = SparseConfig(topk_percent=10.0)
+    assert cfg.k_for(100) == 10
+    assert cfg.k_for(101) == 11  # ceil (spevent.cpp:148)
+    assert cfg.k_for(5) == 1
+    cfg_all = SparseConfig(topk_percent=100.0)
+    assert cfg_all.k_for(7) == 7
+
+
+def test_topk_selects_largest_drift():
+    cfg = SparseConfig(topk_percent=50.0)
+    params = {"w": jnp.array([1.0, 5.0, 2.0, 9.0])}
+    prev = {"w": jnp.array([1.0, 0.0, 2.5, 0.0])}  # |diff| = [0, 5, .5, 9]
+    vals, idxs = topk_payload(params, prev, cfg)
+    assert sorted(np.asarray(idxs["w"]).tolist()) == [1, 3]
+    # values are the *current* params at those indices, not the diffs
+    got = dict(zip(np.asarray(idxs["w"]).tolist(), np.asarray(vals["w"]).tolist()))
+    assert got == {1: 5.0, 3: 9.0}
+
+
+def test_scatter_respects_gate():
+    full = {"w": jnp.zeros((2, 2))}
+    vals = {"w": jnp.array([7.0])}
+    idxs = {"w": jnp.array([3], jnp.int32)}
+    out = scatter_into(full, vals, idxs, {"w": jnp.array(True)})
+    np.testing.assert_allclose(out["w"], [[0, 0], [0, 7.0]])
+    out = scatter_into(full, vals, idxs, {"w": jnp.array(False)})
+    np.testing.assert_allclose(out["w"], np.zeros((2, 2)))
+
+
+def test_state_init_copies_params():
+    topo = Ring(4)
+    params = {"w": jnp.arange(4.0)}
+    st = SparseState.init(params, topo)
+    np.testing.assert_allclose(st.prev_sent["w"], params["w"])
+    assert len(st.replicas) == 2
+    np.testing.assert_allclose(st.replicas[0]["w"], params["w"])
