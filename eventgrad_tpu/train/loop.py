@@ -29,6 +29,7 @@ from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.train.state import init_train_state
 from eventgrad_tpu.train.steps import make_train_step
 from eventgrad_tpu.utils import trees
+from eventgrad_tpu.utils.metrics import msgs_saved_pct
 
 
 def consensus_params(stacked_params: Any) -> Any:
@@ -74,6 +75,7 @@ def train(
     sparse_cfg: Optional[SparseConfig] = None,
     augment: bool = False,
     random_sampler: bool = False,
+    sync_bn: bool = False,
     mesh=None,
     seed: int = 0,
     x_test: Optional[np.ndarray] = None,
@@ -88,6 +90,7 @@ def train(
     step = make_train_step(
         model, tx, topo, algo,
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
+        sync_bn=sync_bn,
     )
     lifted = spmd(step, topo, mesh=mesh)
 
@@ -133,9 +136,10 @@ def train(
         if algo in ("eventgrad", "sp_eventgrad"):
             # msgs-saved vs D-PSGD: events/(n_neighbors * passes * sz) fired
             events_total = int(m["num_events"][-1].sum())
-            possible = topo.n_neighbors * total_passes * sz * topo.n_ranks
             rec["num_events"] = events_total
-            rec["msgs_saved_pct"] = 100.0 * (1.0 - events_total / possible)
+            rec["msgs_saved_pct"] = msgs_saved_pct(
+                events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
+            )
             rec["fired_frac"] = float(m["fired_frac"].mean())
         if x_test is not None and log_every_epoch:
             cons = consensus_params(state.params)
