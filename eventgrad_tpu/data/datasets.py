@@ -53,8 +53,19 @@ def load_mnist(
     data_dir: str, split: str = "train", normalize: bool = True
 ) -> Tuple[np.ndarray, np.ndarray]:
     prefix = "train" if split == "train" else "t10k"
-    images = _read_idx(os.path.join(data_dir, f"{prefix}-images-idx3-ubyte"))
-    labels = _read_idx(os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte"))
+    ipath = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte")
+    lpath = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte")
+
+    # fast path: native idx reader (raw files only; gz falls through)
+    from eventgrad_tpu.data import native
+
+    mean, std = (MNIST_MEAN, MNIST_STD) if normalize else (0.0, 0.0)
+    out = native.load_mnist_idx(ipath, lpath, mean, std)
+    if out is not None:
+        return out
+
+    images = _read_idx(ipath)
+    labels = _read_idx(lpath)
     x = images.astype(np.float32)[..., None] / 255.0
     if normalize:
         x = (x - MNIST_MEAN) / MNIST_STD
@@ -68,9 +79,18 @@ def load_cifar10(data_dir: str, split: str = "train") -> Tuple[np.ndarray, np.nd
         else ["test_batch.bin"]
     )
     if os.path.exists(os.path.join(data_dir, bin_names[0])):
+        paths = [os.path.join(data_dir, n) for n in bin_names]
+
+        # fast path: native binary reader
+        from eventgrad_tpu.data import native
+
+        out = native.load_cifar10_bin(paths)
+        if out is not None:
+            return out
+
         xs, ys = [], []
-        for name in bin_names:
-            raw = np.fromfile(os.path.join(data_dir, name), np.uint8).reshape(-1, 3073)
+        for path in paths:
+            raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
             ys.append(raw[:, 0])
             xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
         x = np.concatenate(xs).astype(np.float32) / 255.0

@@ -1,0 +1,147 @@
+"""ctypes bindings for the native data pipeline (native/dataio.cpp).
+
+Auto-builds `libeg_dataio.so` with the in-tree Makefile on first use when a
+compiler is available; every entry point has a pure-numpy fallback so the
+framework stays fully functional without the native library. The native
+paths matter on big datasets: zero-copy idx/CIFAR-binary parsing and
+memcpy batch gathers instead of numpy fancy-indexing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libeg_dataio.so"))
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on demand; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    i64, i32, f32, u64 = (
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_float,
+        ctypes.c_uint64,
+    )
+    pf = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    pi32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    pi64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+    lib.eg_load_cifar10_file.restype = i64
+    lib.eg_load_cifar10_file.argtypes = [ctypes.c_char_p, pf, pi32, i64]
+    lib.eg_load_mnist.restype = i64
+    lib.eg_load_mnist.argtypes = [ctypes.c_char_p, ctypes.c_char_p, pf, pi32, i64, f32, f32]
+    lib.eg_shard_plan.restype = None
+    lib.eg_shard_plan.argtypes = [i64, i64, u64, u64, ctypes.c_int, pi64]
+    lib.eg_gather.restype = None
+    lib.eg_gather.argtypes = [pf, i64, pi64, i64, pf]
+    lib.eg_gather_i32.restype = None
+    lib.eg_gather_i32.argtypes = [pi32, pi64, i64, pi32]
+    lib.eg_version.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def load_cifar10_bin(paths) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Read CIFAR-10 binary batch files natively; None if lib unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    per_file = 10_000
+    x = np.empty((per_file * len(paths), 32, 32, 3), np.float32)
+    y = np.empty(per_file * len(paths), np.int32)
+    total = 0
+    for p in paths:
+        got = lib.eg_load_cifar10_file(
+            str(p).encode(), x[total:].reshape(-1), y[total:], per_file
+        )
+        if got < 0:
+            return None
+        total += int(got)
+    return x[:total], y[:total]
+
+
+def load_mnist_idx(
+    images_path: str, labels_path: str, mean: float, std: float
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = load_library()
+    if lib is None or not (os.path.exists(images_path) and os.path.exists(labels_path)):
+        return None
+    cap = 70_000
+    x = np.empty((cap, 28, 28, 1), np.float32)
+    y = np.empty(cap, np.int32)
+    got = lib.eg_load_mnist(
+        images_path.encode(), labels_path.encode(), x.reshape(-1), y, cap, mean, std
+    )
+    if got < 0:
+        return None
+    return x[: int(got)], y[: int(got)]
+
+
+def shard_plan(
+    n: int, n_ranks: int, seed: int = 0, epoch: int = 0, shuffle: bool = False
+) -> np.ndarray:
+    """[n_ranks, n // n_ranks] shard index plan (native or numpy fallback)."""
+    per = n // n_ranks
+    lib = load_library()
+    if lib is None:
+        if not shuffle:
+            return np.arange(n_ranks * per, dtype=np.int64).reshape(n_ranks, per)
+        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+        return rng.permutation(n)[: n_ranks * per].reshape(n_ranks, per).astype(np.int64)
+    out = np.empty(n_ranks * per, np.int64)
+    lib.eg_shard_plan(n, n_ranks, seed, epoch, int(shuffle), out)
+    return out.reshape(n_ranks, per)
+
+
+def gather_batches(
+    x: np.ndarray, y: np.ndarray, idx: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Assemble [*idx.shape, ...sample] batches with native memcpy gathers."""
+    lib = load_library()
+    flat_idx = np.ascontiguousarray(idx.reshape(-1), np.int64)
+    if lib is None:
+        return x[flat_idx].reshape(idx.shape + x.shape[1:]), y[flat_idx].reshape(idx.shape)
+    x2 = np.ascontiguousarray(x, np.float32)
+    y2 = np.ascontiguousarray(y, np.int32)
+    elem = int(np.prod(x.shape[1:]))
+    xo = np.empty((flat_idx.size, elem), np.float32)
+    yo = np.empty(flat_idx.size, np.int32)
+    lib.eg_gather(x2.reshape(-1), elem, flat_idx, flat_idx.size, xo.reshape(-1))
+    lib.eg_gather_i32(y2, flat_idx, flat_idx.size, yo)
+    return xo.reshape(idx.shape + x.shape[1:]), yo.reshape(idx.shape)

@@ -1,0 +1,154 @@
+// eventgrad-tpu native data pipeline.
+//
+// TPU-native replacement for the reference's C++ data layer: the OpenCV JPEG
+// walker + label map of /root/reference/dcifar10/common/custom.hpp:26-122 and
+// libtorch's MNIST reader (used at dmnist/cent/cent.cpp:53-56). On TPU the
+// only host-side jobs are bulk IO, deterministic shard/shuffle planning, and
+// contiguous batch assembly (pixels are augmented on-device); those are
+// exactly what this library does, exposed as a C ABI consumed from Python via
+// ctypes (no pybind11 in this image).
+//
+// Everything is deterministic: shuffling uses splitmix64 seeded by
+// (seed, epoch), mirroring the reference's per-epoch reshuffle of its path
+// list (custom.hpp:119-120) without the hidden global RNG.
+//
+// Build: `make -C native` (plain g++ -O3 -shared; no external deps).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// deterministic RNG (splitmix64) — stable across platforms, unlike std::mt19937
+// usage patterns that depend on distribution implementations.
+// ---------------------------------------------------------------------------
+static inline uint64_t splitmix64(uint64_t &state) {
+  uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// ---------------------------------------------------------------------------
+// CIFAR-10 binary batches: each record is 1 label byte + 3072 CHW bytes.
+// Returns number of samples written, or -1 on IO error.
+// Output images are NHWC float32 in [0,1]; labels int32.
+// ---------------------------------------------------------------------------
+int64_t eg_load_cifar10_file(const char *path, float *images, int32_t *labels,
+                             int64_t max_samples) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  const int64_t rec = 1 + 3 * 32 * 32;
+  unsigned char buf[1 + 3 * 32 * 32];
+  int64_t n = 0;
+  const float inv = 1.0f / 255.0f;
+  while (n < max_samples && fread(buf, 1, rec, f) == (size_t)rec) {
+    labels[n] = (int32_t)buf[0];
+    float *out = images + n * 32 * 32 * 3;
+    // CHW uint8 -> HWC float
+    for (int c = 0; c < 3; ++c) {
+      const unsigned char *plane = buf + 1 + c * 32 * 32;
+      for (int hw = 0; hw < 32 * 32; ++hw) {
+        out[hw * 3 + c] = (float)plane[hw] * inv;
+      }
+    }
+    ++n;
+  }
+  fclose(f);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// MNIST idx files (big-endian headers).
+// images path + labels path -> NHWC float32 (normalized if mean/std given).
+// Returns sample count or -1.
+// ---------------------------------------------------------------------------
+static uint32_t be32(const unsigned char *p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+int64_t eg_load_mnist(const char *images_path, const char *labels_path,
+                      float *images, int32_t *labels, int64_t max_samples,
+                      float mean, float std) {
+  FILE *fi = fopen(images_path, "rb");
+  if (!fi) return -1;
+  unsigned char hdr[16];
+  if (fread(hdr, 1, 16, fi) != 16) { fclose(fi); return -1; }
+  int64_t n = be32(hdr + 4), rows = be32(hdr + 8), cols = be32(hdr + 12);
+  if (n > max_samples) n = max_samples;
+  const int64_t px = rows * cols;
+  unsigned char *row = new unsigned char[px];
+  const float inv = 1.0f / 255.0f;
+  const float s = (std > 0.0f) ? (1.0f / std) : 1.0f;
+  for (int64_t i = 0; i < n; ++i) {
+    if (fread(row, 1, px, fi) != (size_t)px) { n = i; break; }
+    float *out = images + i * px;
+    for (int64_t j = 0; j < px; ++j)
+      out[j] = ((float)row[j] * inv - mean) * s;
+  }
+  delete[] row;
+  fclose(fi);
+
+  FILE *fl = fopen(labels_path, "rb");
+  if (!fl) return -1;
+  unsigned char lhdr[8];
+  if (fread(lhdr, 1, 8, fl) != 8) { fclose(fl); return -1; }
+  unsigned char *lab = new unsigned char[n];
+  int64_t got = (int64_t)fread(lab, 1, n, fl);
+  for (int64_t i = 0; i < got; ++i) labels[i] = (int32_t)lab[i];
+  delete[] lab;
+  fclose(fl);
+  return (got < n) ? got : n;
+}
+
+// ---------------------------------------------------------------------------
+// Distributed shard plan — the reference's samplers as one call
+// (DistributedRandomSampler / DistributedSequentialSampler,
+//  cent.cpp:59-60, decent.cpp:81-82): disjoint 1/N shards, optionally a
+// global Fisher-Yates permutation reseeded per (seed, epoch).
+// out_idx has space for n_ranks * (n / n_ranks) int64s.
+// ---------------------------------------------------------------------------
+void eg_shard_plan(int64_t n, int64_t n_ranks, uint64_t seed, uint64_t epoch,
+                   int shuffle, int64_t *out_idx) {
+  const int64_t per = n / n_ranks;
+  const int64_t total = per * n_ranks;
+  if (!shuffle) {
+    for (int64_t i = 0; i < total; ++i) out_idx[i] = i;
+    return;
+  }
+  int64_t *perm = new int64_t[n];
+  for (int64_t i = 0; i < n; ++i) perm[i] = i;
+  uint64_t st = seed * 0x9E3779B97F4A7C15ULL + epoch + 1;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = (int64_t)(splitmix64(st) % (uint64_t)(i + 1));
+    int64_t t = perm[i]; perm[i] = perm[j]; perm[j] = t;
+  }
+  memcpy(out_idx, perm, total * sizeof(int64_t));
+  delete[] perm;
+}
+
+// ---------------------------------------------------------------------------
+// Batch assembly: gather rows of a contiguous [n, elem] float array into
+// [count, elem] following idx — the contiguous-marshalling role the reference
+// performs per-tensor with flatten+memcpy (dcifar10/event/event.cpp:292-297),
+// applied host-side to sample batches before one device_put.
+// ---------------------------------------------------------------------------
+void eg_gather(const float *src, int64_t elem, const int64_t *idx,
+               int64_t count, float *dst) {
+  const size_t bytes = (size_t)elem * sizeof(float);
+  for (int64_t i = 0; i < count; ++i)
+    memcpy(dst + i * elem, src + idx[i] * elem, bytes);
+}
+
+void eg_gather_i32(const int32_t *src, const int64_t *idx, int64_t count,
+                   int32_t *dst) {
+  for (int64_t i = 0; i < count; ++i) dst[i] = src[idx[i]];
+}
+
+int eg_version(void) { return 1; }
+
+}  // extern "C"
