@@ -40,20 +40,37 @@ class NeighborSpec:
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """A named-axis layout of ranks plus the gossip neighbor set."""
+    """A named-axis layout of ranks plus the gossip neighbor set.
+
+    `gossip_axes` restricts which axes carry gossip neighbors; axes outside
+    it are *auxiliary* parallelism axes (e.g. a sequence-parallel axis whose
+    ranks hold identical parameters and pmean their gradients — see
+    `ring_attention` and `train.steps`). Default: every axis gossips.
+    """
 
     axes: Tuple[str, ...]
     shape: Tuple[int, ...]
+    gossip_axes: Tuple[str, ...] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if len(self.axes) != len(self.shape):
             raise ValueError(f"axes {self.axes} vs shape {self.shape} length mismatch")
         if any(s < 1 for s in self.shape):
             raise ValueError(f"invalid topology shape {self.shape}")
+        if self.gossip_axes is None:
+            object.__setattr__(self, "gossip_axes", tuple(self.axes))
+        elif any(a not in self.axes for a in self.gossip_axes):
+            raise ValueError(f"gossip_axes {self.gossip_axes} not all in {self.axes}")
 
     @property
     def n_ranks(self) -> int:
         return math.prod(self.shape)
+
+    @property
+    def aux_axes(self) -> Tuple[str, ...]:
+        """Non-gossip axes (sequence/aux parallelism); ranks along these hold
+        identical parameters and synchronize gradients by pmean."""
+        return tuple(a for a in self.axes if a not in self.gossip_axes)
 
     @property
     def neighbors(self) -> Tuple[NeighborSpec, ...]:
@@ -65,7 +82,7 @@ class Topology:
         """
         specs = []
         for axis, size in zip(self.axes, self.shape):
-            if size > 1:
+            if size > 1 and axis in self.gossip_axes:
                 specs.append(NeighborSpec(axis, -1))
                 specs.append(NeighborSpec(axis, +1))
         return tuple(specs)

@@ -43,10 +43,11 @@ def init_train_state(
     algo: str,
     event_cfg: Optional[EventConfig] = None,
     seed: int = 0,
+    input_dtype=jnp.float32,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks."""
     root = jax.random.PRNGKey(seed)
-    variables = model.init(root, jnp.zeros((1,) + tuple(input_shape), jnp.float32))
+    variables = model.init(root, jnp.zeros((1,) + tuple(input_shape), input_dtype))
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     opt_state = tx.init(params)

@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from eventgrad_tpu.data.augment import pad_flip_crop
 from eventgrad_tpu.parallel import collectives
@@ -45,8 +46,12 @@ ALGOS = ("allreduce", "dpsgd", "eventgrad", "sp_eventgrad")
 
 
 def _xent(output: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax cross-entropy over the trailing class axis; `labels` has the
+    output's shape minus that axis (so this serves both [B,C] classification
+    and [B,T,V] next-token prediction)."""
     logp = jax.nn.log_softmax(output, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
 
 
 def _param_bytes(params: Any) -> int:
@@ -100,6 +105,15 @@ def make_train_step(
         (loss, (out, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
+
+        # auxiliary (non-gossip) parallelism axes — e.g. sequence parallelism:
+        # ranks along them hold identical parameters and share one logical
+        # batch, so gradients (and BN stats) are plain data-parallel pmeans
+        # there; gossip applies only across topo.gossip_axes.
+        for aux in topo.aux_axes:
+            grads = lax.pmean(grads, aux)
+            if has_bn:
+                new_stats = lax.pmean(new_stats, aux)
 
         params = state.params
         event_state = state.event

@@ -1,0 +1,108 @@
+"""Hybrid-mesh training: EventGraD gossip across dp × ring-attention SP.
+
+The strongest structural test in the suite: a Transformer LM whose sequence
+is sharded over an `sp` mesh axis (ring attention) while its parameters
+gossip event-triggered over a `dp` ring — both collectives in one jitted
+step on a 4x2 mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.models.transformer import TransformerLM
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import build_mesh, spmd
+from eventgrad_tpu.parallel.topology import Ring, Topology
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+VOCAB, DIM, HEADS, LAYERS = 64, 32, 4, 2
+B, T_GLOBAL = 2, 32
+
+
+def _lm_batch(key, n_ranks_dp, n_sp, t_local):
+    """Token batches: dp ranks get different sequences; sp ranks share one
+    sequence, each holding its chunk. targets are the next token globally."""
+    toks = jax.random.randint(key, (n_ranks_dp, B, T_GLOBAL), 0, VOCAB)
+    tgts = jnp.roll(toks, -1, axis=-1)
+    xs, ys = [], []
+    for dp in range(n_ranks_dp):
+        for sp in range(n_sp):
+            sl = slice(sp * t_local, (sp + 1) * t_local)
+            xs.append(toks[dp, :, sl])
+            ys.append(tgts[dp, :, sl])
+    return jnp.stack(xs), jnp.stack(ys)
+
+
+def test_transformer_full_attention_trains():
+    topo = Ring(4)
+    model = TransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                          max_len=T_GLOBAL)
+    tx = optax.sgd(0.1)
+    state = init_train_state(
+        model, (T_GLOBAL,), tx, topo, "dpsgd", input_dtype=jnp.int32
+    )
+    step = make_train_step(model, tx, topo, "dpsgd")
+    lifted = jax.jit(spmd(step, topo))
+
+    key = jax.random.PRNGKey(3)
+    toks = jax.random.randint(key, (4, B, T_GLOBAL), 0, VOCAB)
+    tgts = jnp.roll(toks, -1, axis=-1)
+    losses = []
+    for i in range(8):
+        state, m = lifted(state, (toks, tgts))
+        losses.append(float(np.asarray(m["loss"]).mean()))
+    assert losses[-1] < losses[0]  # memorizes the fixed batch
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_hybrid_dp_gossip_sp_attention(attn):
+    n_dp, n_sp = 4, 2
+    t_local = T_GLOBAL // n_sp
+    topo = Topology(axes=("dp", "sp"), shape=(n_dp, n_sp), gossip_axes=("dp",))
+    assert topo.aux_axes == ("sp",)
+    assert len(topo.neighbors) == 2  # dp ring only
+
+    model = TransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                          max_len=T_GLOBAL, attn=attn, topo=topo, sp_axis="sp")
+    tx = optax.sgd(0.1)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+
+    # init params outside the mesh context with the full-attention twin
+    twin = TransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                         max_len=T_GLOBAL)
+    variables = twin.init(jax.random.PRNGKey(0), jnp.zeros((1, t_local), jnp.int32))
+    from eventgrad_tpu.parallel.events import EventState
+    from eventgrad_tpu.parallel.spmd import stack_for_ranks
+    from eventgrad_tpu.train.state import TrainState
+
+    per_rank = TrainState(
+        params=variables["params"],
+        opt_state=tx.init(variables["params"]),
+        batch_stats={},
+        pass_num=jnp.zeros((), jnp.int32),
+        rng=jax.random.PRNGKey(1),
+        event=EventState.init(variables["params"], topo, cfg),
+        sparse=None,
+    )
+    state = stack_for_ranks(per_rank, topo)
+    state = state.replace(rng=jax.random.split(jax.random.PRNGKey(2), topo.n_ranks))
+
+    step = make_train_step(model, tx, topo, "eventgrad", event_cfg=cfg)
+    lifted = jax.jit(spmd(step, topo))
+
+    xb, yb = _lm_batch(jax.random.PRNGKey(5), n_dp, n_sp, t_local)
+    losses = []
+    for i in range(6):
+        state, m = lifted(state, (xb, yb))
+        losses.append(float(np.asarray(m["loss"]).mean()))
+    assert losses[-1] < losses[0]
+
+    # sp ranks must remain parameter-identical (they pmean grads and receive
+    # identical gossip); dp gossip must have fired some events
+    p = jax.tree.leaves(state.params)[0].reshape(n_dp, n_sp, -1)
+    np.testing.assert_allclose(np.asarray(p[:, 0]), np.asarray(p[:, 1]), atol=1e-6)
+    assert int(np.asarray(state.event.num_events).sum()) > 0
