@@ -1,0 +1,1 @@
+from eventgrad_tpu.ops.fused_update import fused_mix_sgd, mix_sgd_reference

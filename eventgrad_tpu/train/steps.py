@@ -36,6 +36,7 @@ import optax
 from jax import lax
 
 from eventgrad_tpu.data.augment import pad_flip_crop
+from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.events import EventConfig, decide_and_update
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
@@ -67,13 +68,22 @@ def make_train_step(
     sparse_cfg: Optional[SparseConfig] = None,
     augment: bool = False,
     sync_bn: bool = False,
+    fused_sgd: Optional[Tuple[float, float]] = None,
 ) -> Callable:
-    """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B])."""
+    """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
+
+    fused_sgd=(lr, momentum): replace the mix + optax tail of gossip
+    algorithms with the Pallas fused_mix_sgd kernel (ops/fused_update.py) —
+    one HBM read/write per parameter element. The values MUST match the
+    `tx` the state was initialized with (plain SGD, optional trace
+    momentum); interpret mode is selected automatically off-TPU.
+    """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
     event_cfg = event_cfg or EventConfig()
     sparse_cfg = sparse_cfg or SparseConfig()
     n_nb = topo.n_neighbors
+    fused_interpret = jax.default_backend() != "tpu"
 
     def step(state, batch):
         x, y = batch
@@ -122,15 +132,14 @@ def make_train_step(
         fired_frac = jnp.float32(1.0)
         sent_bytes = jnp.float32(n_nb) * total_bytes
 
+        bufs = ()
         if algo == "allreduce":
             # E1: average gradients across all ranks, params stay replicated.
             grads = collectives.allreduce_mean(grads, topo)
-            mixed = params
             sent_bytes = total_bytes  # one all-reduce share per chip per step
 
         elif algo == "dpsgd":
             bufs = collectives.neighbor_vals(params, topo)
-            mixed = collectives.mix(params, bufs, topo)
 
         elif algo == "eventgrad":
             fire, event_state = decide_and_update(
@@ -140,7 +149,6 @@ def make_train_step(
                 params, fire, event_state.bufs, topo
             )
             event_state = event_state.replace(bufs=bufs)
-            mixed = collectives.mix(params, bufs, topo)
             fired = [
                 (f.astype(jnp.float32), p.size)
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
@@ -153,7 +161,7 @@ def make_train_step(
                 params, event_state, pass_num, event_cfg, n_nb
             )
             sparse_state = sparse_exchange(params, fire, sparse_state, topo, sparse_cfg)
-            mixed = collectives.mix(params, sparse_state.replicas, topo)
+            bufs = sparse_state.replicas
             fired = [
                 (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
@@ -162,10 +170,32 @@ def make_train_step(
             sent_bytes = jnp.float32(n_nb) * 8.0 * sum(f * k for f, k in fired)
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
-        # optimizer applies gradients (computed at pre-mix params) to the
-        # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
-        updates, opt_state = tx.update(grads, state.opt_state, mixed)
-        params = optax.apply_updates(mixed, updates)
+        if fused_sgd is not None and algo != "allreduce":
+            # Pallas fused tail: mix + momentum-SGD in one HBM pass.
+            lr_f, mom_f = fused_sgd
+            buf_sum = trees.tree_zeros_like(params)
+            for buf in bufs:
+                buf_sum = jax.tree.map(jnp.add, buf_sum, buf)
+            if mom_f:
+                trace = state.opt_state[0].trace
+            else:
+                trace = trees.tree_zeros_like(params)
+            params, new_trace = fused_mix_sgd(
+                params, buf_sum, grads, trace,
+                lr_f, mom_f, topo.mix_weight, interpret=fused_interpret,
+            )
+            if mom_f:
+                opt_state = (state.opt_state[0]._replace(trace=new_trace),) + tuple(
+                    state.opt_state[1:]
+                )
+            else:
+                opt_state = state.opt_state
+        else:
+            mixed = collectives.mix(params, bufs, topo) if bufs else params
+            # optimizer applies gradients (computed at pre-mix params) to the
+            # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
+            updates, opt_state = tx.update(grads, state.opt_state, mixed)
+            params = optax.apply_updates(mixed, updates)
 
         if sync_bn and has_bn:
             new_stats = collectives.allreduce_mean(new_stats, topo)
