@@ -42,15 +42,22 @@ class NeighborSpec:
 class Topology:
     """A named-axis layout of ranks plus the gossip neighbor set.
 
-    `gossip_axes` restricts which axes carry gossip neighbors; axes outside
-    it are *auxiliary* parallelism axes (e.g. a sequence-parallel axis whose
-    ranks hold identical parameters and pmean their gradients — see
-    `ring_attention` and `train.steps`). Default: every axis gossips.
+    Three axis classes:
+      * gossip axes (`gossip_axes`, default all): carry the decentralized
+        neighbor exchanges; per-rank parameters differ and mix by averaging.
+      * replicated aux axes (everything else not in `sharded_axes`): e.g. a
+        sequence-parallel axis — ranks hold identical parameters and pmean
+        their gradients (see `ring_attention` and `train.steps`).
+      * sharded axes (`sharded_axes`): tensor/expert parallelism — each rank
+        owns a distinct parameter shard; activations are synchronized inside
+        the model (psum/all_to_all in the TP layers), so the train step must
+        NOT average parameters or gradients across them.
     """
 
     axes: Tuple[str, ...]
     shape: Tuple[int, ...]
     gossip_axes: Tuple[str, ...] = None  # type: ignore[assignment]
+    sharded_axes: Tuple[str, ...] = ()
 
     def __post_init__(self):
         if len(self.axes) != len(self.shape):
@@ -58,9 +65,17 @@ class Topology:
         if any(s < 1 for s in self.shape):
             raise ValueError(f"invalid topology shape {self.shape}")
         if self.gossip_axes is None:
-            object.__setattr__(self, "gossip_axes", tuple(self.axes))
+            object.__setattr__(
+                self,
+                "gossip_axes",
+                tuple(a for a in self.axes if a not in self.sharded_axes),
+            )
         elif any(a not in self.axes for a in self.gossip_axes):
             raise ValueError(f"gossip_axes {self.gossip_axes} not all in {self.axes}")
+        if any(a not in self.axes for a in self.sharded_axes):
+            raise ValueError(f"sharded_axes {self.sharded_axes} not all in {self.axes}")
+        if set(self.gossip_axes) & set(self.sharded_axes):
+            raise ValueError("an axis cannot be both gossip and sharded")
 
     @property
     def n_ranks(self) -> int:
@@ -68,9 +83,13 @@ class Topology:
 
     @property
     def aux_axes(self) -> Tuple[str, ...]:
-        """Non-gossip axes (sequence/aux parallelism); ranks along these hold
-        identical parameters and synchronize gradients by pmean."""
-        return tuple(a for a in self.axes if a not in self.gossip_axes)
+        """Replicated non-gossip axes (sequence/aux parallelism); ranks along
+        these hold identical parameters and synchronize gradients by pmean."""
+        return tuple(
+            a
+            for a in self.axes
+            if a not in self.gossip_axes and a not in self.sharded_axes
+        )
 
     @property
     def neighbors(self) -> Tuple[NeighborSpec, ...]:

@@ -72,3 +72,49 @@ def init_train_state(
     # decorrelate per-rank PRNG streams
     keys = jax.random.split(jax.random.fold_in(root, 1), topo.n_ranks)
     return stacked.replace(rng=keys)
+
+
+def init_train_state_spmd(
+    model,
+    input_shape,
+    tx: optax.GradientTransformation,
+    topo: Topology,
+    algo: str,
+    event_cfg: Optional[EventConfig] = None,
+    seed: int = 0,
+    input_dtype=jnp.float32,
+) -> TrainState:
+    """Per-rank initialization inside the SPMD context — required when the
+    topology has `sharded_axes` (tensor/expert parallelism): sharded layers
+    fold the axis index into their own initializers (models/tp.py
+    `sharded_lecun_init`), so they need `lax.axis_index` available at init
+    time. Every rank receives the same root key; replicated parameters come
+    out identical mesh-wide, sharded kernels distinct per TP rank. Runs on
+    the vmap simulator (init is cheap); the resulting stacked state works
+    under either backend."""
+    from eventgrad_tpu.parallel.spmd import spmd
+
+    def per_rank_init(key):
+        variables = model.init(key, jnp.zeros((1,) + tuple(input_shape), input_dtype))
+        params = variables["params"]
+        event = None
+        sparse = None
+        if algo in ("eventgrad", "sp_eventgrad"):
+            event = EventState.init(params, topo, event_cfg or EventConfig())
+        if algo == "sp_eventgrad":
+            sparse = SparseState.init(params, topo)
+        return TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=variables.get("batch_stats", {}),
+            pass_num=jnp.zeros((), jnp.int32),
+            rng=key,
+            event=event,
+            sparse=sparse,
+        )
+
+    root = jax.random.PRNGKey(seed)
+    keys = jnp.broadcast_to(root, (topo.n_ranks,) + root.shape)
+    state = spmd(per_rank_init, topo)(keys)
+    rngs = jax.random.split(jax.random.fold_in(root, 1), topo.n_ranks)
+    return state.replace(rng=rngs)

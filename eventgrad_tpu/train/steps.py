@@ -125,6 +125,25 @@ def make_train_step(
             if has_bn:
                 new_stats = lax.pmean(new_stats, aux)
 
+        # tensor/expert-parallel axes: each rank owns distinct shards of the
+        # parameters named with the `tp_` prefix (models/tp.py convention).
+        # JAX's psum transpose under both vmap and shard_map(check_vma=False)
+        # scales every cotangent by the axis size (transpose(psum) == psum of
+        # replicated cotangents), so: sharded leaves divide by N (their
+        # per-rank grad is already the right shard), replicated leaves pmean
+        # (sum of per-rank path contributions / N) — verified against an
+        # unsharded twin in tests/test_tensor_parallel.py.
+        for ax in topo.sharded_axes:
+            n_ax = topo.axis_size(ax)
+
+            def fix(path, g, _ax=ax, _n=n_ax):
+                sharded = any(
+                    getattr(p, "key", "").startswith("tp_") for p in path
+                )
+                return g / _n if sharded else lax.pmean(g, _ax)
+
+            grads = jax.tree_util.tree_map_with_path(fix, grads)
+
         params = state.params
         event_state = state.event
         sparse_state = state.sparse

@@ -1,0 +1,180 @@
+"""Tensor parallelism: TP-sharded Transformer == unsharded twin, exactly.
+
+The strongest TP correctness check available without hardware: build the
+tp_size=1 model, slice its weights into TP shards, and demand (a) identical
+logits and (b) identical one-SGD-step weight updates (slice-for-slice)
+between the TP=2 mesh run and the single-rank run. (b) exercises the psum
+transpose rule through the whole backward pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.models.tp import TPTransformerLM
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
+from eventgrad_tpu.parallel.topology import Ring, Topology
+from eventgrad_tpu.train.state import TrainState, init_train_state_spmd
+from eventgrad_tpu.train.steps import make_train_step
+
+VOCAB, DIM, HEADS, LAYERS, T = 32, 32, 4, 2, 16
+TP = 2
+
+
+def _slice_params(full, tp_rank):
+    """Slice the tp_size=1 params into the shard tp_rank would own.
+
+    The qkv projection (ColParallelDense_0) is the fused [q|k|v] kernel:
+    rank r owns head block r of EACH of q, k, v, so its shard slices each
+    third separately; the MLP ColParallelDense_1 is structureless and
+    slices contiguously."""
+
+    def walk(path, leaf):
+        name = "/".join(str(p.key) for p in path)
+        if "ColParallelDense_0" in name and name.endswith("tp_kernel"):
+            thirds = jnp.split(leaf, 3, axis=1)
+            local = thirds[0].shape[1] // TP
+            return jnp.concatenate(
+                [t[:, tp_rank * local : (tp_rank + 1) * local] for t in thirds], axis=1
+            )
+        if "ColParallelDense" in name and name.endswith("tp_kernel"):
+            local = leaf.shape[1] // TP
+            return leaf[:, tp_rank * local : (tp_rank + 1) * local]
+        if "RowParallelDense" in name and name.endswith("tp_kernel"):
+            local = leaf.shape[0] // TP
+            return leaf[tp_rank * local : (tp_rank + 1) * local, :]
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(walk, full)
+
+
+def _models():
+    full = TPTransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                           max_len=T, tp_size=1)
+    tp = TPTransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                         max_len=T, axis="tp", tp_size=TP)
+    return full, tp
+
+
+def _qkv_note():
+    """ColParallelDense for qkv concatenates [q|k|v] per shard: slicing the
+    full kernel's columns per rank keeps each rank's q,k,v for its local
+    heads IFF the full model's reshape order groups heads contiguously.
+    The models reshape to (b, t, 3*h_local, d) per rank, so a column slice
+    of the fused qkv kernel is NOT the per-head slice — to sidestep this,
+    the equivalence test compares the tp run against a full run whose qkv
+    kernel was built by re-concatenating the tp shards, which is always
+    consistent."""
+
+
+def test_tp_forward_and_step_match_unsharded():
+    topo = Topology(axes=("tp",), shape=(TP,), sharded_axes=("tp",))
+    assert topo.neighbors == ()  # sharded axis never gossips
+    full_model, tp_model = _models()
+
+    tx = optax.sgd(0.1)
+    state = init_train_state_spmd(
+        tp_model, (T,), tx, topo, "dpsgd", input_dtype=jnp.int32
+    )
+
+    # build the unsharded twin by concatenating the TP shards
+    def merge(path, *leaves):
+        name = "/".join(str(p.key) for p in path)
+        if "ColParallelDense_0" in name and name.endswith("tp_kernel"):
+            # per-rank [q_r|k_r|v_r] -> full [q_all|k_all|v_all]
+            thirds = [jnp.split(l, 3, axis=1) for l in leaves]
+            return jnp.concatenate(
+                [jnp.concatenate([t[i] for t in thirds], axis=1) for i in range(3)],
+                axis=1,
+            )
+        if "ColParallelDense" in name and name.endswith("tp_kernel"):
+            return jnp.concatenate(leaves, axis=1)
+        if "RowParallelDense" in name and name.endswith("tp_kernel"):
+            return jnp.concatenate(leaves, axis=0)
+        for l in leaves[1:]:
+            np.testing.assert_allclose(np.asarray(leaves[0]), np.asarray(l), atol=1e-7)
+        return leaves[0]
+
+    shards = [jax.tree.map(lambda p: p[r], state.params) for r in range(TP)]
+    full_params = jax.tree_util.tree_map_with_path(merge, *shards)
+
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, T), 0, VOCAB)
+    tgts = jnp.roll(toks, -1, axis=-1)
+
+    # (a) forward parity
+    tp_logits = spmd(
+        lambda p, t: tp_model.apply({"params": p}, t), topo
+    )(state.params, jnp.broadcast_to(toks, (TP,) + toks.shape))
+    full_logits = full_model.apply({"params": full_params}, toks)
+    np.testing.assert_allclose(
+        np.asarray(tp_logits[0]), np.asarray(full_logits), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp_logits[0]), np.asarray(tp_logits[1]), atol=1e-6
+    )
+
+    # (b) one-SGD-step parity, slice for slice (psum transpose correctness)
+    step = make_train_step(tp_model, tx, topo, "dpsgd")
+    lifted = jax.jit(spmd(step, topo))
+    xb = jnp.broadcast_to(toks, (TP,) + toks.shape)
+    yb = jnp.broadcast_to(tgts, (TP,) + tgts.shape)
+    new_state, m = lifted(state, (xb, yb))
+
+    def full_loss(p):
+        out = full_model.apply({"params": p}, toks)
+        logp = jax.nn.log_softmax(out)
+        return -jnp.mean(jnp.take_along_axis(logp, tgts[..., None], -1))
+
+    g = jax.grad(full_loss)(full_params)
+    full_new = jax.tree.map(lambda p, g: p - 0.1 * g, full_params, g)
+
+    for r in range(TP):
+        expect = _slice_params(full_new, r)
+        got = jax.tree.map(lambda p: p[r], new_state.params)
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(expect),
+            jax.tree_util.tree_leaves_with_path(got),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5,
+                err_msg=f"rank {r}: {jax.tree_util.keystr(pa)}",
+            )
+
+
+def test_dp_gossip_times_tp():
+    """EventGraD across dp while blocks are TP-sharded: 4x2 mesh."""
+    from eventgrad_tpu.parallel.events import EventConfig
+
+    topo = Topology(
+        axes=("dp", "tp"), shape=(4, TP), gossip_axes=("dp",), sharded_axes=("tp",)
+    )
+    assert len(topo.neighbors) == 2 and topo.aux_axes == ()
+    tp_model = TPTransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                               max_len=T, axis="tp", tp_size=TP)
+    tx = optax.sgd(0.1)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    state = init_train_state_spmd(
+        tp_model, (T,), tx, topo, "eventgrad", cfg, input_dtype=jnp.int32
+    )
+    step = make_train_step(tp_model, tx, topo, "eventgrad", event_cfg=cfg)
+    lifted = jax.jit(spmd(step, topo))
+
+    key = jax.random.PRNGKey(9)
+    toks = jax.random.randint(key, (4, 2, T), 0, VOCAB)  # per-dp batches
+    xb = jnp.repeat(toks, TP, axis=0).reshape(8, 2, T)  # replicate over tp
+    yb = jnp.roll(xb, -1, axis=-1)
+
+    losses = []
+    for _ in range(6):
+        state, m = lifted(state, (xb, yb))
+        losses.append(float(np.asarray(m["loss"]).mean()))
+    assert losses[-1] < losses[0]
+    assert int(np.asarray(state.event.num_events).sum()) > 0
+
+    # tp shards of a dp rank must stay consistent: replicated leaves equal
+    emb = state.params["Embed_0"]["embedding"].reshape(4, TP, VOCAB, DIM)
+    np.testing.assert_allclose(
+        np.asarray(emb[:, 0]), np.asarray(emb[:, 1]), atol=1e-5
+    )
