@@ -99,18 +99,20 @@ def make_train_step(
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
-                out, updated = model.apply(
-                    variables,
-                    x,
-                    train=True,
-                    rngs={"dropout": k_drop},
-                    mutable=["batch_stats"],
-                )
-                new_stats = updated["batch_stats"]
-            else:
-                out = model.apply(variables, x, train=True, rngs={"dropout": k_drop})
-                new_stats = state.batch_stats
-            return _xent(out, y), (out, new_stats)
+            # "losses" collects auxiliary objectives sown by the model (e.g.
+            # the MoE load-balancing loss, models/moe.py); empty otherwise.
+            out, updated = model.apply(
+                variables,
+                x,
+                train=True,
+                rngs={"dropout": k_drop},
+                mutable=["batch_stats", "losses"],
+            )
+            new_stats = updated["batch_stats"] if has_bn else state.batch_stats
+            loss = _xent(out, y)
+            for leaf in jax.tree.leaves(updated.get("losses", {})):
+                loss = loss + jnp.sum(leaf)
+            return loss, (out, new_stats)
 
         (loss, (out, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
