@@ -1,4 +1,8 @@
 from eventgrad_tpu.models.mlp import MLP
+from eventgrad_tpu.models.moe import MoETransformerLM
+from eventgrad_tpu.models.pp import PPTransformerLM
+from eventgrad_tpu.models.tp import TPTransformerLM
+from eventgrad_tpu.models.transformer import TransformerLM
 from eventgrad_tpu.models.cnn import CNN1, CNN2, LeNetCifar
 from eventgrad_tpu.models.resnet import (
     ResNet,

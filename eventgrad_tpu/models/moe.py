@@ -45,7 +45,8 @@ def _dispatch_combine(probs, n_select: int, capacity: int, dtype):
     """Dense dispatch/combine tensors from router probabilities.
 
     probs: [S, E] softmax router output. Returns (dispatch [S,E,C] in {0,1},
-    combine [S,E,C] floats). Selection is top-`n_select` per token with
+    combine [S,E,C] floats, routed [S,E] pre-capacity assignment counts for
+    the load-balancing loss). Selection is top-`n_select` per token with
     gate weights renormalized over the selected experts; capacity is
     granted in selection-priority order (all first choices before any
     second choices), each expert keeping its first `capacity` takers in

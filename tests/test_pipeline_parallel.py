@@ -1,0 +1,172 @@
+"""Pipeline parallelism: PP-staged Transformer == sequential twin, exactly.
+
+Strategy mirrors test_tensor_parallel.py: run the pp_size=S model on an
+S-rank mesh, reassemble its stage params into a pp_size=1 sequential twin
+(stage-major: pp rank r's local layer i is global layer r*L+i), and demand
+(a) identical logits on every rank and (b) identical one-SGD-step updates —
+(b) exercises AD through the gpipe scan+ppermute schedule and the
+masked-psum loss broadcast's cotangent scaling (sharded-leaf /N rule).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from eventgrad_tpu.models.pp import PPTransformerLM, gpipe
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring, Topology
+from eventgrad_tpu.train.state import init_train_state_spmd
+from eventgrad_tpu.train.steps import make_train_step
+
+VOCAB, DIM, HEADS, T = 32, 32, 4, 16
+PP = 4
+LAYERS = 4  # one block per stage
+MICRO = 2
+BATCH = 4
+
+
+def _models():
+    pp = PPTransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                         max_len=T, axis="pp", pp_size=PP, n_micro=MICRO)
+    seq = PPTransformerLM(vocab=VOCAB, dim=DIM, n_heads=HEADS, n_layers=LAYERS,
+                          max_len=T, pp_size=1)
+    return pp, seq
+
+
+def _assemble_twin(stacked):
+    """Stacked pp params [S, ...] -> sequential twin params: stage r's
+    tp_l{i}_* leaf becomes the twin's tp_l{r*L+i}_*; replicated leaves take
+    rank 0 after asserting mesh-wide equality."""
+    layers_local = LAYERS // PP
+    twin = {}
+    for name, leaf in stacked.items():
+        if name.startswith("tp_l"):
+            i, _, suffix = name[4:].partition("_")
+            for r in range(PP):
+                twin[f"tp_l{r * layers_local + int(i)}_{suffix}"] = leaf[r]
+        else:
+            sub = jax.tree.map(lambda x: x[0], leaf)
+            for r in range(1, PP):
+                jax.tree.map(
+                    lambda a, b: np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b[r]), atol=1e-7
+                    ),
+                    sub, leaf,
+                )
+            twin[name] = sub
+    return twin
+
+
+def _slice_stage(twin, r):
+    """Inverse of _assemble_twin for one pp rank."""
+    layers_local = LAYERS // PP
+    out = {}
+    for name, leaf in twin.items():
+        if name.startswith("tp_l"):
+            j, _, suffix = name[4:].partition("_")
+            j = int(j)
+            if j // layers_local == r:
+                out[f"tp_l{j % layers_local}_{suffix}"] = leaf
+        else:
+            out[name] = leaf
+    return out
+
+
+def test_gpipe_schedule_identity_stage():
+    """With an identity stage_fn the last stage must reproduce the feed."""
+    topo = Topology(axes=("pp",), shape=(PP,), sharded_axes=("pp",))
+    xm = jnp.arange(3 * 2 * 5, dtype=jnp.float32).reshape(1, 3, 2, 5)
+    xm = jnp.broadcast_to(xm, (PP, 3, 2, 5))
+
+    out = spmd(lambda x: gpipe(lambda h: h, x, "pp", PP), topo)(xm)
+    np.testing.assert_allclose(np.asarray(out[PP - 1]), np.asarray(xm[0]))
+
+
+def test_pp_forward_and_step_match_sequential():
+    topo = Topology(axes=("pp",), shape=(PP,), sharded_axes=("pp",))
+    assert topo.neighbors == ()  # sharded axis never gossips
+    pp_model, seq_model = _models()
+
+    tx = optax.sgd(0.1)
+    state = init_train_state_spmd(
+        pp_model, (T,), tx, topo, "dpsgd", input_dtype=jnp.int32
+    )
+    twin_params = _assemble_twin(state.params)
+
+    toks = jax.random.randint(jax.random.PRNGKey(5), (BATCH, T), 0, VOCAB)
+    tgts = jnp.roll(toks, -1, axis=-1)
+
+    # (a) forward parity: every pp rank emits the twin's logits
+    pp_logits = spmd(
+        lambda p, t: pp_model.apply({"params": p}, t), topo
+    )(state.params, jnp.broadcast_to(toks, (PP,) + toks.shape))
+    seq_logits = seq_model.apply({"params": twin_params}, toks)
+    for r in range(PP):
+        np.testing.assert_allclose(
+            np.asarray(pp_logits[r]), np.asarray(seq_logits), atol=2e-5,
+            err_msg=f"rank {r}",
+        )
+
+    # (b) one-SGD-step parity (AD through the pipeline schedule)
+    step = make_train_step(pp_model, tx, topo, "dpsgd")
+    lifted = jax.jit(spmd(step, topo))
+    xb = jnp.broadcast_to(toks, (PP,) + toks.shape)
+    yb = jnp.broadcast_to(tgts, (PP,) + tgts.shape)
+    new_state, m = lifted(state, (xb, yb))
+    assert np.ptp(np.asarray(m["loss"])) < 1e-6  # same loss on every stage
+
+    def twin_loss(p):
+        out = seq_model.apply({"params": p}, toks)
+        logp = jax.nn.log_softmax(out)
+        return -jnp.mean(jnp.take_along_axis(logp, tgts[..., None], -1))
+
+    g = jax.grad(twin_loss)(twin_params)
+    twin_new = jax.tree.map(lambda p, g: p - 0.1 * g, twin_params, g)
+
+    for r in range(PP):
+        expect = _slice_stage(twin_new, r)
+        got = jax.tree.map(lambda p: p[r], new_state.params)
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(expect),
+            jax.tree_util.tree_leaves_with_path(got),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5,
+                err_msg=f"rank {r}: {jax.tree_util.keystr(pa)}",
+            )
+
+
+def test_dp_gossip_times_pp():
+    """EventGraD across dp while blocks are pipeline-staged: 2x4 mesh."""
+    from eventgrad_tpu.parallel.events import EventConfig
+
+    topo = Topology(
+        axes=("dp", "pp"), shape=(2, PP), gossip_axes=("dp",), sharded_axes=("pp",)
+    )
+    assert len(topo.neighbors) == 2 and topo.aux_axes == ()
+    pp_model, _ = _models()
+    tx = optax.sgd(0.1)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    state = init_train_state_spmd(
+        pp_model, (T,), tx, topo, "eventgrad", cfg, input_dtype=jnp.int32
+    )
+    step = make_train_step(pp_model, tx, topo, "eventgrad", event_cfg=cfg)
+    lifted = jax.jit(spmd(step, topo))
+
+    toks = jax.random.randint(jax.random.PRNGKey(9), (2, BATCH, T), 0, VOCAB)
+    xb = jnp.repeat(toks, PP, axis=0).reshape(2 * PP, BATCH, T)  # replicate over pp
+    yb = jnp.roll(xb, -1, axis=-1)
+
+    losses = []
+    for _ in range(6):
+        state, m = lifted(state, (xb, yb))
+        losses.append(float(np.asarray(m["loss"]).mean()))
+    assert losses[-1] < losses[0]
+    assert int(np.asarray(state.event.num_events).sum()) > 0
+
+    # pp stages of a dp rank must agree on replicated leaves post-gossip
+    emb = state.params["Embed_0"]["embedding"].reshape(2, PP, VOCAB, DIM)
+    np.testing.assert_allclose(
+        np.asarray(emb[:, 0]), np.asarray(emb[:, 1]), atol=1e-5
+    )
