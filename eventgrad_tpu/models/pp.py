@@ -131,10 +131,20 @@ class PPTransformerLM(nn.Module):
             raise ValueError(
                 f"n_layers {self.n_layers} not divisible by pp_size {self.pp_size}"
             )
+        if self.n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1, got {self.n_micro}")
         b, t = tokens.shape
-        # GPipe output is microbatch-count invariant; indivisible batches
-        # (e.g. the batch-1 init trace) just run unsplit.
-        n_micro = self.n_micro if b % self.n_micro == 0 else 1
+        # GPipe output is microbatch-count invariant, so the batch-1 init
+        # trace may run unsplit; any other indivisible batch is a config
+        # error (silently unsplitting would defeat the memory schedule)
+        if b == 1:
+            n_micro = 1
+        elif b % self.n_micro == 0:
+            n_micro = self.n_micro
+        else:
+            raise ValueError(
+                f"batch {b} not divisible by n_micro {self.n_micro}"
+            )
         layers_local = self.n_layers // self.pp_size
         sharded = self.pp_size > 1
         kinit = sharded_lecun_init(self.axis) if sharded else nn.initializers.lecun_normal()

@@ -1,9 +1,11 @@
 """Decoder-only Transformer LM with pluggable sequence parallelism.
 
 Beyond reference parity (the reference trains only image CNNs): the
-framework's long-context story. Attention runs in one of three modes:
+framework's long-context story. Attention runs in one of four modes:
 
-  * "full"    — single-rank exact attention.
+  * "full"    — single-rank exact attention (materialized scores).
+  * "flash"   — single-rank fused Pallas FlashAttention kernel (VMEM-
+                streamed scores, custom fwd+bwd; ops/attention.py).
   * "ring"    — ring attention over a named SP mesh axis: KV blocks rotate
                 around the ICI ring, O(T/N) memory per chip.
   * "ulysses" — all-to-all head-sharded attention over the SP axis.
@@ -53,8 +55,14 @@ class Block(nn.Module):
             o = ring_attention(q, k, v, self.topo, axis=self.sp_axis, causal=True)
         elif self.attn == "ulysses":
             o = ulysses_attention(q, k, v, self.topo, axis=self.sp_axis, causal=True)
-        else:
+        elif self.attn == "flash":
+            from eventgrad_tpu.ops.attention import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
+        elif self.attn == "full":
             o = full_attention(q, k, v, causal=True)
+        else:
+            raise ValueError(f"unknown attn mode {self.attn!r}")
         x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(
             o.reshape(b, t, self.dim)
         )
@@ -71,7 +79,7 @@ class TransformerLM(nn.Module):
     n_heads: int = 8
     n_layers: int = 2
     max_len: int = 1024  # GLOBAL sequence length budget
-    attn: str = "full"  # "full" | "ring" | "ulysses"
+    attn: str = "full"  # "full" | "flash" | "ring" | "ulysses"
     topo: Optional[Topology] = None
     sp_axis: Optional[str] = None
     dtype: Any = jnp.float32
