@@ -79,7 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-bn", action="store_true")
     p.add_argument("--seed", type=int, default=0)             # torch::manual_seed(0)
     p.add_argument("--log-file", default=None, help="JSONL metrics path")
+    p.add_argument("--trace-file", default=None,
+                   help="per-pass per-param send-trace JSONL (the reference's "
+                        "file_write=1 send{r}.txt, event.cpp:337-391)")
     p.add_argument("--n-synth", type=int, default=4096)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="snapshot the full gossip TrainState here")
+    p.add_argument("--save-every", type=int, default=0,
+                   help="checkpoint every N epochs (0 = final epoch only)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest snapshot from --checkpoint-dir")
     return p
 
 
@@ -118,6 +127,8 @@ def main(argv=None) -> int:
         event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
         augment=args.augment, random_sampler=args.random_sampler,
         sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
+        checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
+        resume=args.resume, trace_file=args.trace_file,
     )
     for rec in history:
         logger.log(rec)

@@ -4,7 +4,9 @@ Mirrors the reference's shared skeleton (epoch loop -> batch loop -> comm ->
 step -> accuracy, e.g. /root/reference/dmnist/event/event.cpp:269-500) but
 compiles the *entire epoch* as one `lax.scan` over steps, so the TPU runs
 back-to-back fused steps with no host round-trips; per-epoch metrics come
-back as stacked arrays.
+back as stacked arrays. Host batch assembly for epoch E+1 overlaps epoch
+E's device compute via `data.prefetch.EpochPrefetcher` (native shard-plan
++ memcpy gathers on a background thread).
 
 End-of-training consensus: the reference allreduce-averages parameters and
 lets rank 0 evaluate (event.cpp:517-525). Here `consensus_params` means over
@@ -13,6 +15,8 @@ the stacked rank axis — numerically the same reduction.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -21,20 +25,53 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import spmd
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.train.state import init_train_state
 from eventgrad_tpu.train.steps import make_train_step
-from eventgrad_tpu.utils import trees
+from eventgrad_tpu.utils import checkpoint, trees
 from eventgrad_tpu.utils.metrics import msgs_saved_pct
 
 
 def consensus_params(stacked_params: Any) -> Any:
     """Average the per-rank models into the final consensus model."""
     return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked_params)
+
+
+def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
+                 n_ranks: int, state) -> None:
+    """Append the reference's send{r}.txt instrumentation as JSONL: one
+    record per (pass, rank) with per-parameter norm/thres/fired vectors in
+    leaf-major order (event.cpp:337-339,385-391). A header record names the
+    parameter leaves the first time the file is written."""
+    first = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a") as tf:
+        if first:
+            names = [
+                "/".join(str(getattr(p, "key", p)) for p in kp)
+                for kp, _ in jax.tree_util.tree_flatten_with_path(
+                    jax.tree.map(lambda x: x[0], state.params)
+                )[0]
+            ]
+            tf.write(json.dumps({"trace_params": names}) + "\n")
+        steps = m["trace_fired"].shape[0]
+        for s_i in range(steps):
+            for r in range(n_ranks):
+                tf.write(
+                    json.dumps(
+                        {
+                            "pass": pass_base + s_i + 1,
+                            "rank": r,
+                            "norm": [round(float(v), 6) for v in m["trace_norm"][s_i, r]],
+                            "thres": [round(float(v), 6) for v in m["trace_thres"][s_i, r]],
+                            "fired": [int(v) for v in m["trace_fired"][s_i, r]],
+                        }
+                    )
+                    + "\n"
+                )
 
 
 def evaluate(model, params, batch_stats, x, y, batch_size: int = 1000) -> Dict[str, float]:
@@ -81,16 +118,38 @@ def train(
     x_test: Optional[np.ndarray] = None,
     y_test: Optional[np.ndarray] = None,
     log_every_epoch: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    save_every: int = 0,
+    resume: bool = False,
+    trace_file: Optional[str] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
-    """Run the full training job; returns (final_state, per-epoch history)."""
+    """Run the full training job; returns (final_state, per-epoch history).
+
+    With `checkpoint_dir`, the full gossip TrainState (+ epoch counter) is
+    snapshotted every `save_every` epochs (always at the end); `resume=True`
+    restores the latest snapshot and continues from its epoch — the elastic
+    story the reference lacks entirely (a dead MPI rank just hangs it,
+    decent.cpp:200-205).
+    """
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
     state = init_train_state(
         model, x_train.shape[1:], tx, topo, algo, event_cfg, seed=seed
     )
+
+    ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+    start_epoch = 0
+    if ckpt_path and resume:
+        found = checkpoint.latest(ckpt_path)
+        if found:
+            restored = checkpoint.restore(
+                found, {"state": state, "epoch": np.int64(0)}
+            )
+            state = restored["state"]
+            start_epoch = int(restored["epoch"])
     step = make_train_step(
         model, tx, topo, algo,
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
-        sync_bn=sync_bn,
+        sync_bn=sync_bn, trace=trace_file is not None,
     )
     lifted = spmd(step, topo, mesh=mesh)
 
@@ -109,44 +168,54 @@ def train(
     sz = trees.tree_num_leaves(state.params)
     history: List[Dict[str, Any]] = []
 
-    for epoch in range(1, epochs + 1):
-        xb, yb = batched_epoch(
-            x_train, y_train, topo.n_ranks, batch_size,
-            random=random_sampler, seed=seed, epoch=epoch,
-        )
-        steps = xb.shape[1]
-        t0 = time.perf_counter()
-        state, m = run_epoch(state, jnp.asarray(xb), jnp.asarray(yb))
-        jax.block_until_ready(state.params)
-        dt = time.perf_counter() - t0
+    prefetcher = EpochPrefetcher(
+        x_train, y_train, topo.n_ranks, batch_size,
+        random=random_sampler, seed=seed, last_epoch=epochs,
+    )
+    try:
+        for epoch in range(start_epoch + 1, epochs + 1):
+            xb, yb = prefetcher.get(epoch)
+            steps = xb.shape[1]
+            t0 = time.perf_counter()
+            state, m = run_epoch(state, jnp.asarray(xb), jnp.asarray(yb))
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
 
-        # metrics are [steps, n_ranks]
-        m = jax.tree.map(np.asarray, m)
-        total_passes = int(state.pass_num.reshape(-1)[0])
-        rec = {
-            "epoch": epoch,
-            "algo": algo,
-            "steps": steps,
-            "wall_s": dt,
-            "loss": float(m["loss"].mean()),
-            "train_acc": 100.0 * float(m["correct"].sum()) / (topo.n_ranks * steps * batch_size),
-            "sent_bytes_per_step_per_chip": float(m["sent_bytes"][..., 0].mean()),
-            "n_params": n_params,
-        }
-        if algo in ("eventgrad", "sp_eventgrad"):
-            # msgs-saved vs D-PSGD: events/(n_neighbors * passes * sz) fired
-            events_total = int(m["num_events"][-1].sum())
-            rec["num_events"] = events_total
-            rec["msgs_saved_pct"] = msgs_saved_pct(
-                events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
-            )
-            rec["fired_frac"] = float(m["fired_frac"].mean())
-        if x_test is not None and log_every_epoch:
-            cons = consensus_params(state.params)
-            stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
-            rec.update(
-                {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
-            )
-        history.append(rec)
+            # metrics are [steps, n_ranks]
+            m = jax.tree.map(np.asarray, m)
+            total_passes = int(state.pass_num.reshape(-1)[0])
+            rec = {
+                "epoch": epoch,
+                "algo": algo,
+                "steps": steps,
+                "wall_s": dt,
+                "loss": float(m["loss"].mean()),
+                "train_acc": 100.0 * float(m["correct"].sum()) / (topo.n_ranks * steps * batch_size),
+                "sent_bytes_per_step_per_chip": float(m["sent_bytes"][..., 0].mean()),
+                "n_params": n_params,
+            }
+            if algo in ("eventgrad", "sp_eventgrad"):
+                # msgs-saved vs D-PSGD: events/(n_neighbors * passes * sz) fired
+                events_total = int(m["num_events"][-1].sum())
+                rec["num_events"] = events_total
+                rec["msgs_saved_pct"] = msgs_saved_pct(
+                    events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
+                )
+                rec["fired_frac"] = float(m["fired_frac"].mean())
+            if trace_file and "trace_fired" in m:
+                _write_trace(trace_file, m, total_passes - steps, topo.n_ranks, state)
+            if x_test is not None and log_every_epoch:
+                cons = consensus_params(state.params)
+                stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+                rec.update(
+                    {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
+                )
+            history.append(rec)
+            if ckpt_path and (
+                epoch == epochs or (save_every and epoch % save_every == 0)
+            ):
+                checkpoint.save(ckpt_path, {"state": state, "epoch": np.int64(epoch)})
+    finally:
+        prefetcher.close()
 
     return state, history

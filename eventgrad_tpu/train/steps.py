@@ -69,6 +69,7 @@ def make_train_step(
     augment: bool = False,
     sync_bn: bool = False,
     fused_sgd: Optional[Tuple[float, float]] = None,
+    trace: bool = False,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -77,6 +78,11 @@ def make_train_step(
     one HBM read/write per parameter element. The values MUST match the
     `tx` the state was initialized with (plain SGD, optional trace
     momentum); interpret mode is selected automatically off-TPU.
+
+    trace=True (event algorithms only) adds per-parameter send-side trace
+    vectors to the metrics — current norm, threshold, fired bit, leaf-major
+    order — the reference's `file_write=1` send{r}.txt instrumentation
+    (event.cpp:337-339,385-391).
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
@@ -198,11 +204,11 @@ def make_train_step(
             for buf in bufs:
                 buf_sum = jax.tree.map(jnp.add, buf_sum, buf)
             if mom_f:
-                trace = state.opt_state[0].trace
+                mom_trace = state.opt_state[0].trace
             else:
-                trace = trees.tree_zeros_like(params)
+                mom_trace = trees.tree_zeros_like(params)
             params, new_trace = fused_mix_sgd(
-                params, buf_sum, grads, trace,
+                params, buf_sum, grads, mom_trace,
                 lr_f, mom_f, topo.mix_weight, interpret=fused_interpret,
             )
             if mom_f:
@@ -239,6 +245,16 @@ def make_train_step(
                 event_state.num_events if event_state is not None else jnp.int32(0)
             ),
         }
+        if trace and algo in ("eventgrad", "sp_eventgrad"):
+            # send{r}.txt columns: norm of the (pre-mix) param at the event
+            # check, the post-decay/post-fire threshold, and the fire bit
+            metrics["trace_norm"] = jnp.stack(
+                jax.tree.leaves(trees.tree_norm(state.params))
+            )
+            metrics["trace_thres"] = jnp.stack(jax.tree.leaves(event_state.thres))
+            metrics["trace_fired"] = jnp.stack(
+                [f.astype(jnp.float32) for f in jax.tree.leaves(fire)]
+            )
         return new_state, metrics
 
     return step

@@ -8,16 +8,40 @@ so an interrupted decentralized run resumes with its exact gossip state.
 from __future__ import annotations
 
 import os
-from typing import Any
+import shutil
+from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 
 def save(path: str, state: Any) -> None:
+    """Crash-safe snapshot: write to `<path>.tmp`, swap the old snapshot to
+    `<path>.prev`, promote tmp, drop prev. A kill at any point leaves either
+    `<path>` or `<path>.prev` complete — `latest()` finds whichever survived."""
     path = os.path.abspath(path)
+    tmp, prev = path + ".tmp", path + ".prev"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
+        ckptr.save(tmp, state, force=True)
+    if os.path.exists(prev):
+        shutil.rmtree(prev)
+    if os.path.exists(path):
+        os.rename(path, prev)
+    os.rename(tmp, path)
+    if os.path.exists(prev):
+        shutil.rmtree(prev)
+
+
+def latest(path: str) -> Optional[str]:
+    """The newest complete snapshot for `path` (the primary, or the .prev
+    left by a save interrupted mid-swap); None if neither exists."""
+    path = os.path.abspath(path)
+    for cand in (path, path + ".prev"):
+        if os.path.exists(cand):
+            return cand
+    return None
 
 
 def restore(path: str, template: Any) -> Any:

@@ -1,0 +1,117 @@
+"""Randomized golden-trace cross-check of the EventGraD state machine.
+
+`_oracle` re-implements the reference's sender-side semantics
+(/root/reference/dmnist/event/event.cpp:324-391) the way the C++ does it —
+an imperative per-parameter scalar loop over passes — written independently
+of parallel/events.py's fused pytree version. Driving both with hundreds of
+random norm trajectories and asserting identical fire decisions, thresholds,
+slope buffers, and event counters is the property-test equivalent of
+replaying the reference's send{r}.txt traces (SURVEY §4 test pyramid, item 4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgrad_tpu.parallel.events import EventConfig, EventState
+from eventgrad_tpu.parallel.events import decide_and_update
+from eventgrad_tpu.parallel.topology import Ring
+
+
+class _Oracle:
+    """Scalar-loop twin of event.cpp's state arrays (:181-225)."""
+
+    def __init__(self, n_params, cfg, n_neighbors):
+        self.cfg = cfg
+        self.nb = n_neighbors
+        self.thres = np.zeros(n_params)
+        self.last_sent_norm = np.zeros(n_params)
+        self.last_sent_iter = np.zeros(n_params)
+        self.slopes = np.zeros((n_params, cfg.history))
+        self.num_events = 0
+
+    def step(self, norms, pass_num):
+        fires = []
+        for i, norm in enumerate(norms):
+            value_diff = abs(norm - self.last_sent_norm[i])
+            if self.cfg.adaptive:  # decay BEFORE the check (event.cpp:330-332)
+                self.thres[i] *= self.cfg.horizon
+            else:  # constant mode re-assigns every pass (:332-334)
+                self.thres[i] = self.cfg.constant
+            fire = value_diff >= self.thres[i] or pass_num < self.cfg.warmup_passes
+            if fire:
+                iter_diff = pass_num - self.last_sent_iter[i]
+                self.slopes[i] = np.append(self.slopes[i][1:], value_diff / iter_diff)
+                if self.cfg.adaptive:  # thres = mean slope (:363-378)
+                    self.thres[i] = self.slopes[i].mean()
+                self.last_sent_norm[i] = norm
+                self.last_sent_iter[i] = pass_num
+                self.num_events += self.nb  # += 2 on a ring (:344)
+            fires.append(fire)
+        return fires
+
+
+def _run_pair(cfg, n_passes=120, n_params=6, seed=0):
+    topo = Ring(4)
+    rng = np.random.default_rng(seed)
+    # random-walk positive norms, occasionally flat (drift can be ~0)
+    steps = rng.normal(0, 0.05, (n_passes, n_params)) * (
+        rng.random((n_passes, n_params)) > 0.25
+    )
+    norms = np.abs(2.0 + np.cumsum(steps, axis=0))
+
+    # params chosen as single-element arrays whose L2 norm IS the trajectory
+    params = {f"p{i}": jnp.zeros((1,)) for i in range(n_params)}
+    state = EventState.init(params, topo, cfg)
+    oracle = _Oracle(n_params, cfg, topo.n_neighbors)
+
+    step = jax.jit(
+        lambda p, s, t: decide_and_update(p, s, t, cfg, topo.n_neighbors),
+        static_argnames=(),
+    )
+    for t in range(1, n_passes + 1):  # pass_num is 1-based (event.cpp:273)
+        p = {f"p{i}": jnp.array([norms[t - 1, i]], jnp.float32) for i in range(n_params)}
+        fire, state = step(p, state, jnp.array(t))
+        fire_o = oracle.step(norms[t - 1].astype(np.float32), t)
+        got = [bool(fire[f"p{i}"]) for i in range(n_params)]
+        assert got == fire_o, f"fire mismatch at pass {t}: {got} vs {fire_o}"
+    return state, oracle
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_adaptive_matches_oracle(seed):
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=8, history=2)
+    state, oracle = _run_pair(cfg, seed=seed)
+    for i in range(6):
+        k = f"p{i}"
+        np.testing.assert_allclose(float(state.thres[k]), oracle.thres[i], rtol=1e-5)
+        np.testing.assert_allclose(
+            float(state.last_sent_norm[k]), oracle.last_sent_norm[i], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(state.last_sent_iter[k]), oracle.last_sent_iter[i]
+        )
+        np.testing.assert_allclose(
+            np.asarray(state.slopes[k]), oracle.slopes[i], rtol=1e-5
+        )
+    assert int(state.num_events) == oracle.num_events
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_constant_mode_matches_oracle(seed):
+    cfg = EventConfig(adaptive=False, constant=0.08, warmup_passes=5)
+    state, oracle = _run_pair(cfg, seed=seed)
+    assert int(state.num_events) == oracle.num_events
+    for i in range(6):
+        np.testing.assert_allclose(
+            float(state.last_sent_norm[f"p{i}"]), oracle.last_sent_norm[i], rtol=1e-6
+        )
+
+
+def test_zero_constant_always_fires():
+    """threshold 0 == exact D-PSGD (dmnist/event/README.md:59-60)."""
+    cfg = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
+    state, oracle = _run_pair(cfg, n_passes=40)
+    # every pass, every param, both neighbors
+    assert int(state.num_events) == 40 * 6 * 2 == oracle.num_events

@@ -1,0 +1,40 @@
+"""Send-side trace instrumentation (the reference's file_write=1 send{r}.txt)."""
+
+import json
+
+import numpy as np
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+
+
+def test_trace_file_records_send_decisions(tmp_path):
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=1)
+    path = tmp_path / "send.jsonl"
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    state, hist = train(
+        MLP(), Ring(4), x, y,
+        algo="eventgrad", epochs=2, batch_size=8, learning_rate=0.05,
+        event_cfg=cfg, seed=0, trace_file=str(path),
+    )
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    header, recs = lines[0], lines[1:]
+
+    assert len(header["trace_params"]) == 4  # MLP: 2 kernels + 2 biases
+    steps_per_epoch = hist[0]["steps"]
+    total = 2 * steps_per_epoch * 4  # passes x ranks
+    assert len(recs) == total
+    assert {r["rank"] for r in recs} == {0, 1, 2, 3}
+    assert max(r["pass"] for r in recs) == 2 * steps_per_epoch
+
+    for r in recs:
+        assert len(r["norm"]) == len(r["thres"]) == len(r["fired"]) == 4
+        if r["pass"] <= 1:  # warmup: pass_num < warmup_passes always fires
+            assert all(f == 1 for f in r["fired"])
+
+    # fired counts must reconcile with the num_events counter (x2 neighbors)
+    fired_total = sum(sum(r["fired"]) for r in recs)
+    assert 2 * fired_total == int(np.asarray(state.event.num_events).sum())
