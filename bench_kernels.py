@@ -1,0 +1,83 @@
+"""Kernel microbenchmarks (not part of the driver contract — run by hand).
+
+Times the Pallas kernels against their XLA/jnp twins on the active device:
+
+  * flash attention fwd and fwd+bwd vs materialized-score attention, over
+    a sweep of sequence lengths;
+  * the fused gossip-mix + momentum-SGD update vs the unfused tree-map
+    chain, at the flagship ResNet parameter count.
+
+Prints one JSON line per measurement: {"kernel", "config", "pallas_ms",
+"xla_ms", "speedup"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _time(fn, *args, iters=20):
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return 1000 * (time.perf_counter() - t0) / iters
+
+
+def bench_attention():
+    from eventgrad_tpu.ops import flash_attention, flash_attention_reference
+
+    b, h, d = 4, 8, 64
+    for t in (512, 1024, 2048, 4096):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d), jnp.bfloat16)
+            for i in range(3)
+        )
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+        ref = jax.jit(lambda q, k, v: flash_attention_reference(q, k, v, True))
+        ms_f, ms_r = _time(flash, q, k, v), _time(ref, q, k, v)
+        print(json.dumps({
+            "kernel": "flash_attention_fwd", "config": f"B{b}xT{t}xH{h}xD{d}",
+            "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
+            "speedup": round(ms_r / ms_f, 2),
+        }))
+
+        lossf = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32) ** 2)))
+        lossr = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention_reference(q, k, v, True).astype(jnp.float32) ** 2)))
+        ms_f, ms_r = _time(lossf, q), _time(lossr, q)
+        print(json.dumps({
+            "kernel": "flash_attention_fwd_bwd", "config": f"B{b}xT{t}xH{h}xD{d}",
+            "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
+            "speedup": round(ms_r / ms_f, 2),
+        }))
+
+
+def bench_fused_update():
+    from eventgrad_tpu.ops import fused_mix_sgd, mix_sgd_reference
+
+    n = 17_400_000  # flagship ResNet parameter count
+    key = jax.random.PRNGKey(1)
+    p, b_, g, t = (
+        {"w": jax.random.normal(jax.random.fold_in(key, i), (n,))} for i in range(4)
+    )
+    fused = jax.jit(lambda p, b, g, t: fused_mix_sgd(p, b, g, t, 0.01, 0.9, 1 / 3))
+    ref = jax.jit(lambda p, b, g, t: mix_sgd_reference(p, b, g, t, 0.01, 0.9, 1 / 3))
+    ms_f, ms_r = _time(fused, p, b_, g, t), _time(ref, p, b_, g, t)
+    print(json.dumps({
+        "kernel": "fused_mix_sgd", "config": f"{n/1e6:.1f}M params",
+        "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
+        "speedup": round(ms_r / ms_f, 2),
+    }))
+
+
+if __name__ == "__main__":
+    print(json.dumps({"platform": jax.devices()[0].platform}))
+    bench_attention()
+    bench_fused_update()

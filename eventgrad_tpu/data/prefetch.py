@@ -21,7 +21,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from eventgrad_tpu.data import native
-from eventgrad_tpu.data.sharding import shard_random, shard_sequential
+from eventgrad_tpu.data.sharding import epoch_index_plan
 
 
 class EpochPrefetcher:
@@ -51,23 +51,14 @@ class EpochPrefetcher:
         self.random = random
         self.seed = seed
         self.last_epoch = last_epoch  # no speculative assembly past this
-        per = len(x) // n_ranks
-        self.steps = per // batch_size
-        if self.steps == 0:
-            raise ValueError(
-                f"batch_size {batch_size} larger than per-rank shard {per} "
-                f"({len(x)} samples / {n_ranks} ranks)"
-            )
+        # validates batch/shard sizes too (single source of truth)
+        self.steps = epoch_index_plan(len(x), n_ranks, batch_size).shape[1]
         self._pending: Optional[Tuple[int, threading.Thread, dict]] = None
 
     def _assemble(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
-        plan = (
-            shard_random(len(self.x), self.n_ranks, self.seed, epoch)
-            if self.random
-            else shard_sequential(len(self.x), self.n_ranks)
-        )
-        idx = plan[:, : self.steps * self.batch].reshape(
-            self.n_ranks, self.steps, self.batch
+        idx = epoch_index_plan(
+            len(self.x), self.n_ranks, self.batch,
+            random=self.random, seed=self.seed, epoch=epoch,
         )
         return native.gather_batches(self.x, self.y, idx)
 
@@ -75,7 +66,10 @@ class EpochPrefetcher:
         box: dict = {}
 
         def work():
-            box["out"] = self._assemble(epoch)
+            try:
+                box["out"] = self._assemble(epoch)
+            except BaseException as e:  # surfaced by the consuming get()
+                box["err"] = e
 
         th = threading.Thread(target=work, daemon=True, name=f"eg-prefetch-{epoch}")
         th.start()
@@ -87,6 +81,8 @@ class EpochPrefetcher:
             ep, th, box = self._pending
             th.join()  # either our epoch, or stale speculation to retire
             if ep == epoch:
+                if "err" in box:
+                    raise box["err"]
                 out = box["out"]
             self._pending = None
         if out is None:  # miss (first call or out-of-order epoch)

@@ -36,6 +36,34 @@ def shard_random(n: int, n_ranks: int, seed: int = 0, epoch: int = 0) -> np.ndar
     return perm.reshape(n_ranks, per).astype(np.int64)
 
 
+def epoch_index_plan(
+    n: int,
+    n_ranks: int,
+    batch_size: int,
+    *,
+    random: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+) -> np.ndarray:
+    """[n_ranks, steps, batch] sample indices for one epoch. Trailing
+    partial batches are dropped, matching the reference loaders'
+    full-batch iteration. The single source of truth for epoch assembly —
+    `batched_epoch` and `prefetch.EpochPrefetcher` both consume it."""
+    shards = (
+        shard_random(n, n_ranks, seed, epoch)
+        if random
+        else shard_sequential(n, n_ranks)
+    )
+    per = shards.shape[1]
+    steps = per // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"batch_size {batch_size} larger than per-rank shard {per} "
+            f"({n} samples / {n_ranks} ranks)"
+        )
+    return shards[:, : steps * batch_size].reshape(n_ranks, steps, batch_size)
+
+
 def batched_epoch(
     x: np.ndarray,
     y: np.ndarray,
@@ -46,23 +74,9 @@ def batched_epoch(
     seed: int = 0,
     epoch: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """One epoch of per-rank batches in stacked layout.
-
-    Returns (xb, yb) with shapes [n_ranks, steps, batch, ...] and
-    [n_ranks, steps, batch]. Trailing partial batches are dropped, matching
-    the reference loaders' full-batch iteration.
-    """
-    shards = (
-        shard_random(len(x), n_ranks, seed, epoch)
-        if random
-        else shard_sequential(len(x), n_ranks)
+    """One epoch of per-rank batches in stacked layout: (xb, yb) shaped
+    [n_ranks, steps, batch, ...] / [n_ranks, steps, batch]."""
+    idx = epoch_index_plan(
+        len(x), n_ranks, batch_size, random=random, seed=seed, epoch=epoch
     )
-    per = shards.shape[1]
-    steps = per // batch_size
-    if steps == 0:
-        raise ValueError(
-            f"batch_size {batch_size} larger than per-rank shard {per} "
-            f"({len(x)} samples / {n_ranks} ranks)"
-        )
-    idx = shards[:, : steps * batch_size].reshape(n_ranks, steps, batch_size)
     return x[idx], y[idx]

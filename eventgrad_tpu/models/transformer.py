@@ -41,6 +41,7 @@ class Block(nn.Module):
     topo: Optional[Topology]
     sp_axis: Optional[str]
     dtype: Any = jnp.float32
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -52,10 +53,12 @@ class Block(nn.Module):
         qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype)(y)
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
         if self.attn == "ring":
-            o = ring_attention(q, k, v, self.topo, axis=self.sp_axis, causal=True)
+            o = ring_attention(q, k, v, self.topo, axis=self.sp_axis,
+                               causal=True, use_flash=self.use_flash)
         elif self.attn == "ulysses":
-            o = ulysses_attention(q, k, v, self.topo, axis=self.sp_axis, causal=True)
-        elif self.attn == "flash":
+            o = ulysses_attention(q, k, v, self.topo, axis=self.sp_axis,
+                                  causal=True, use_flash=self.use_flash)
+        elif self.attn == "flash" or (self.attn == "full" and self.use_flash):
             from eventgrad_tpu.ops.attention import flash_attention
 
             o = flash_attention(q, k, v, causal=True)
@@ -83,6 +86,8 @@ class TransformerLM(nn.Module):
     topo: Optional[Topology] = None
     sp_axis: Optional[str] = None
     dtype: Any = jnp.float32
+    use_flash: bool = False  # run ring/ulysses/full local attention through
+    #                          the Pallas kernel (attn="flash" implies it)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -99,7 +104,8 @@ class TransformerLM(nn.Module):
 
         for _ in range(self.n_layers):
             x = Block(
-                self.dim, self.n_heads, self.attn, self.topo, self.sp_axis, self.dtype
+                self.dim, self.n_heads, self.attn, self.topo, self.sp_axis,
+                self.dtype, self.use_flash,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab, dtype=self.dtype)(x).astype(jnp.float32)

@@ -36,6 +36,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -94,13 +95,25 @@ def _causal_q_index(causal):
     return lambda b, h, j, i: (b, h, i, 0)
 
 
-def _block_mask(qi, kj, t_real_k, causal):
+def _block_mask(qi, kj, t_real_k, causal, q_off=0, k_off=0):
+    """Validity of score block (qi, kj). The padding mask is in local
+    coordinates; the causal comparison adds the global offsets (ring hops
+    pass the rank origins of the resident Q and K shards)."""
     qpos = qi * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 0)
     kpos = kj * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 1)
     valid = kpos < t_real_k
     if causal:
-        valid &= qpos >= kpos
+        valid &= (q_off + qpos) >= (k_off + kpos)
     return valid
+
+
+def _unpack(args, n_scratch, has_offsets):
+    """Split pallas kernel args into (offs_ref|None, io_refs, scratch_refs)."""
+    scratch = args[len(args) - n_scratch:]
+    io = args[: len(args) - n_scratch]
+    if has_offsets:
+        return io[0], io[1:], scratch
+    return None, io, scratch
 
 
 def _dot(a, b, trans=False):
@@ -108,8 +121,12 @@ def _dot(a, b, trans=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, a_s,
-                *, scale, causal, t_real, nk):
+def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
+    offs_ref, (q_ref, k_ref, v_ref, o_ref, lse_ref), (m_s, l_s, a_s) = _unpack(
+        args, 3, has_offsets
+    )
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
     qi, kj = pl.program_id(2), pl.program_id(3)
 
     @pl.when(kj == 0)
@@ -123,7 +140,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, a_s,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = _dot(q, k, trans=True)  # [bq, bk]
-        valid = _block_mask(qi, kj, t_real, causal)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
         s = jnp.where(valid, s, _NEG_INF)
         m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -133,9 +150,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, a_s,
         l_s[...] = l_prev * corr + p.sum(axis=-1, keepdims=True)
         a_s[...] = a_s[...] * corr + _dot(p, v)
 
-    if causal:  # skip KV blocks strictly above the diagonal
+    if causal and not has_offsets:  # skip KV blocks above the diagonal
         pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
-    else:
+    else:  # offset diagonals are dynamic: mask handles everything
         _compute()
 
     @pl.when(kj == nk - 1)
@@ -145,8 +162,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, a_s,
         lse_ref[0, 0] = m_s[...] + jnp.log(l_safe)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
-               *, scale, causal, t_real, nk):
+def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
+    offs_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref), (dq_s,) = (
+        _unpack(args, 1, has_offsets)
+    )
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
     qi, kj = pl.program_id(2), pl.program_id(3)
 
     @pl.when(kj == 0)
@@ -160,14 +181,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
         do = do_ref[0, 0].astype(jnp.float32)
         lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
         s = _dot(q, k, trans=True)
-        valid = _block_mask(qi, kj, t_real, causal)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse) * valid
         dp = _dot(do, v, trans=True)
         ds = p * (dp - delta) * scale
         dq_s[...] += _dot(ds, k)
 
-    if causal:
+    if causal and not has_offsets:
         pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
     else:
         _compute()
@@ -177,8 +198,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
         dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, t_real, nq):
+def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets):
+    (
+        offs_ref,
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref),
+        (dk_s, dv_s),
+    ) = _unpack(args, 2, has_offsets)
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
     kj, qi = pl.program_id(2), pl.program_id(3)
 
     @pl.when(qi == 0)
@@ -193,7 +220,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
         s = scale * _dot(q, k, trans=True)  # [bq, bk]
-        valid = _block_mask(qi, kj, t_real, causal)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse) * valid
         dv_s[...] += jax.lax.dot_general(
@@ -205,7 +232,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:  # Q blocks strictly before this KV block contribute nothing
+    if causal and not has_offsets:
+        # Q blocks strictly before this KV block contribute nothing
         pl.when((qi + 1) * _BLOCK > kj * _BLOCK)(_compute)
     else:
         _compute()
@@ -227,24 +255,46 @@ def _dims(t, d):
     return t_pad, d_pad, t_pad // _BLOCK
 
 
-def _run_fwd(q, k, v, causal, interpret):
-    """q/k/v: [B, H, T, D] (already transposed). Returns (out, lse [B,H,T,1])."""
+def _offs_spec(interpret):
+    """(1, 2) int32 [q_offset, k_offset] — scalar memory on real TPU."""
+    kw = {}
+    if not interpret and pltpu is not None:
+        kw["memory_space"] = pltpu.SMEM
+    return pl.BlockSpec((1, 2), lambda b_, h_, i, j: (0, 0), **kw)
+
+
+def _run_fwd(q, k, v, causal, interpret, offsets=None):
+    """q/k/v: [B, H, T, D] (already transposed). Returns (out, lse [B,H,T,1]).
+
+    offsets: traced (1, 2) int32 [q_offset, k_offset] shifting the causal
+    mask to global positions (ring attention hops), or None."""
     b, h, t, d = q.shape
     t_pad, d_pad, n = _dims(t, d)
     qp, kp, vp = (_pad_to(x, t_pad, d_pad) for x in (q, k, v))
     scale = 1.0 / float(d) ** 0.5
+    has_offs = offsets is not None
 
     q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
-    kv_blk = _spec((1, 1, _BLOCK, d_pad), _causal_kv_index(causal), interpret)
+    kv_blk = _spec(
+        (1, 1, _BLOCK, d_pad), _causal_kv_index(causal and not has_offs), interpret
+    )
     row_blk = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    in_specs = [q_blk, kv_blk, kv_blk]
+    operands = [qp, kp, vp]
+    if has_offs:
+        in_specs.insert(0, _offs_spec(interpret))
+        operands.insert(0, offsets)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, t_real=t, nk=n),
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, t_real=t, nk=n,
+            has_offsets=has_offs,
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
             jax.ShapeDtypeStruct((b, h, t_pad, 1), jnp.float32),
         ),
         grid=(b, h, n, n),
-        in_specs=[q_blk, kv_blk, kv_blk],
+        in_specs=in_specs,
         out_specs=(q_blk, row_blk),
         scratch_shapes=[
             _any_scratch((_BLOCK, 1)),
@@ -253,50 +303,73 @@ def _run_fwd(q, k, v, causal, interpret):
         ],
         interpret=interpret,
         **_compiler_params(interpret),
-    )(qp, kp, vp)
+    )(*operands)
     return out[:, :, :t, :d], lse[:, :, :t, :]
 
 
-def _run_bwd(q, k, v, out, lse, do, causal, interpret):
+def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
+    """FA2 backward. dlse (cotangent of the logsumexp output, [B,H,T,1])
+    folds into the delta term: ds = p * (dp - (delta - dlse))."""
     b, h, t, d = q.shape
     t_pad, d_pad, n = _dims(t, d)
     qp, kp, vp, op, dop = (_pad_to(x, t_pad, d_pad) for x in (q, k, v, out, do))
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
     scale = 1.0 / float(d) ** 0.5
+    has_offs = offsets is not None
     delta = (dop.astype(jnp.float32) * op.astype(jnp.float32)).sum(-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - jnp.pad(
+            dlse.astype(jnp.float32), ((0, 0), (0, 0), (0, t_pad - t), (0, 0))
+        )
+    skip = causal and not has_offs
 
     q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
-    kv_blk = _spec((1, 1, _BLOCK, d_pad), _causal_kv_index(causal), interpret)
+    kv_blk = _spec((1, 1, _BLOCK, d_pad), _causal_kv_index(skip), interpret)
     row_q = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
-
+    dq_specs = [q_blk, kv_blk, kv_blk, q_blk, row_q, row_q]
+    dq_ops = [qp, kp, vp, dop, lsep, delta]
+    if has_offs:
+        dq_specs.insert(0, _offs_spec(interpret))
+        dq_ops.insert(0, offsets)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, t_real=t, nk=n),
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, t_real=t, nk=n,
+            has_offsets=has_offs,
+        ),
         out_shape=jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
         grid=(b, h, n, n),
-        in_specs=[q_blk, kv_blk, kv_blk, q_blk, row_q, row_q],
+        in_specs=dq_specs,
         out_specs=q_blk,
         scratch_shapes=[_any_scratch((_BLOCK, d_pad))],
         interpret=interpret,
         **_compiler_params(interpret),
-    )(qp, kp, vp, dop, lsep, delta)
+    )(*dq_ops)
 
     # grid order (..., kv-block, q-block): the Q sweep is innermost
     kv_outer = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, j, i: (b_, h_, j, 0), interpret)
-    q_inner = _spec((1, 1, _BLOCK, d_pad), _causal_q_index(causal), interpret)
-    row_inner = _spec((1, 1, _BLOCK, 1), _causal_q_index(causal), interpret)
+    q_inner = _spec((1, 1, _BLOCK, d_pad), _causal_q_index(skip), interpret)
+    row_inner = _spec((1, 1, _BLOCK, 1), _causal_q_index(skip), interpret)
+    dkv_specs = [q_inner, kv_outer, kv_outer, q_inner, row_inner, row_inner]
+    dkv_ops = [qp, kp, vp, dop, lsep, delta]
+    if has_offs:
+        dkv_specs.insert(0, _offs_spec(interpret))
+        dkv_ops.insert(0, offsets)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, t_real=t, nq=n),
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, t_real=t, nq=n,
+            has_offsets=has_offs,
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, t_pad, d_pad), k.dtype),
             jax.ShapeDtypeStruct((b, h, t_pad, d_pad), v.dtype),
         ),
         grid=(b, h, n, n),
-        in_specs=[q_inner, kv_outer, kv_outer, q_inner, row_inner, row_inner],
+        in_specs=dkv_specs,
         out_specs=(kv_outer, kv_outer),
         scratch_shapes=[_any_scratch((_BLOCK, d_pad)), _any_scratch((_BLOCK, d_pad))],
         interpret=interpret,
         **_compiler_params(interpret),
-    )(qp, kp, vp, dop, lsep, delta)
+    )(*dkv_ops)
     cut = lambda x: x[:, :, :t, :d]
     return cut(dq), cut(dk), cut(dv)
 
@@ -321,6 +394,65 @@ def _flash_bwd(causal, interpret, res, do):
 
 
 _flash_bhtd.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_lse_bhtd(q, k, v, offs, causal, interpret):
+    return _run_fwd(q, k, v, causal, interpret, offsets=offs)
+
+
+def _flash_lse_fwd(q, k, v, offs, causal, interpret):
+    out, lse = _run_fwd(q, k, v, causal, interpret, offsets=offs)
+    return (out, lse), (q, k, v, offs, out, lse)
+
+
+def _flash_lse_bwd(causal, interpret, res, cts):
+    q, k, v, offs, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _run_bwd(
+        q, k, v, out, lse, do, causal, interpret, offsets=offs, dlse=dlse
+    )
+    d_offs = np.zeros(offs.shape, jax.dtypes.float0)  # int operand: no tangent
+    return dq, dk, dv, d_offs
+
+
+_flash_lse_bhtd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    q_offset=0,
+    k_offset=0,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention returning (out [B,T,H,D], logsumexp [B,T,H]).
+
+    The lse output makes partial results mergeable with the online-softmax
+    combine rule — ring attention computes each KV hop through this kernel
+    and folds the hops together (parallel/ring_attention.py). q_offset and
+    k_offset (traced ints) shift the causal mask to global sequence
+    positions: hop blocks are fully-visible, diagonal, or fully-masked
+    depending on the ranks' relative positions. Differentiable in q/k/v,
+    including through lse (the dlse cotangent folds into the delta term of
+    the FA2 backward)."""
+    if not (q.shape == k.shape == v.shape):
+        raise ValueError(
+            f"flash_attention_lse: q/k/v shapes must match, got "
+            f"{q.shape}, {k.shape}, {v.shape}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )[None, :]
+    to_bhtd = lambda x: jnp.swapaxes(x, 1, 2)
+    out, lse = _flash_lse_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), offs, causal, bool(interpret)
+    )
+    return to_bhtd(out), jnp.swapaxes(lse[..., 0], 1, 2)  # lse -> [B,T,H]
 
 
 def flash_attention(

@@ -68,18 +68,48 @@ def ring_attention(
     topo: Topology,
     axis: Optional[str] = None,
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on a ring axis.
 
     q/k/v: per-rank shards [B, T_local, H, D]; global sequence length is
     T_local * axis_size, shard r owning positions [r*T_local, (r+1)*T_local).
     Returns the local output shard [B, T_local, H, D] (q.dtype).
+
+    use_flash=True computes each hop's block attention with the Pallas
+    FlashAttention kernel (out + logsumexp, global-position causal offsets)
+    and folds hops together with the two-way online-softmax merge — scores
+    stay in VMEM instead of materializing [B,H,T/N,T/N] per hop.
     """
     axis = axis or topo.axes[0]
     n = topo.axis_size(axis)
     nb = NeighborSpec(axis, -1)  # KV block arrives from the left each hop
     b, t_local, h, d = q.shape
     my_rank = lax.axis_index(axis)
+
+    if use_flash:
+        from eventgrad_tpu.ops.attention import flash_attention_lse
+
+        def body_flash(step, carry):
+            o, lse, kv = carry  # o [B,T,H,D] f32; lse [B,T,H] f32
+            k_cur, v_cur = kv
+            src = (my_rank - step) % n
+            o_blk, lse_blk = flash_attention_lse(
+                q, k_cur, v_cur, causal=causal,
+                q_offset=my_rank * t_local, k_offset=src * t_local,
+            )
+            lse_new = jnp.logaddexp(lse, lse_blk)
+            w_old = jnp.exp(lse - lse_new)[..., None]
+            w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+            o = o * w_old + o_blk.astype(jnp.float32) * w_blk
+            kv = jax.tree.map(lambda x: lax.ppermute(
+                x, axis, [((r + nb.offset) % n, r) for r in range(n)]), kv)
+            return o, lse_new, kv
+
+        o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+        lse0 = jnp.full((b, t_local, h), -jnp.inf, jnp.float32)
+        o, _, _ = lax.fori_loop(0, n, body_flash, (o0, lse0, (k, v)))
+        return o.astype(q.dtype)
 
     m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, h, t_local), jnp.float32)
@@ -117,9 +147,15 @@ def ulysses_attention(
     topo: Topology,
     axis: Optional[str] = None,
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style SP: all_to_all seq-sharded -> head-sharded,
-    full local attention, all_to_all back. Requires H % axis_size == 0."""
+    full local attention, all_to_all back. Requires H % axis_size == 0.
+
+    use_flash=True runs the local attention through the Pallas
+    FlashAttention kernel (ops/attention.py) — after the all_to_all each
+    rank holds full-sequence causal self-attention over its head shard,
+    which is exactly the kernel's contract."""
     axis = axis or topo.axes[0]
     n = topo.axis_size(axis)
     b, t_local, h, d = q.shape
@@ -136,6 +172,10 @@ def ulysses_attention(
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from eventgrad_tpu.ops.attention import flash_attention
+
+        return heads_to_seq(flash_attention(qg, kg, vg, causal=causal))
     t = t_local * n
     bias = None
     if causal:

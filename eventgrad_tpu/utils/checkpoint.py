@@ -25,10 +25,13 @@ def save(path: str, state: Any) -> None:
         shutil.rmtree(tmp)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp, state, force=True)
-    if os.path.exists(prev):
-        shutil.rmtree(prev)
     if os.path.exists(path):
+        # make room for the demotion; the current primary covers the gap
+        if os.path.exists(prev):
+            shutil.rmtree(prev)
         os.rename(path, prev)
+    # primary may be absent (first save, or resumed-from-.prev); never touch
+    # a surviving .prev until the new primary is in place
     os.rename(tmp, path)
     if os.path.exists(prev):
         shutil.rmtree(prev)
