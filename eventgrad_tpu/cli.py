@@ -34,6 +34,7 @@ import numpy as np
 
 from eventgrad_tpu.data.datasets import load_or_synthesize
 from eventgrad_tpu.models import MODEL_REGISTRY
+from eventgrad_tpu.parallel import multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import build_mesh
@@ -83,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-pass per-param send-trace JSONL (the reference's "
                         "file_write=1 send{r}.txt, event.cpp:337-391)")
     p.add_argument("--n-synth", type=int, default=4096)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 — joins a multi-host run "
+                        "(mpirun's role; requires --backend mesh)")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="snapshot the full gossip TrainState here")
     p.add_argument("--save-every", type=int, default=0,
@@ -95,7 +101,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     topo = args.mesh  # argparse already applied parse_mesh (also to the default)
-    logger = JsonlLogger(args.log_file)
+
+    if args.coordinator:
+        if args.backend != "mesh":
+            raise SystemExit("--coordinator requires --backend mesh")
+        multihost.init(args.coordinator, args.num_processes, args.process_id)
+
+    primary = multihost.is_primary()
+    logger = JsonlLogger(
+        args.log_file if primary else None, echo=primary
+    )
 
     # --dataset synthetic means "hermetic stand-in even if real data exists":
     # drop data_dir so load_or_synthesize can't pick up on-disk files.
@@ -133,10 +148,14 @@ def main(argv=None) -> int:
     for rec in history:
         logger.log(rec)
 
-    cons = consensus_params(state.params)
-    stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
-    final = evaluate(model, cons, stats0, xt, yt)
-    logger.log({"final": True, **final})
+    # allgathers are collective: every process participates...
+    params_host = multihost.to_host(state.params)
+    stats_host = multihost.to_host(state.batch_stats)
+    if primary:  # ...but only the primary spends the eval and logs it
+        cons = consensus_params(params_host)
+        stats0 = jax.tree.map(lambda s: s[0], stats_host)
+        final = evaluate(model, cons, stats0, xt, yt)
+        logger.log({"final": True, **final})
     logger.close()
     return 0
 

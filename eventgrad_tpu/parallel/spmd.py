@@ -50,6 +50,12 @@ def build_mesh(topo: Topology, devices: Optional[Sequence[jax.Device]] = None) -
     return Mesh(dev_array, topo.axes)
 
 
+def stacked_spec(topo: Topology) -> P:
+    """PartitionSpec of the stacked layout: the single leading [n_ranks]
+    axis sharded over every mesh axis, row-major."""
+    return P(topo.axes if len(topo.axes) > 1 else topo.axes[0])
+
+
 def stack_for_ranks(tree: Any, topo: Topology) -> Any:
     """Broadcast a per-rank pytree to the stacked layout: every leaf gains a
     leading `n_ranks` axis holding identical copies (the reference seeds all
@@ -97,7 +103,7 @@ def spmd(
     # shard_map path: leading stacked axis sharded over all mesh axes
     # (row-major, matching the stacked layout); per-shard leading dim is 1,
     # squeezed away so `fn` sees true per-rank shapes.
-    spec = P(topo.axes if len(topo.axes) > 1 else topo.axes[0])
+    spec = stacked_spec(topo)
 
     def shard_body(*args):
         args = tuple(jax.tree.map(lambda x: x[0], a) for a in args)

@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
+from eventgrad_tpu.parallel import multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import spmd
@@ -52,9 +53,7 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
         if first:
             names = [
                 "/".join(str(getattr(p, "key", p)) for p in kp)
-                for kp, _ in jax.tree_util.tree_flatten_with_path(
-                    jax.tree.map(lambda x: x[0], state.params)
-                )[0]
+                for kp, _ in jax.tree_util.tree_flatten_with_path(state.params)[0]
             ]
             tf.write(json.dumps({"trace_params": names}) + "\n")
         steps = m["trace_fired"].shape[0]
@@ -136,6 +135,11 @@ def train(
         model, x_train.shape[1:], tx, topo, algo, event_cfg, seed=seed
     )
 
+    multi = multihost.is_multiprocess()
+    if multi and checkpoint_dir:
+        raise ValueError(
+            "checkpointing under multi-process runs is not supported yet"
+        )
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
     start_epoch = 0
     if ckpt_path and resume:
@@ -146,6 +150,12 @@ def train(
             )
             state = restored["state"]
             start_epoch = int(restored["epoch"])
+
+    # host-side pass counter (the sharded pass_num leaf is not addressable
+    # across processes); read once here, advance arithmetically per epoch
+    start_passes = int(np.asarray(state.pass_num).reshape(-1)[0])
+    if mesh is not None:
+        state = multihost.put_stacked(state, mesh, topo)
     step = make_train_step(
         model, tx, topo, algo,
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
@@ -176,14 +186,19 @@ def train(
         for epoch in range(start_epoch + 1, epochs + 1):
             xb, yb = prefetcher.get(epoch)
             steps = xb.shape[1]
+            if mesh is not None:  # global placement (spans hosts if any)
+                xb = multihost.put_stacked(xb, mesh, topo)
+                yb = multihost.put_stacked(yb, mesh, topo)
+            else:
+                xb, yb = jnp.asarray(xb), jnp.asarray(yb)
             t0 = time.perf_counter()
-            state, m = run_epoch(state, jnp.asarray(xb), jnp.asarray(yb))
+            state, m = run_epoch(state, xb, yb)
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
 
             # metrics are [steps, n_ranks]
-            m = jax.tree.map(np.asarray, m)
-            total_passes = int(state.pass_num.reshape(-1)[0])
+            m = multihost.to_host(m)
+            total_passes = start_passes + (epoch - start_epoch) * steps
             rec = {
                 "epoch": epoch,
                 "algo": algo,
@@ -202,9 +217,11 @@ def train(
                     events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
                 )
                 rec["fired_frac"] = float(m["fired_frac"].mean())
-            if trace_file and "trace_fired" in m:
+            if trace_file and "trace_fired" in m and multihost.is_primary():
                 _write_trace(trace_file, m, total_passes - steps, topo.n_ranks, state)
-            if x_test is not None and log_every_epoch:
+            if x_test is not None and log_every_epoch and not multi:
+                # multi-process callers evaluate once at the end on
+                # allgathered params (multihost.to_host)
                 cons = consensus_params(state.params)
                 stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
                 rec.update(

@@ -1,0 +1,62 @@
+"""Worker process for tests/test_multihost.py (not a test module).
+
+Each of N processes owns 4 virtual CPU devices; together they form one
+global 8-device ring. Trains MLP/EventGraD through the CLI train() path on
+the global mesh, then compares the allgathered final parameters against an
+in-process single-device vmap simulation of the identical run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from eventgrad_tpu.parallel import multihost  # noqa: E402
+
+multihost.init(f"localhost:{port}", nprocs, pid)
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+import numpy as np  # noqa: E402
+
+from eventgrad_tpu.data.datasets import synthetic_dataset  # noqa: E402
+from eventgrad_tpu.models import MLP  # noqa: E402
+from eventgrad_tpu.parallel.events import EventConfig  # noqa: E402
+from eventgrad_tpu.parallel.spmd import build_mesh  # noqa: E402
+from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
+from eventgrad_tpu.train.loop import train  # noqa: E402
+
+topo = Ring(8)
+x, y = synthetic_dataset(512, (28, 28, 1), seed=11)
+kwargs = dict(
+    algo="eventgrad", epochs=2, batch_size=8, learning_rate=0.05,
+    event_cfg=EventConfig(adaptive=True, horizon=0.9, warmup_passes=3),
+    random_sampler=True, seed=3, log_every_epoch=False,
+)
+
+# global-mesh run: ranks 0-3 on this process, 4-7 on the peer
+state_mesh, hist_mesh = train(MLP(), topo, x, y, mesh=build_mesh(topo), **kwargs)
+params_mesh = multihost.to_host(state_mesh.params)
+
+# reference: same run simulated on one device (no mesh)
+state_sim, hist_sim = train(MLP(), topo, x, y, mesh=None, **kwargs)
+params_sim = jax.tree.map(np.asarray, state_sim.params)
+
+for a, b in zip(jax.tree.leaves(params_mesh), jax.tree.leaves(params_sim)):
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+for hm, hs in zip(hist_mesh, hist_sim):
+    assert hm["num_events"] == hs["num_events"], (hm, hs)
+    np.testing.assert_allclose(hm["loss"], hs["loss"], atol=1e-5)
+    # train_acc divides by the true step count: catches to_host duplication
+    np.testing.assert_allclose(hm["train_acc"], hs["train_acc"], atol=1e-6)
+    assert hm["steps"] == hs["steps"]
+
+print(f"MH-WORKER-{pid}-OK", flush=True)
