@@ -76,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--history", type=int, default=2)          # event.cpp:103
     p.add_argument("--topk-percent", type=float, default=10.0)
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
+    p.add_argument("--fused", action="store_true",
+                   help="Pallas fused gossip-mix+SGD update tail "
+                        "(gossip algorithms; plain/momentum SGD only)")
     p.add_argument("--random-sampler", action="store_true")
     p.add_argument("--sync-bn", action="store_true")
     p.add_argument("--seed", type=int, default=0)             # torch::manual_seed(0)
@@ -144,6 +147,7 @@ def main(argv=None) -> int:
         sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
         checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
         resume=args.resume, trace_file=args.trace_file,
+        fused_update=args.fused,
     )
     for rec in history:
         logger.log(rec)

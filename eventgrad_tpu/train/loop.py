@@ -121,6 +121,7 @@ def train(
     save_every: int = 0,
     resume: bool = False,
     trace_file: Optional[str] = None,
+    fused_update: bool = False,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -129,6 +130,10 @@ def train(
     restores the latest snapshot and continues from its epoch — the elastic
     story the reference lacks entirely (a dead MPI rank just hangs it,
     decent.cpp:200-205).
+
+    fused_update=True routes the gossip-mix + SGD tail of each step through
+    the Pallas fused kernel (ops/fused_update.py) — one HBM read/write per
+    parameter element. Gossip algorithms only (allreduce keeps optax).
     """
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
     state = init_train_state(
@@ -160,6 +165,7 @@ def train(
         model, tx, topo, algo,
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
         sync_bn=sync_bn, trace=trace_file is not None,
+        fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
     )
     lifted = spmd(step, topo, mesh=mesh)
 

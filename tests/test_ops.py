@@ -45,6 +45,26 @@ def test_fused_handles_tiny_and_odd_sizes():
     np.testing.assert_allclose(np.asarray(fp["s"]), [1.0, 2.0, 3.0])
 
 
+def test_fused_train_loop_matches_unfused():
+    """train(fused_update=True) follows the optax trajectory exactly."""
+    from eventgrad_tpu.data.datasets import synthetic_dataset
+    from eventgrad_tpu.models import MLP
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.parallel.topology import Ring
+    from eventgrad_tpu.train.loop import train
+
+    x, y = synthetic_dataset(256, (28, 28, 1), seed=6)
+    kwargs = dict(
+        algo="eventgrad", epochs=2, batch_size=8, learning_rate=0.05,
+        momentum=0.9, event_cfg=EventConfig(adaptive=True, warmup_passes=3),
+        seed=1, log_every_epoch=False,
+    )
+    s_fused, _ = train(MLP(), Ring(4), x, y, fused_update=True, **kwargs)
+    s_plain, _ = train(MLP(), Ring(4), x, y, **kwargs)
+    for a, b in zip(jax.tree.leaves(s_fused.params), jax.tree.leaves(s_plain.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_fused_step_matches_unfused_trajectory():
     """A full EventGraD step with fused_sgd must equal the optax path."""
     import optax
