@@ -70,3 +70,11 @@ def to_host(tree: Any) -> Any:
 def is_primary() -> bool:
     """True on the process that should own logging / file output."""
     return jax.process_index() == 0
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (no-op single-process)."""
+    if is_multiprocess():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

@@ -141,10 +141,6 @@ def train(
     )
 
     multi = multihost.is_multiprocess()
-    if multi and checkpoint_dir:
-        raise ValueError(
-            "checkpointing under multi-process runs is not supported yet"
-        )
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
     start_epoch = 0
     if ckpt_path and resume:
@@ -237,7 +233,13 @@ def train(
             if ckpt_path and (
                 epoch == epochs or (save_every and epoch % save_every == 0)
             ):
-                checkpoint.save(ckpt_path, {"state": state, "epoch": np.int64(epoch)})
+                # multi-process: allgather the global-mesh state to host;
+                # checkpoint.save coordinates the one-writer snapshot
+                # (checkpoint_dir must be visible to all processes)
+                save_state = multihost.to_host(state) if multi else state
+                checkpoint.save(
+                    ckpt_path, {"state": save_state, "epoch": np.int64(epoch)}
+                )
     finally:
         prefetcher.close()
 

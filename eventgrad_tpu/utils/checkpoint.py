@@ -18,23 +18,31 @@ import orbax.checkpoint as ocp
 def save(path: str, state: Any) -> None:
     """Crash-safe snapshot: write to `<path>.tmp`, swap the old snapshot to
     `<path>.prev`, promote tmp, drop prev. A kill at any point leaves either
-    `<path>` or `<path>.prev` complete — `latest()` finds whichever survived."""
+    `<path>` or `<path>.prev` complete — `latest()` finds whichever survived.
+
+    Multi-process: EVERY process must call this (orbax coordinates the write
+    internally and only the primary touches disk); `path` must be on a
+    filesystem all processes can read for a later resume. Leaves must be
+    host-replicated (numpy) — `multihost.to_host` the state first."""
+    from eventgrad_tpu.parallel import multihost
+
     path = os.path.abspath(path)
     tmp, prev = path + ".tmp", path + ".prev"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
+    # force=True clears a stale tmp itself, primary-only with internal syncs
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp, state, force=True)
-    if os.path.exists(path):
-        # make room for the demotion; the current primary covers the gap
+    if multihost.is_primary():
+        if os.path.exists(path):
+            # make room for the demotion; the current snapshot covers the gap
+            if os.path.exists(prev):
+                shutil.rmtree(prev)
+            os.rename(path, prev)
+        # the promoted snapshot may be absent (first save, or resumed from
+        # .prev); never touch a surviving .prev until the new one is in place
+        os.rename(tmp, path)
         if os.path.exists(prev):
             shutil.rmtree(prev)
-        os.rename(path, prev)
-    # primary may be absent (first save, or resumed-from-.prev); never touch
-    # a surviving .prev until the new primary is in place
-    os.rename(tmp, path)
-    if os.path.exists(prev):
-        shutil.rmtree(prev)
+    multihost.barrier("eg-ckpt-promote")
 
 
 def latest(path: str) -> Optional[str]:

@@ -16,7 +16,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_training_matches_single_process():
+def test_two_process_training_matches_single_process(tmp_path):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "mh_worker.py")
     env = {
@@ -25,7 +25,7 @@ def test_two_process_training_matches_single_process():
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", str(port)],
+            [sys.executable, worker, str(pid), "2", str(port), str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(worker)),
         )
