@@ -119,6 +119,24 @@ class Topology:
     def axis_size(self, axis: str) -> int:
         return self.shape[self.axes.index(axis)]
 
+    def neighbor_source(self, rank: int, spec: NeighborSpec) -> int:
+        """Flat rank whose payload arrives at `rank` via `spec`, under the
+        row-major stacked layout (matches collectives.recv_from's ppermute:
+        rank r receives from the rank `spec.offset` away along `spec.axis`,
+        so offset=-1 is the reference's `left`, decent.cpp:56-64)."""
+        ax = self.axes.index(spec.axis)
+        coords = []
+        rem = rank
+        for size in reversed(self.shape):
+            coords.append(rem % size)
+            rem //= size
+        coords.reverse()
+        coords[ax] = (coords[ax] + spec.offset) % self.shape[ax]
+        flat = 0
+        for c, size in zip(coords, self.shape):
+            flat = flat * size + c
+        return flat
+
 
 def Ring(n: int, axis: str = "ring") -> Topology:
     """1-D ring of `n` ranks — the reference's only topology."""

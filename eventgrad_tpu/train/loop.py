@@ -43,11 +43,26 @@ def consensus_params(stacked_params: Any) -> Any:
 
 
 def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
-                 n_ranks: int, state) -> None:
-    """Append the reference's send{r}.txt instrumentation as JSONL: one
-    record per (pass, rank) with per-parameter norm/thres/fired vectors in
-    leaf-major order (event.cpp:337-339,385-391). A header record names the
-    parameter leaves the first time the file is written."""
+                 topo: Topology, state, carry: Dict[str, np.ndarray]) -> None:
+    """Append the reference's file_write=1 instrumentation as JSONL.
+
+    Send side (send{r}.txt, event.cpp:337-339,385-391): one record per
+    (pass, rank) with per-parameter norm/thres/fired vectors in leaf-major
+    order. Receive side (recv{r}.txt, event.cpp:418-425,446-461): one record
+    per (pass, rank, neighbor) with the received-buffer norm and a changed
+    bit — here derived deterministically from the sender's fire bit, with
+    `carry` holding the stale norm between messages (the buffers start as
+    zeros, like the reference's window, event.cpp:177-179). A header record
+    names the parameter leaves and neighbor directions on first write."""
+    n_ranks = topo.n_ranks
+    fired_all = np.asarray(m["trace_fired"])
+    norm_all = np.asarray(m["trace_norm"])
+    thres_all = np.asarray(m["trace_thres"])
+    specs = topo.neighbors
+    last = carry["recv_norm"]
+    srcs = [
+        [topo.neighbor_source(r, nb) for r in range(n_ranks)] for nb in specs
+    ]
     first = not os.path.exists(path) or os.path.getsize(path) == 0
     with open(path, "a") as tf:
         if first:
@@ -55,8 +70,11 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
                 "/".join(str(getattr(p, "key", p)) for p in kp)
                 for kp, _ in jax.tree_util.tree_flatten_with_path(state.params)[0]
             ]
-            tf.write(json.dumps({"trace_params": names}) + "\n")
-        steps = m["trace_fired"].shape[0]
+            tf.write(json.dumps({
+                "trace_params": names,
+                "trace_neighbors": [nb.name for nb in specs],
+            }) + "\n")
+        steps = fired_all.shape[0]
         for s_i in range(steps):
             for r in range(n_ranks):
                 tf.write(
@@ -64,13 +82,30 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
                         {
                             "pass": pass_base + s_i + 1,
                             "rank": r,
-                            "norm": [round(float(v), 6) for v in m["trace_norm"][s_i, r]],
-                            "thres": [round(float(v), 6) for v in m["trace_thres"][s_i, r]],
-                            "fired": [int(v) for v in m["trace_fired"][s_i, r]],
+                            "norm": [round(float(v), 6) for v in norm_all[s_i, r]],
+                            "thres": [round(float(v), 6) for v in thres_all[s_i, r]],
+                            "fired": [int(v) for v in fired_all[s_i, r]],
                         }
                     )
                     + "\n"
                 )
+            for k, nb in enumerate(specs):
+                for r in range(n_ranks):
+                    src = srcs[k][r]
+                    ch = fired_all[s_i, src]
+                    last[k, r] = np.where(ch, norm_all[s_i, src], last[k, r])
+                    tf.write(
+                        json.dumps(
+                            {
+                                "pass": pass_base + s_i + 1,
+                                "rank": r,
+                                "recv": nb.name,
+                                "changed": [int(v) for v in ch],
+                                "norm": [round(float(v), 6) for v in last[k, r]],
+                            }
+                        )
+                        + "\n"
+                    )
 
 
 def evaluate(model, params, batch_stats, x, y, batch_size: int = 1000) -> Dict[str, float]:
@@ -142,15 +177,26 @@ def train(
 
     multi = multihost.is_multiprocess()
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+    n_params = trees.tree_count_params(
+        jax.tree.map(lambda p: p[0], state.params)
+    )
+    sz = trees.tree_num_leaves(state.params)
+    # recv-trace staleness carry — part of the snapshot so a resumed run's
+    # recv{r} records continue the interrupted trajectory exactly
+    trace_carry: Dict[str, np.ndarray] = {
+        "recv_norm": np.zeros((topo.n_neighbors, topo.n_ranks, sz))
+    }
     start_epoch = 0
     if ckpt_path and resume:
         found = checkpoint.latest(ckpt_path)
         if found:
             restored = checkpoint.restore(
-                found, {"state": state, "epoch": np.int64(0)}
+                found,
+                {"state": state, "epoch": np.int64(0), "trace_carry": trace_carry},
             )
             state = restored["state"]
             start_epoch = int(restored["epoch"])
+            trace_carry = restored["trace_carry"]
 
     # host-side pass counter (the sharded pass_num leaf is not addressable
     # across processes); read once here, advance arithmetically per epoch
@@ -174,10 +220,6 @@ def train(
         xs = (jnp.swapaxes(xb, 0, 1), jnp.swapaxes(yb, 0, 1))
         return jax.lax.scan(body, st, xs)
 
-    n_params = trees.tree_count_params(
-        jax.tree.map(lambda p: p[0], state.params)
-    )
-    sz = trees.tree_num_leaves(state.params)
     history: List[Dict[str, Any]] = []
 
     prefetcher = EpochPrefetcher(
@@ -220,7 +262,9 @@ def train(
                 )
                 rec["fired_frac"] = float(m["fired_frac"].mean())
             if trace_file and "trace_fired" in m and multihost.is_primary():
-                _write_trace(trace_file, m, total_passes - steps, topo.n_ranks, state)
+                _write_trace(
+                    trace_file, m, total_passes - steps, topo, state, trace_carry
+                )
             if x_test is not None and log_every_epoch and not multi:
                 # multi-process callers evaluate once at the end on
                 # allgathered params (multihost.to_host)
@@ -238,7 +282,12 @@ def train(
                 # (checkpoint_dir must be visible to all processes)
                 save_state = multihost.to_host(state) if multi else state
                 checkpoint.save(
-                    ckpt_path, {"state": save_state, "epoch": np.int64(epoch)}
+                    ckpt_path,
+                    {
+                        "state": save_state,
+                        "epoch": np.int64(epoch),
+                        "trace_carry": trace_carry,
+                    },
                 )
     finally:
         prefetcher.close()

@@ -12,7 +12,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+ckpt_dir = sys.argv[4]  # shared checkpoint dir: the resume leg is mandatory
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
@@ -63,20 +63,19 @@ for hm, hs in zip(hist_mesh, hist_sim):
     np.testing.assert_allclose(hm["train_acc"], hs["train_acc"], atol=1e-6)
     assert hm["steps"] == hs["steps"]
 
-if ckpt_dir:
-    # resume the interrupted mesh run from the epoch-2 snapshot: every
-    # process restores the primary's snapshot (shared filesystem), places it
-    # back on the global mesh, and runs epoch 3 — bit-for-bit the same
-    # trajectory as the uninterrupted single-process simulation
-    state_res, hist_res = train(
-        MLP(), topo, x, y, mesh=build_mesh(topo),
-        checkpoint_dir=ckpt_dir, resume=True, **kwargs_sim
-    )
-    assert [h["epoch"] for h in hist_res] == [3], hist_res
-    assert hist_res[0]["num_events"] == hist_sim[2]["num_events"]
-    np.testing.assert_allclose(hist_res[0]["loss"], hist_sim[2]["loss"], atol=1e-5)
-    params_res = multihost.to_host(state_res.params)
-    for a, b in zip(jax.tree.leaves(params_res), jax.tree.leaves(params_sim)):
-        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+# resume the interrupted mesh run from the epoch-2 snapshot: every process
+# restores the primary's snapshot (shared filesystem), places it back on
+# the global mesh, and runs epoch 3 — bit-for-bit the same trajectory as
+# the uninterrupted single-process simulation
+state_res, hist_res = train(
+    MLP(), topo, x, y, mesh=build_mesh(topo),
+    checkpoint_dir=ckpt_dir, resume=True, **kwargs_sim
+)
+assert [h["epoch"] for h in hist_res] == [3], hist_res
+assert hist_res[0]["num_events"] == hist_sim[2]["num_events"]
+np.testing.assert_allclose(hist_res[0]["loss"], hist_sim[2]["loss"], atol=1e-5)
+params_res = multihost.to_host(state_res.params)
+for a, b in zip(jax.tree.leaves(params_res), jax.tree.leaves(params_sim)):
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
 
 print(f"MH-WORKER-{pid}-OK", flush=True)

@@ -21,12 +21,16 @@ def test_trace_file_records_send_decisions(tmp_path):
         event_cfg=cfg, seed=0, trace_file=str(path),
     )
     lines = [json.loads(l) for l in path.read_text().splitlines()]
-    header, recs = lines[0], lines[1:]
+    header, allrecs = lines[0], lines[1:]
+    recs = [r for r in allrecs if "fired" in r]
+    recvs = [r for r in allrecs if "recv" in r]
 
     assert len(header["trace_params"]) == 4  # MLP: 2 kernels + 2 biases
+    assert header["trace_neighbors"] == ["ring_m1", "ring_p1"]
     steps_per_epoch = hist[0]["steps"]
     total = 2 * steps_per_epoch * 4  # passes x ranks
     assert len(recs) == total
+    assert len(recvs) == total * 2  # one per neighbor direction
     assert {r["rank"] for r in recs} == {0, 1, 2, 3}
     assert max(r["pass"] for r in recs) == 2 * steps_per_epoch
 
@@ -38,3 +42,46 @@ def test_trace_file_records_send_decisions(tmp_path):
     # fired counts must reconcile with the num_events counter (x2 neighbors)
     fired_total = sum(sum(r["fired"]) for r in recs)
     assert 2 * fired_total == int(np.asarray(state.event.num_events).sum())
+
+    # recv records (recv{r}.txt): changed bits mirror the source rank's fire
+    # bits, and the logged norm is the sender's norm when changed else the
+    # last received value (zero before any message — the window's initial
+    # state, event.cpp:177-179)
+    send_at = {(r["pass"], r["rank"]): r for r in recs}
+    last = {}
+    for rv in sorted(recvs, key=lambda r: r["pass"]):
+        offset = {"ring_m1": -1, "ring_p1": +1}[rv["recv"]]
+        src = (rv["rank"] + offset) % 4
+        sent = send_at[(rv["pass"], src)]
+        assert rv["changed"] == sent["fired"]
+        expect = [
+            s_n if ch else prev
+            for s_n, ch, prev in zip(
+                sent["norm"], sent["fired"],
+                last.get((rv["rank"], rv["recv"]), [0.0] * 4),
+            )
+        ]
+        np.testing.assert_allclose(rv["norm"], expect, atol=1e-6)
+        last[(rv["rank"], rv["recv"])] = rv["norm"]
+
+
+def test_trace_survives_resume(tmp_path):
+    """The recv-norm staleness carry is part of the snapshot: a run
+    interrupted after epoch 1 and resumed must append byte-identical trace
+    records to what the uninterrupted run writes."""
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=1)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    kw = dict(
+        algo="eventgrad", batch_size=8, learning_rate=0.05,
+        event_cfg=cfg, seed=0, log_every_epoch=False,
+    )
+    straight = tmp_path / "straight.jsonl"
+    train(MLP(), Ring(4), x, y, epochs=2, trace_file=str(straight), **kw)
+
+    resumed = tmp_path / "resumed.jsonl"
+    ck = str(tmp_path / "ck")
+    train(MLP(), Ring(4), x, y, epochs=1, trace_file=str(resumed),
+          checkpoint_dir=ck, **kw)
+    train(MLP(), Ring(4), x, y, epochs=2, trace_file=str(resumed),
+          checkpoint_dir=ck, resume=True, **kw)
+    assert straight.read_text() == resumed.read_text()
