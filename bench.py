@@ -40,6 +40,10 @@ _TINY = os.environ.get("EG_BENCH_TINY") == "1"
 def main() -> None:
     import jax.numpy as jnp
 
+    from eventgrad_tpu.utils import compile_cache
+
+    compile_cache.enable()
+
     from eventgrad_tpu.data.datasets import load_or_synthesize
     from eventgrad_tpu.models import ResNet18, ResNet
     from eventgrad_tpu.models.resnet import BasicBlock

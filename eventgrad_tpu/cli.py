@@ -102,6 +102,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from eventgrad_tpu.utils import compile_cache
+
+    compile_cache.enable()
     args = build_parser().parse_args(argv)
     topo = args.mesh  # argparse already applied parse_mesh (also to the default)
 

@@ -124,18 +124,12 @@ class Topology:
         row-major stacked layout (matches collectives.recv_from's ppermute:
         rank r receives from the rank `spec.offset` away along `spec.axis`,
         so offset=-1 is the reference's `left`, decent.cpp:56-64)."""
+        import numpy as np
+
         ax = self.axes.index(spec.axis)
-        coords = []
-        rem = rank
-        for size in reversed(self.shape):
-            coords.append(rem % size)
-            rem //= size
-        coords.reverse()
+        coords = list(np.unravel_index(rank, self.shape))
         coords[ax] = (coords[ax] + spec.offset) % self.shape[ax]
-        flat = 0
-        for c, size in zip(coords, self.shape):
-            flat = flat * size + c
-        return flat
+        return int(np.ravel_multi_index(coords, self.shape))
 
 
 def Ring(n: int, axis: str = "ring") -> Topology:

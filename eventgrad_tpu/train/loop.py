@@ -15,6 +15,7 @@ the stacked rank axis — numerically the same reduction.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -190,13 +191,21 @@ def train(
     if ckpt_path and resume:
         found = checkpoint.latest(ckpt_path)
         if found:
-            restored = checkpoint.restore(
-                found,
-                {"state": state, "epoch": np.int64(0), "trace_carry": trace_carry},
-            )
+            try:
+                restored = checkpoint.restore(
+                    found,
+                    {"state": state, "epoch": np.int64(0),
+                     "trace_carry": trace_carry},
+                )
+                trace_carry = restored["trace_carry"]
+            except Exception:
+                # snapshot from before the trace carry existed: resume the
+                # training state, let the carry start from zeros
+                restored = checkpoint.restore(
+                    found, {"state": state, "epoch": np.int64(0)}
+                )
             state = restored["state"]
             start_epoch = int(restored["epoch"])
-            trace_carry = restored["trace_carry"]
 
     # host-side pass counter (the sharded pass_num leaf is not addressable
     # across processes); read once here, advance arithmetically per epoch
@@ -211,7 +220,10 @@ def train(
     )
     lifted = spmd(step, topo, mesh=mesh)
 
-    @jax.jit
+    # donate the carried state: the scan updates params/opt/event state in
+    # place instead of holding two copies in HBM (batches can't alias — the
+    # steps-major swapaxes relayouts them)
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run_epoch(st, xb, yb):
         def body(s, batch):
             return lifted(s, batch)
