@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import jax
@@ -98,10 +99,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint every N epochs (0 = final epoch only)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest snapshot from --checkpoint-dir")
+    p.add_argument("--fault-inject", default=None, metavar="MODE:N",
+                   help="elastic-recovery drill: crash:N exits 13 after "
+                        "epoch N (post-snapshot), hang:N stops making "
+                        "progress — pair with eventgrad_tpu.supervise")
     return p
 
 
 def main(argv=None) -> int:
+    # honor an explicit CPU pin even when an accelerator plugin registered
+    # itself ahead of the env var (jax config may read "plugin,cpu"); must
+    # happen before the first backend use
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     from eventgrad_tpu.utils import compile_cache
 
     compile_cache.enable()
@@ -150,10 +161,10 @@ def main(argv=None) -> int:
         sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
         checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
         resume=args.resume, trace_file=args.trace_file,
-        fused_update=args.fused,
+        fused_update=args.fused, fault_inject=args.fault_inject,
+        on_epoch=logger.log,  # records stream as epochs finish: live
+        # metrics for the user, a liveness signal for supervise.py
     )
-    for rec in history:
-        logger.log(rec)
 
     # allgathers are collective: every process participates...
     params_host = multihost.to_host(state.params)

@@ -158,6 +158,8 @@ def train(
     resume: bool = False,
     trace_file: Optional[str] = None,
     fused_update: bool = False,
+    fault_inject: Optional[str] = None,
+    on_epoch: Optional[Any] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -170,7 +172,18 @@ def train(
     fused_update=True routes the gossip-mix + SGD tail of each step through
     the Pallas fused kernel (ops/fused_update.py) — one HBM read/write per
     parameter element. Gossip algorithms only (allreduce keeps optax).
+
+    fault_inject ("crash:N" or "hang:N") kills or wedges the process right
+    after epoch N's work (post-snapshot) — the fault-injection half of the
+    elastic-recovery story (eventgrad_tpu/supervise.py); the reference has neither
+    (a dead rank just hangs its peers' MPI_Recv, decent.cpp:200-205).
     """
+    fault_mode, fault_epoch = None, -1
+    if fault_inject:
+        fault_mode, _, n = fault_inject.partition(":")
+        if fault_mode not in ("crash", "hang") or not n.isdigit():
+            raise ValueError(f"bad fault_inject spec {fault_inject!r}")
+        fault_epoch = int(n)
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
     state = init_train_state(
         model, x_train.shape[1:], tx, topo, algo, event_cfg, seed=seed
@@ -198,9 +211,16 @@ def train(
                      "trace_carry": trace_carry},
                 )
                 trace_carry = restored["trace_carry"]
-            except Exception:
+            except Exception as e:
                 # snapshot from before the trace carry existed: resume the
-                # training state, let the carry start from zeros
+                # training state, let the carry start from zeros (loud — a
+                # corrupt carry also lands here and recv traces diverge)
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint has no restorable trace_carry ({e!r}); "
+                    "recv-trace staleness restarts from zeros"
+                )
                 restored = checkpoint.restore(
                     found, {"state": state, "epoch": np.int64(0)}
                 )
@@ -286,6 +306,8 @@ def train(
                     {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
                 )
             history.append(rec)
+            if on_epoch is not None:  # live metrics (and liveness signal)
+                on_epoch(rec)
             if ckpt_path and (
                 epoch == epochs or (save_every and epoch % save_every == 0)
             ):
@@ -301,6 +323,11 @@ def train(
                         "trace_carry": trace_carry,
                     },
                 )
+            if epoch == fault_epoch:
+                if fault_mode == "crash":
+                    os._exit(13)
+                while True:  # "hang": alive but no progress (no heartbeat)
+                    time.sleep(3600)
     finally:
         prefetcher.close()
 

@@ -28,6 +28,11 @@ def enable(path: str | None = None) -> str | None:
     )
     if path.lower() in ("0", "off", "none"):
         return None
+    # XLA:CPU AOT reload is brittle across host-feature detection (loader
+    # warns about possible SIGILL); the compile-time win is a TPU concern,
+    # so skip caching when the process resolves to the CPU backend
+    if jax.default_backend() == "cpu":
+        return None
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache every executable, not just the slowest ones

@@ -1,0 +1,111 @@
+"""Elastic recovery: the supervisor detects crashes and hangs, restarts
+from the latest snapshot, and the recovered run finishes the job with the
+exact trajectory of an uninterrupted one. (The reference has no failure
+story: a dead rank blocks its peers' MPI_Recv forever, decent.cpp:200-205.)"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli_args(tmp, tag, extra):
+    return [
+        "--algo", "eventgrad", "--mesh", "ring:4", "--dataset", "synthetic",
+        "--model", "mlp", "--epochs", "3", "--batch-size", "8",
+        "--n-synth", "128", "--warmup-passes", "2",
+        "--log-file", os.path.join(tmp, f"{tag}.jsonl"),
+    ] + extra
+
+
+def _run_supervised(tmp, tag, extra, timeout=0.0, max_restarts=3):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    cmd = [
+        sys.executable, "-m", "eventgrad_tpu.supervise",
+        "--timeout", str(timeout), "--max-restarts", str(max_restarts), "--",
+    ] + _cli_args(tmp, tag, extra)
+    return subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=600
+    )
+
+
+def _records(tmp, tag):
+    with open(os.path.join(tmp, f"{tag}.jsonl")) as f:
+        return [json.loads(l) for l in f]
+
+
+def test_crash_recovery_matches_uninterrupted_run(tmp_path):
+    tmp = str(tmp_path)
+    ck = os.path.join(tmp, "ck")
+
+    straight = _run_supervised(tmp, "straight", ["--checkpoint-dir",
+                                                 os.path.join(tmp, "ck0"),
+                                                 "--save-every", "1"])
+    assert straight.returncode == 0, straight.stderr[-2000:]
+
+    # crash:1 kills the child (exit 13) right after epoch 1's snapshot; the
+    # supervisor must relaunch with --resume and epochs 2-3 must replay the
+    # uninterrupted trajectory exactly
+    crashed = _run_supervised(
+        tmp, "crashed",
+        ["--checkpoint-dir", ck, "--save-every", "1",
+         "--fault-inject", "crash:1"],
+    )
+    assert crashed.returncode == 0, crashed.stderr[-2000:]
+    assert "attempt 1 failed (exit code 13)" in crashed.stderr
+
+    ref = _records(tmp, "straight")
+    got = _records(tmp, "crashed")
+    # log has epoch 1 (first attempt) then epochs 2,3 + final (second)
+    assert [r.get("epoch") for r in got] == [1, 2, 3, None]
+    by_epoch = {r["epoch"]: r for r in ref if "epoch" in r}
+    for r in got[:-1]:
+        np.testing.assert_allclose(r["loss"], by_epoch[r["epoch"]]["loss"],
+                                   atol=1e-6)
+        assert r["num_events"] == by_epoch[r["epoch"]]["num_events"]
+    assert got[-1]["final"] and ref[-1]["final"]
+    np.testing.assert_allclose(got[-1]["accuracy"], ref[-1]["accuracy"],
+                               atol=1e-6)
+
+
+def test_hang_detection_kills_and_recovers(tmp_path):
+    tmp = str(tmp_path)
+    hung = _run_supervised(
+        tmp, "hung",
+        ["--checkpoint-dir", os.path.join(tmp, "ck"), "--save-every", "1",
+         "--fault-inject", "hang:1"],
+        timeout=45.0, max_restarts=1,
+    )
+    assert hung.returncode == 0, hung.stderr[-2000:]
+    assert "no heartbeat" in hung.stderr
+    recs = _records(tmp, "hung")
+    assert [r.get("epoch") for r in recs] == [1, 2, 3, None]
+
+
+def test_supervisor_gives_up_after_max_restarts(tmp_path):
+    tmp = str(tmp_path)
+    # no periodic snapshots -> the resumed run restarts at epoch 1 and hits
+    # the same crash every attempt: the supervisor must stop trying
+    doomed = _run_supervised(
+        tmp, "doomed",
+        ["--checkpoint-dir", os.path.join(tmp, "ck"),
+         "--fault-inject", "crash:1"],
+        max_restarts=1,
+    )
+    assert doomed.returncode == 13
+    assert "giving up" in doomed.stderr
+
+
+def test_supervisor_requires_checkpoint_dir(tmp_path):
+    with pytest.raises(SystemExit):
+        from eventgrad_tpu.supervise import supervise
+
+        supervise(["--algo", "dpsgd"])
