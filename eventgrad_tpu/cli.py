@@ -113,9 +113,6 @@ def main(argv=None) -> int:
     if os.environ.get("JAX_PLATFORMS") == "cpu" and jax.config.jax_platforms != "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    from eventgrad_tpu.utils import compile_cache
-
-    compile_cache.enable()
     args = build_parser().parse_args(argv)
     topo = args.mesh  # argparse already applied parse_mesh (also to the default)
 
@@ -123,6 +120,12 @@ def main(argv=None) -> int:
         if args.backend != "mesh":
             raise SystemExit("--coordinator requires --backend mesh")
         multihost.init(args.coordinator, args.num_processes, args.process_id)
+
+    # after distributed init — resolving the backend would otherwise
+    # initialize it and break jax.distributed.initialize's ordering contract
+    from eventgrad_tpu.utils import compile_cache
+
+    compile_cache.enable()
 
     primary = multihost.is_primary()
     logger = JsonlLogger(
@@ -152,7 +155,7 @@ def main(argv=None) -> int:
         warmup_passes=args.warmup_passes,
         history=args.history,
     )
-    state, history = train(
+    state, _ = train(
         model, topo, x, y,
         algo=args.algo, epochs=args.epochs, batch_size=batch,
         learning_rate=args.lr, momentum=args.momentum,

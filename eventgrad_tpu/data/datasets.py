@@ -72,7 +72,56 @@ def load_mnist(
     return x, labels.astype(np.int32)
 
 
+# the reference's folder-name -> label map (custom.hpp:15-19 uses the same
+# alphabetical CIFAR-10 class order)
+CIFAR10_CLASSES = (
+    "airplane", "automobile", "bird", "cat", "deer",
+    "dog", "frog", "horse", "ship", "truck",
+)
+
+
+def load_cifar10_jpeg_dir(
+    data_dir: str, split: str = "train", image_size: int = 32
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The reference's raw-JPEG CIFAR-10 layout (`<root>/<split>/<class>/
+    NNNN.jpg`, the "CIFAR-10-images" mirror — custom.hpp:66-122): walk the
+    class folders, decode+resize natively (libjpeg + bilinear, standing in
+    for cv::imread/cv::resize, custom.hpp:33-41). Deterministic file order
+    (sorted); shuffling is the sampler layer's job, unlike the reference's
+    hidden global random_shuffle (custom.hpp:119-120)."""
+    from eventgrad_tpu.data import native
+
+    root = os.path.join(data_dir, split)
+    paths: list = []
+    labels: list = []
+    for label, cls in enumerate(CIFAR10_CLASSES):
+        cls_dir = os.path.join(root, cls)
+        if not os.path.isdir(cls_dir):
+            continue
+        for name in sorted(os.listdir(cls_dir)):
+            if name.lower().endswith((".jpg", ".jpeg")):
+                paths.append(os.path.join(cls_dir, name))
+                labels.append(label)
+    if not paths:
+        raise FileNotFoundError(f"no <class>/*.jpg under {root}")
+    x = np.empty((len(paths), image_size, image_size, 3), np.float32)
+    for i, p in enumerate(paths):
+        x[i] = native.load_jpeg_image(p, image_size)
+    return x, np.asarray(labels, np.int32)
+
+
 def load_cifar10(data_dir: str, split: str = "train") -> Tuple[np.ndarray, np.ndarray]:
+    # raw-JPEG directory mirror (the reference's own format) takes priority
+    # when present AND decodable; a libjpeg-less build falls through to the
+    # binary/pickle formats (and ultimately the synthetic fallback)
+    if os.path.isdir(os.path.join(data_dir, split)) and any(
+        os.path.isdir(os.path.join(data_dir, split, c)) for c in CIFAR10_CLASSES
+    ):
+        from eventgrad_tpu.data import native
+
+        if native.jpeg_supported():
+            return load_cifar10_jpeg_dir(data_dir, split)
+
     bin_names = (
         [f"data_batch_{i}.bin" for i in range(1, 6)]
         if split == "train"

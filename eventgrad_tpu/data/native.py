@@ -22,10 +22,10 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(force: bool = False) -> bool:
     try:
         subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)] + (["-B"] if force else []),
             check=True,
             capture_output=True,
             timeout=120,
@@ -36,18 +36,29 @@ def _build() -> bool:
 
 
 def load_library() -> Optional[ctypes.CDLL]:
-    """The shared library, building it on demand; None if unavailable."""
+    """The shared library, building it on demand; None if unavailable.
+    A stale .so from an older commit (missing newer symbols) triggers one
+    forced rebuild before giving up."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
     if not os.path.exists(_LIB_PATH) and not _build():
         return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
-        return None
+    for attempt in (0, 1):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _bind(lib)
+        except (OSError, AttributeError):
+            if attempt == 0 and _build(force=True):
+                continue
+            return None
+        _lib = lib
+        return _lib
+    return None
 
+
+def _bind(lib: ctypes.CDLL) -> None:
     i64, i32, f32, u64 = (
         ctypes.c_int64,
         ctypes.c_int32,
@@ -68,9 +79,15 @@ def load_library() -> Optional[ctypes.CDLL]:
     lib.eg_gather.argtypes = [pf, i64, pi64, i64, pf]
     lib.eg_gather_i32.restype = None
     lib.eg_gather_i32.argtypes = [pi32, pi64, i64, pi32]
+    pu8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.eg_jpeg_supported.restype = ctypes.c_int
+    lib.eg_load_jpeg_image.restype = ctypes.c_int
+    lib.eg_load_jpeg_image.argtypes = [ctypes.c_char_p, pf, i32]
+    lib.eg_jpeg_encode_file.restype = ctypes.c_int
+    lib.eg_jpeg_encode_file.argtypes = [ctypes.c_char_p, pu8, i32, i32, i32]
+    lib.eg_resize_bilinear_rgb.restype = None
+    lib.eg_resize_bilinear_rgb.argtypes = [pu8, i32, i32, pu8, i32, i32]
     lib.eg_version.restype = ctypes.c_int
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:
@@ -111,6 +128,44 @@ def load_mnist_idx(
     if got < 0:
         return None
     return x[: int(got)], y[: int(got)]
+
+
+def jpeg_supported() -> bool:
+    lib = load_library()
+    return bool(lib is not None and lib.eg_jpeg_supported())
+
+
+def load_jpeg_image(path: str, image_size: int = 32) -> np.ndarray:
+    """Decode one JPEG to [image_size, image_size, 3] RGB float32 in [0,1]
+    (libjpeg decode + bilinear resize, the reference's imread+resize,
+    custom.hpp:33-41). Raises on unsupported builds or bad files."""
+    lib = load_library()
+    if lib is None or not lib.eg_jpeg_supported():
+        raise RuntimeError(
+            "JPEG support needs native/libeg_dataio.so built against libjpeg"
+        )
+    out = np.empty((image_size, image_size, 3), np.float32)
+    rc = lib.eg_load_jpeg_image(str(path).encode(), out.reshape(-1), image_size)
+    if rc != 0:
+        raise ValueError(f"JPEG decode failed for {path!r} (rc={rc})")
+    return out
+
+
+def save_jpeg(path: str, rgb: np.ndarray, quality: int = 90) -> None:
+    """Encode an HWC uint8 RGB array to a JPEG file (fixtures / export)."""
+    lib = load_library()
+    if lib is None or not lib.eg_jpeg_supported():
+        raise RuntimeError(
+            "JPEG support needs native/libeg_dataio.so built against libjpeg"
+        )
+    rgb = np.ascontiguousarray(rgb, np.uint8)
+    if rgb.ndim != 3 or rgb.shape[2] != 3:
+        raise ValueError(f"expected HWC RGB uint8, got shape {rgb.shape}")
+    rc = lib.eg_jpeg_encode_file(
+        str(path).encode(), rgb.reshape(-1), rgb.shape[1], rgb.shape[0], quality
+    )
+    if rc != 0:
+        raise ValueError(f"JPEG encode failed for {path!r} (rc={rc})")
 
 
 def shard_plan(

@@ -103,6 +103,12 @@ def supervise(
                 last_hb_check = now
                 last_hb = _latest_mtime(heartbeat)
             if now - max(started, last_hb) > timeout:
+                # the cached mtime may be up to hb_every stale — re-stat
+                # before declaring a live child hung
+                last_hb_check = now
+                last_hb = _latest_mtime(heartbeat)
+                if now - max(started, last_hb) <= timeout:
+                    continue
                 reason = f"no heartbeat on {heartbeat} for {timeout:.0f}s"
                 _terminate(proc)
                 break

@@ -30,8 +30,12 @@ def enable(path: str | None = None) -> str | None:
         return None
     # XLA:CPU AOT reload is brittle across host-feature detection (loader
     # warns about possible SIGILL); the compile-time win is a TPU concern,
-    # so skip caching when the process resolves to the CPU backend
-    if jax.default_backend() == "cpu":
+    # so skip caching when the process resolves to the CPU backend. Prefer
+    # the config pin — resolving the backend initializes it, which callers
+    # may not be ready for (jax.distributed.initialize must come first).
+    plats = (jax.config.jax_platforms or "").split(",")
+    backend = plats[0] if plats and plats[0] else jax.default_backend()
+    if backend == "cpu":
         return None
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)

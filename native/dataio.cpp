@@ -16,8 +16,14 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+
+#ifdef EG_HAVE_LIBJPEG
+#include <setjmp.h>
+#include <jpeglib.h>
+#endif
 
 extern "C" {
 
@@ -149,6 +155,200 @@ void eg_gather_i32(const int32_t *src, const int64_t *idx, int64_t count,
   for (int64_t i = 0; i < count; ++i) dst[i] = src[idx[i]];
 }
 
-int eg_version(void) { return 1; }
+// ---------------------------------------------------------------------------
+// JPEG pipeline — the role OpenCV plays in the reference (cv::imread +
+// cv::resize to image_size, custom.hpp:33-41), on libjpeg with a bilinear
+// resampler (half-pixel centers, cv::INTER_LINEAR's mapping). Output is RGB
+// interleaved; the reference reads BGR and reorders to RGB itself
+// (custom.hpp:45-59) — same end state. The encoder exists for fixture
+// generation and dataset export (no network egress in dev environments).
+//
+// Return codes: 0 ok; -1 io error; -2 image larger than caller capacity;
+// -3 malformed stream; -9 built without libjpeg.
+// ---------------------------------------------------------------------------
+#ifdef EG_HAVE_LIBJPEG
+
+struct EgJpegErr {
+  struct jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+static void eg_jpeg_error_exit(j_common_ptr cinfo) {
+  longjmp(((EgJpegErr *)cinfo->err)->jb, 1);  // default handler exit()s
+}
+
+int eg_jpeg_supported(void) { return 1; }
+
+// header-only parse: dimensions without decoding (cheap — a few KB of IO)
+int eg_jpeg_header(const char *path, int32_t *w, int32_t *h) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  struct jpeg_decompress_struct cinfo;
+  EgJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = eg_jpeg_error_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return -3;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  *w = (int32_t)cinfo.image_width;
+  *h = (int32_t)cinfo.image_height;
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return 0;
+}
+
+int eg_jpeg_decode_file(const char *path, uint8_t *out, int32_t cap_w,
+                        int32_t cap_h, int32_t *w, int32_t *h) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  struct jpeg_decompress_struct cinfo;
+  EgJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = eg_jpeg_error_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return -3;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // grayscale/YCbCr all land as RGB
+  jpeg_start_decompress(&cinfo);
+  *w = (int32_t)cinfo.output_width;
+  *h = (int32_t)cinfo.output_height;
+  if (*w > cap_w || *h > cap_h) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return -2;
+  }
+  const int stride = *w * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + (size_t)cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return 0;
+}
+
+int eg_jpeg_encode_file(const char *path, const uint8_t *rgb, int32_t w,
+                        int32_t h, int32_t quality) {
+  FILE *f = fopen(path, "wb");
+  if (!f) return -1;
+  struct jpeg_compress_struct cinfo;
+  EgJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = eg_jpeg_error_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    fclose(f);
+    return -3;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_stdio_dest(&cinfo, f);
+  cinfo.image_width = (JDIMENSION)w;
+  cinfo.image_height = (JDIMENSION)h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  const int stride = w * 3;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = (JSAMPROW)(rgb + (size_t)cinfo.next_scanline * stride);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  fclose(f);
+  return 0;
+}
+
+#else  // !EG_HAVE_LIBJPEG
+
+int eg_jpeg_supported(void) { return 0; }
+int eg_jpeg_header(const char *, int32_t *, int32_t *) { return -9; }
+int eg_jpeg_decode_file(const char *, uint8_t *, int32_t, int32_t, int32_t *,
+                        int32_t *) { return -9; }
+int eg_jpeg_encode_file(const char *, const uint8_t *, int32_t, int32_t,
+                        int32_t) { return -9; }
+
+#endif  // EG_HAVE_LIBJPEG
+
+// Bilinear resample with half-pixel centers (cv::INTER_LINEAR's mapping),
+// RGB interleaved. Identity sizes short-circuit to a memcpy.
+void eg_resize_bilinear_rgb(const uint8_t *src, int32_t w, int32_t h,
+                            uint8_t *dst, int32_t ow, int32_t oh) {
+  if (w == ow && h == oh) {
+    memcpy(dst, src, (size_t)w * h * 3);
+    return;
+  }
+  const float sx = (float)w / (float)ow, sy = (float)h / (float)oh;
+  for (int32_t y = 0; y < oh; ++y) {
+    float fy = ((float)y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > (float)(h - 1)) fy = (float)(h - 1);
+    const int32_t y0 = (int32_t)fy;
+    const int32_t y1 = (y0 + 1 < h) ? y0 + 1 : y0;
+    const float ty = fy - (float)y0;
+    for (int32_t x = 0; x < ow; ++x) {
+      float fx = ((float)x + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      if (fx > (float)(w - 1)) fx = (float)(w - 1);
+      const int32_t x0 = (int32_t)fx;
+      const int32_t x1 = (x0 + 1 < w) ? x0 + 1 : x0;
+      const float tx = fx - (float)x0;
+      for (int c = 0; c < 3; ++c) {
+        const float v00 = src[((size_t)y0 * w + x0) * 3 + c];
+        const float v01 = src[((size_t)y0 * w + x1) * 3 + c];
+        const float v10 = src[((size_t)y1 * w + x0) * 3 + c];
+        const float v11 = src[((size_t)y1 * w + x1) * 3 + c];
+        const float top = v00 + (v01 - v00) * tx;
+        const float bot = v10 + (v11 - v10) * tx;
+        const float v = top + (bot - top) * ty;
+        dst[((size_t)y * ow + x) * 3 + c] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+// One-shot loader: JPEG file -> image_size x image_size RGB float32 NHWC in
+// [0,1] (the framework's input convention; the reference keeps raw 0..255
+// CHW floats, custom.hpp:46-59 — a constant input scale, noted in PARITY).
+// Returns 0 or the decoder's error code.
+int eg_load_jpeg_image(const char *path, float *out, int32_t image_size) {
+  int32_t w = 0, h = 0;
+  int rc = eg_jpeg_header(path, &w, &h);
+  if (rc != 0) return rc;
+  uint8_t *raw = (uint8_t *)malloc((size_t)w * h * 3);
+  if (!raw) return -1;
+  rc = eg_jpeg_decode_file(path, raw, w, h, &w, &h);
+  if (rc != 0) {
+    free(raw);
+    return rc;
+  }
+  uint8_t *small = (uint8_t *)malloc((size_t)image_size * image_size * 3);
+  if (!small) {
+    free(raw);
+    return -1;
+  }
+  eg_resize_bilinear_rgb(raw, w, h, small, image_size, image_size);
+  const int64_t px = (int64_t)image_size * image_size * 3;
+  const float inv = 1.0f / 255.0f;
+  for (int64_t i = 0; i < px; ++i) out[i] = (float)small[i] * inv;
+  free(small);
+  free(raw);
+  return 0;
+}
+
+int eg_version(void) { return 2; }
 
 }  // extern "C"

@@ -18,7 +18,7 @@ def lib():
 
 
 def test_version(lib):
-    assert lib.eg_version() == 1
+    assert lib.eg_version() == 2
 
 
 def test_shard_plan_matches_shapes(lib):
@@ -93,3 +93,76 @@ def test_mnist_idx_native(lib):
     np.testing.assert_array_equal(y, labs.astype(np.int32))
     expect = (imgs.astype(np.float32) / 255.0 - 0.1307) / 0.3081
     np.testing.assert_allclose(x.squeeze(-1), expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# JPEG pipeline (libjpeg decode/encode + bilinear resize; D2 in SURVEY §2.4)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jpeg(lib):
+    if not native.jpeg_supported():
+        pytest.skip("libeg_dataio built without libjpeg")
+    return lib
+
+
+def test_jpeg_roundtrip_high_quality(jpeg, tmp_path):
+    # smooth image: JPEG at q=95 should reproduce it closely
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
+    img = np.stack(
+        [127 + 120 * np.sin(xx / 7), 127 + 120 * np.cos(yy / 9),
+         127 * np.ones_like(xx)], -1
+    ).astype(np.uint8)
+    p = str(tmp_path / "a.jpg")
+    native.save_jpeg(p, img, quality=95)
+    out = native.load_jpeg_image(p, 32)
+    assert out.shape == (32, 32, 3) and out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 1.0
+    err = np.abs(out * 255.0 - img.astype(np.float32))
+    assert err.mean() < 3.0, err.mean()  # near-lossless at q=95
+
+
+def test_jpeg_resize_to_dataset_size(jpeg, tmp_path):
+    # constant-color 64x64 must resize to the exact same color at 32x32
+    img = np.full((64, 64, 3), (10, 200, 90), np.uint8)
+    p = str(tmp_path / "big.jpg")
+    native.save_jpeg(p, img, quality=98)
+    out = native.load_jpeg_image(p, 32)
+    np.testing.assert_allclose(
+        out.reshape(-1, 3).mean(0) * 255.0, (10, 200, 90), atol=3.0
+    )
+
+
+def test_jpeg_decode_rejects_garbage(jpeg, tmp_path):
+    p = str(tmp_path / "bad.jpg")
+    with open(p, "wb") as f:
+        f.write(b"this is not a jpeg at all")
+    with pytest.raises(ValueError):
+        native.load_jpeg_image(p, 32)
+
+
+def test_cifar10_jpeg_dir_loader(jpeg, tmp_path):
+    from eventgrad_tpu.data.datasets import (
+        CIFAR10_CLASSES, load_cifar10, load_cifar10_jpeg_dir,
+    )
+
+    rng = np.random.default_rng(3)
+    for split in ("train", "test"):
+        for cls in CIFAR10_CLASSES[:3]:  # 3 classes suffice
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            n = 4 if split == "train" else 2
+            for i in range(n):
+                img = rng.integers(0, 256, (32, 32, 3)).astype(np.uint8)
+                native.save_jpeg(str(d / f"{i:04d}.jpg"), img, quality=92)
+
+    x, y = load_cifar10_jpeg_dir(str(tmp_path), "train")
+    assert x.shape == (12, 32, 32, 3)
+    assert [int((y == l).sum()) for l in range(3)] == [4, 4, 4]
+    assert 0.0 <= x.min() and x.max() <= 1.0
+
+    # load_cifar10 auto-detects the directory layout
+    x2, y2 = load_cifar10(str(tmp_path), "test")
+    assert x2.shape == (6, 32, 32, 3)
+    np.testing.assert_array_equal(np.unique(y2), [0, 1, 2])
