@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
+import tempfile
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -20,6 +23,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libeg_dataio.so"))
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_lock = threading.Lock()
 
 
 def _build(force: bool = False) -> bool:
@@ -38,24 +42,35 @@ def _build(force: bool = False) -> bool:
 def load_library() -> Optional[ctypes.CDLL]:
     """The shared library, building it on demand; None if unavailable.
     A stale .so from an older commit (missing newer symbols) triggers one
-    forced rebuild before giving up."""
+    forced rebuild before giving up. Thread-safe (first JPEG use may come
+    from a decode pool)."""
     global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    if not os.path.exists(_LIB_PATH) and not _build():
-        return None
-    for attempt in (0, 1):
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _bind(lib)
         except (OSError, AttributeError):
-            if attempt == 0 and _build(force=True):
-                continue
-            return None
+            # stale build: rebuild, then load through a fresh temp copy —
+            # dlopen caches by path, so reloading _LIB_PATH in-process
+            # would hand back the old mapping
+            if not _build(force=True):
+                return None
+            try:
+                with tempfile.NamedTemporaryFile(
+                    suffix=".so", delete=False
+                ) as tf:
+                    shutil.copyfile(_LIB_PATH, tf.name)
+                lib = ctypes.CDLL(tf.name)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
-    return None
 
 
 def _bind(lib: ctypes.CDLL) -> None:

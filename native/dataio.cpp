@@ -325,16 +325,45 @@ void eg_resize_bilinear_rgb(const uint8_t *src, int32_t w, int32_t h,
 // CHW floats, custom.hpp:46-59 — a constant input scale, noted in PARITY).
 // Returns 0 or the decoder's error code.
 int eg_load_jpeg_image(const char *path, float *out, int32_t image_size) {
-  int32_t w = 0, h = 0;
-  int rc = eg_jpeg_header(path, &w, &h);
-  if (rc != 0) return rc;
-  uint8_t *raw = (uint8_t *)malloc((size_t)w * h * 3);
-  if (!raw) return -1;
-  rc = eg_jpeg_decode_file(path, raw, w, h, &w, &h);
-  if (rc != 0) {
+#ifdef EG_HAVE_LIBJPEG
+  // single pass: one fopen + header parse, buffer sized from the header
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  struct jpeg_decompress_struct cinfo;
+  EgJpegErr err;
+  // volatile: assigned between setjmp and a potential longjmp, read after
+  uint8_t *volatile raw = nullptr;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = eg_jpeg_error_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
     free(raw);
-    return rc;
+    fclose(f);
+    return -3;
   }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int32_t w = (int32_t)cinfo.output_width;
+  const int32_t h = (int32_t)cinfo.output_height;
+  raw = (uint8_t *)malloc((size_t)w * h * 3);
+  if (!raw) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return -1;
+  }
+  const int stride = w * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = raw + (size_t)cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+
   uint8_t *small = (uint8_t *)malloc((size_t)image_size * image_size * 3);
   if (!small) {
     free(raw);
@@ -347,6 +376,12 @@ int eg_load_jpeg_image(const char *path, float *out, int32_t image_size) {
   free(small);
   free(raw);
   return 0;
+#else
+  (void)path;
+  (void)out;
+  (void)image_size;
+  return -9;
+#endif
 }
 
 int eg_version(void) { return 2; }
