@@ -42,6 +42,7 @@ def main() -> None:
 
     from eventgrad_tpu.utils import compile_cache
 
+    compile_cache.honor_cpu_pin()
     compile_cache.enable()
 
     from eventgrad_tpu.data.datasets import load_or_synthesize
@@ -134,5 +135,47 @@ def main() -> None:
     )
 
 
+def _supervised() -> None:
+    """Run main() in a child with a deadline. The accelerator tunnel can
+    wedge a blocked device op forever (no Python-level interrupt works);
+    a supervising parent is the only reliable watchdog. On timeout the
+    child is killed and one retry runs; if that also stalls, a diagnostic
+    JSON line is emitted so the harness always gets its one line."""
+    import subprocess
+    import sys
+
+    deadline = float(os.environ.get("EG_BENCH_DEADLINE_S", "4500"))
+    env = dict(os.environ, EG_BENCH_CHILD="1")
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=deadline, stdout=subprocess.PIPE, text=True,
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                print(proc.stdout.strip().splitlines()[-1])
+                return
+        except subprocess.TimeoutExpired:
+            pass
+        print(
+            f"bench attempt {attempt} stalled/failed (deadline {deadline}s)",
+            file=sys.stderr, flush=True,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet_eventgrad_msgs_saved",
+                "value": 0.0,
+                "unit": "%",
+                "vs_baseline": 0.0,
+                "error": "device stalled or bench failed twice; see stderr",
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("EG_BENCH_CHILD") == "1":
+        main()
+    else:
+        _supervised()

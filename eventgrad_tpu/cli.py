@@ -103,16 +103,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic-recovery drill: crash:N exits 13 after "
                         "epoch N (post-snapshot), hang:N stops making "
                         "progress — pair with eventgrad_tpu.supervise")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler (XPlane/TensorBoard) trace "
+                        "of the training run into this directory")
     return p
 
 
 def main(argv=None) -> int:
-    # honor an explicit CPU pin even when an accelerator plugin registered
-    # itself ahead of the env var (jax config may read "plugin,cpu"); must
-    # happen before the first backend use
-    if os.environ.get("JAX_PLATFORMS") == "cpu" and jax.config.jax_platforms != "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from eventgrad_tpu.utils import compile_cache
 
+    compile_cache.honor_cpu_pin()
     args = build_parser().parse_args(argv)
     topo = args.mesh  # argparse already applied parse_mesh (also to the default)
 
@@ -155,19 +155,28 @@ def main(argv=None) -> int:
         warmup_passes=args.warmup_passes,
         history=args.history,
     )
-    state, _ = train(
-        model, topo, x, y,
-        algo=args.algo, epochs=args.epochs, batch_size=batch,
-        learning_rate=args.lr, momentum=args.momentum,
-        event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
-        augment=args.augment, random_sampler=args.random_sampler,
-        sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
-        checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
-        resume=args.resume, trace_file=args.trace_file,
-        fused_update=args.fused, fault_inject=args.fault_inject,
-        on_epoch=logger.log,  # records stream as epochs finish: live
-        # metrics for the user, a liveness signal for supervise.py
+    import contextlib
+
+    from eventgrad_tpu.utils import profiling
+
+    scope = (
+        profiling.trace(args.profile_dir) if args.profile_dir
+        else contextlib.nullcontext()
     )
+    with scope:
+        state, _ = train(
+            model, topo, x, y,
+            algo=args.algo, epochs=args.epochs, batch_size=batch,
+            learning_rate=args.lr, momentum=args.momentum,
+            event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
+            augment=args.augment, random_sampler=args.random_sampler,
+            sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
+            checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
+            resume=args.resume, trace_file=args.trace_file,
+            fused_update=args.fused, fault_inject=args.fault_inject,
+            on_epoch=logger.log,  # records stream as epochs finish: live
+            # metrics for the user, a liveness signal for supervise.py
+        )
 
     # allgathers are collective: every process participates...
     params_host = multihost.to_host(state.params)

@@ -66,6 +66,8 @@ def load_library() -> Optional[ctypes.CDLL]:
                 ) as tf:
                     shutil.copyfile(_LIB_PATH, tf.name)
                 lib = ctypes.CDLL(tf.name)
+                # the dlopen mapping outlives the name; don't leak the copy
+                os.unlink(tf.name)
                 _bind(lib)
             except (OSError, AttributeError):
                 return None

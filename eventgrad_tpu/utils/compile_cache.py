@@ -20,6 +20,15 @@ import jax
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def honor_cpu_pin() -> None:
+    """Honor an explicit JAX_PLATFORMS=cpu env pin over accelerator plugins
+    that registered themselves ahead of it (jax config may read
+    "plugin,cpu"). Must run before the first backend use; shared by the
+    CLI and bench entry points."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
 def enable(path: str | None = None) -> str | None:
     """Turn on the persistent compilation cache; returns the dir (or None
     when disabled via EG_COMPILE_CACHE=off/0)."""

@@ -63,7 +63,10 @@ def trace(log_dir: str):
         jax.profiler.start_trace(log_dir)
         started = True
     except Exception as e:  # pragma: no cover - backend dependent
-        print(f"[profiling] trace unavailable: {e}")
+        import sys
+
+        # stderr: stdout may carry a JSONL metrics stream (cli.py)
+        print(f"[profiling] trace unavailable: {e}", file=sys.stderr)
     try:
         yield
     finally:
