@@ -152,9 +152,15 @@ def _supervised() -> None:
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, timeout=deadline, stdout=subprocess.PIPE, text=True,
             )
-            if proc.returncode == 0 and proc.stdout.strip():
-                print(proc.stdout.strip().splitlines()[-1])
-                return
+            # accept any run that produced a parseable metric line — a
+            # teardown crash after a completed measurement is still a result
+            for line in reversed(proc.stdout.strip().splitlines() or []):
+                try:
+                    if "metric" in json.loads(line):
+                        print(line)
+                        return
+                except json.JSONDecodeError:
+                    continue
         except subprocess.TimeoutExpired:
             pass
         print(

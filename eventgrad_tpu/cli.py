@@ -121,10 +121,9 @@ def main(argv=None) -> int:
             raise SystemExit("--coordinator requires --backend mesh")
         multihost.init(args.coordinator, args.num_processes, args.process_id)
 
-    # after distributed init — resolving the backend would otherwise
-    # initialize it and break jax.distributed.initialize's ordering contract
-    from eventgrad_tpu.utils import compile_cache
-
+    # enable() only after distributed init — resolving the backend would
+    # otherwise initialize it and break jax.distributed.initialize's
+    # ordering contract
     compile_cache.enable()
 
     primary = multihost.is_primary()

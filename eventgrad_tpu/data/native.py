@@ -60,17 +60,24 @@ def load_library() -> Optional[ctypes.CDLL]:
             # would hand back the old mapping
             if not _build(force=True):
                 return None
+            tmp_name = None
             try:
                 with tempfile.NamedTemporaryFile(
                     suffix=".so", delete=False
                 ) as tf:
-                    shutil.copyfile(_LIB_PATH, tf.name)
-                lib = ctypes.CDLL(tf.name)
-                # the dlopen mapping outlives the name; don't leak the copy
-                os.unlink(tf.name)
+                    tmp_name = tf.name
+                shutil.copyfile(_LIB_PATH, tmp_name)
+                lib = ctypes.CDLL(tmp_name)
                 _bind(lib)
             except (OSError, AttributeError):
                 return None
+            finally:
+                # the dlopen mapping outlives the name; never leak the copy
+                if tmp_name is not None:
+                    try:
+                        os.unlink(tmp_name)
+                    except OSError:
+                        pass
         _lib = lib
         return _lib
 

@@ -24,8 +24,9 @@ BATCH = 8
 STEPS = 6
 
 
-def _run(algo, backend="vmap", event_cfg=None, sparse_cfg=None, lr=0.05):
-    topo = Ring(N_RANKS)
+def _run(algo, backend="vmap", event_cfg=None, sparse_cfg=None, lr=0.05,
+         topo=None):
+    topo = topo or Ring(N_RANKS)
     model = MLP(hidden=16)
     tx = optax.sgd(lr)
     x, y = synthetic_dataset(N_RANKS * BATCH * STEPS, (28, 28, 1), seed=3)
@@ -80,6 +81,37 @@ def test_eventgrad_threshold0_equals_dpsgd():
         jax.tree.leaves(_params_np(st_event)), jax.tree.leaves(_params_np(st_dpsgd))
     ):
         np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+def test_eventgrad_threshold0_equals_dpsgd_on_torus():
+    """The same equivalence must hold on the 2D torus (4 neighbors, /5
+    mixing) — the BASELINE stress topology the reference never had."""
+    from eventgrad_tpu.parallel.topology import Torus
+
+    cfg = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
+    st_event, _ = _run("eventgrad", event_cfg=cfg, topo=Torus(2, 2))
+    st_dpsgd, _ = _run("dpsgd", topo=Torus(2, 2))
+    for a, b in zip(
+        jax.tree.leaves(_params_np(st_event)), jax.tree.leaves(_params_np(st_dpsgd))
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+def test_torus_differs_from_ring_after_divergence():
+    """Sanity: the torus actually mixes differently than the ring once
+    per-rank shards diverge (guards against axis wiring collapsing to a
+    single neighborhood)."""
+    from eventgrad_tpu.parallel.topology import Torus
+
+    st_ring, _ = _run("dpsgd")
+    st_torus, _ = _run("dpsgd", topo=Torus(2, 2))
+    diffs = [
+        float(np.abs(a - b).max())
+        for a, b in zip(
+            jax.tree.leaves(_params_np(st_ring)), jax.tree.leaves(_params_np(st_torus))
+        )
+    ]
+    assert max(diffs) > 1e-6, diffs
 
 
 def test_sparse_topk100_equals_dense_eventgrad():
