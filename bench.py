@@ -156,11 +156,12 @@ def _supervised() -> None:
             # teardown crash after a completed measurement is still a result
             for line in reversed(proc.stdout.strip().splitlines() or []):
                 try:
-                    if "metric" in json.loads(line):
-                        print(line)
-                        return
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    print(line)
+                    return
         except subprocess.TimeoutExpired:
             pass
         print(

@@ -49,7 +49,8 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
 
     Send side (send{r}.txt, event.cpp:337-339,385-391): one record per
     (pass, rank) with per-parameter norm/thres/fired vectors in leaf-major
-    order. Receive side (recv{r}.txt, event.cpp:418-425,446-461): one record
+    order, plus the step's train loss (= train{r}.txt, the per-step loss
+    file of dcifar10/event/event.cpp:271-273). Receive side (recv{r}.txt, event.cpp:418-425,446-461): one record
     per (pass, rank, neighbor) with the received-buffer norm and a changed
     bit — here derived deterministically from the sender's fire bit, with
     `carry` holding the stale norm between messages (the buffers start as
@@ -59,6 +60,7 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
     fired_all = np.asarray(m["trace_fired"])
     norm_all = np.asarray(m["trace_norm"])
     thres_all = np.asarray(m["trace_thres"])
+    loss_all = np.asarray(m["loss"])
     specs = topo.neighbors
     last = carry["recv_norm"]
     srcs = [
@@ -83,6 +85,7 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
                         {
                             "pass": pass_base + s_i + 1,
                             "rank": r,
+                            "loss": round(float(loss_all[s_i, r]), 6),
                             "norm": [round(float(v), 6) for v in norm_all[s_i, r]],
                             "thres": [round(float(v), 6) for v in thres_all[s_i, r]],
                             "fired": [int(v) for v in fired_all[s_i, r]],

@@ -36,6 +36,7 @@ def test_trace_file_records_send_decisions(tmp_path):
 
     for r in recs:
         assert len(r["norm"]) == len(r["thres"]) == len(r["fired"]) == 4
+        assert np.isfinite(r["loss"])  # train{r}.txt: per-step loss rides along
         if r["pass"] <= 1:  # warmup: pass_num < warmup_passes always fires
             assert all(f == 1 for f in r["fired"])
 

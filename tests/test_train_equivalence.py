@@ -27,10 +27,11 @@ STEPS = 6
 def _run(algo, backend="vmap", event_cfg=None, sparse_cfg=None, lr=0.05,
          topo=None):
     topo = topo or Ring(N_RANKS)
+    n = topo.n_ranks
     model = MLP(hidden=16)
     tx = optax.sgd(lr)
-    x, y = synthetic_dataset(N_RANKS * BATCH * STEPS, (28, 28, 1), seed=3)
-    xb, yb = batched_epoch(x, y, N_RANKS, BATCH)
+    x, y = synthetic_dataset(n * BATCH * STEPS, (28, 28, 1), seed=3)
+    xb, yb = batched_epoch(x, y, n, BATCH)
 
     state = init_train_state(model, (28, 28, 1), tx, topo, algo, event_cfg)
     step = make_train_step(
@@ -85,12 +86,14 @@ def test_eventgrad_threshold0_equals_dpsgd():
 
 def test_eventgrad_threshold0_equals_dpsgd_on_torus():
     """The same equivalence must hold on the 2D torus (4 neighbors, /5
-    mixing) — the BASELINE stress topology the reference never had."""
+    mixing) — the BASELINE stress topology the reference never had. 2x4 so
+    the four neighbor directions hit distinct ranks (a 2x2 torus aliases
+    -1/+1 on every axis and would hide swapped-direction wiring bugs)."""
     from eventgrad_tpu.parallel.topology import Torus
 
     cfg = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
-    st_event, _ = _run("eventgrad", event_cfg=cfg, topo=Torus(2, 2))
-    st_dpsgd, _ = _run("dpsgd", topo=Torus(2, 2))
+    st_event, _ = _run("eventgrad", event_cfg=cfg, topo=Torus(2, 4))
+    st_dpsgd, _ = _run("dpsgd", topo=Torus(2, 4))
     for a, b in zip(
         jax.tree.leaves(_params_np(st_event)), jax.tree.leaves(_params_np(st_dpsgd))
     ):
