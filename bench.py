@@ -12,8 +12,8 @@ All 8 ranks are vmap-simulated on the local accelerator (the single-chip
 lifting path; identical trajectories to the shard_map path per
 test_train_equivalence.py::test_shard_map_matches_vmap).
 
-Data: synthetic teacher-labeled CIFAR-shaped set (no network egress here).
-Augmentation stays OFF for synthetic data — the fixed linear teacher's
+Data: synthetic class-prototype CIFAR-shaped set (no network egress here).
+Augmentation stays OFF for synthetic data — the class prototypes'
 labels are not crop/flip-invariant, so the reference's pad4+flip+crop would
 destroy the learning signal (the real-data CLI path applies it).
 

@@ -11,7 +11,7 @@ loader / CIFAR-10 via an OpenCV JPEG walker (custom.hpp:26-122). Here:
     (data_batch_{1..5}.bin / test_batch.bin) or the python-pickle version,
     scaled to [0,1] float32 like OpenCV's CV_32FC3 convertTo path.
   * `synthetic_dataset(...)` builds a deterministic, *learnable* stand-in
-    (random inputs labeled by a fixed random teacher network) so every
+    (noisy class-prototype images) so every
     algorithm, test, and benchmark runs hermetically when no dataset is on
     disk (this environment has no network egress).
 
@@ -191,20 +191,23 @@ def synthetic_dataset(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic learnable classification task.
 
-    Inputs are unit Gaussians; labels come from a fixed random linear teacher
-    over the flattened input, so models genuinely reduce loss and the event
-    dynamics (norm drift, threshold adaptation) exercise realistically.
+    Each class has a fixed random prototype image; a sample is its class
+    prototype at moderate SNR plus Gaussian noise. Convolutional and dense
+    models alike genuinely learn it (unlike a flat linear-teacher labeling,
+    which pooling architectures cannot fit), so losses fall, parameters
+    settle, and the event dynamics (norm drift, threshold adaptation,
+    post-convergence message savings) exercise the way real data does.
     `split` offsets the sample stream so train/test are disjoint.
     """
     rng = np.random.default_rng(seed)
-    teacher = rng.standard_normal((int(np.prod(image_shape)), num_classes)).astype(
+    protos = rng.standard_normal((num_classes,) + tuple(image_shape)).astype(
         np.float32
     )
     offset = 0 if split == "train" else 1_000_003
     sample_rng = np.random.default_rng(seed + 17 + offset)
-    x = sample_rng.standard_normal((n,) + tuple(image_shape)).astype(np.float32)
-    logits = x.reshape(n, -1) @ teacher
-    y = np.argmax(logits, axis=1).astype(np.int32)
+    y = sample_rng.integers(0, num_classes, n).astype(np.int32)
+    noise = sample_rng.standard_normal((n,) + tuple(image_shape)).astype(np.float32)
+    x = 0.6 * protos[y] + noise
     return x, y
 
 

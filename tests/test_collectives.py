@@ -117,3 +117,39 @@ def test_pytree_exchange_vmap():
     left, right = spmd(fn, topo)(tree)
     np.testing.assert_allclose(left["a"], [3.0, 0.0, 1.0, 2.0])
     np.testing.assert_allclose(right["b"][0], [2.0, 3.0])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_masked_exchange_packed_multileaf(backend):
+    """Multi-leaf trees take the packed wire path (one buffer + one
+    fire-bit vector per neighbor); fire bits and values must land on the
+    right leaves in the right leaf order, stale values preserved per leaf."""
+    topo = Ring(4)
+
+    def fn(p, fire, last):
+        bufs, fires = collectives.masked_neighbor_vals(
+            p, fire, (last, last), topo
+        )
+        return bufs, fires
+
+    # leaf "a" fires on even ranks, leaf "b" on odd ranks
+    p = {"a": jnp.arange(4.0), "b": 10.0 + jnp.arange(8.0).reshape(4, 2)}
+    fire = {
+        "a": jnp.array([True, False, True, False]),
+        "b": jnp.array([False, True, False, True]),
+    }
+    last = {"a": jnp.full(4, -7.0), "b": jnp.full((4, 2), -9.0)}
+    (left, right), (lf, rf) = _lift(fn, topo, backend)(p, fire, last)
+
+    # from the left (rank r-1): a fired iff r-1 even, b iff r-1 odd
+    np.testing.assert_allclose(left["a"], [-7.0, 0.0, -7.0, 2.0])
+    np.testing.assert_allclose(
+        left["b"], [[16.0, 17.0], [-9.0, -9.0], [12.0, 13.0], [-9.0, -9.0]]
+    )
+    np.testing.assert_array_equal(lf["a"], [False, True, False, True])
+    np.testing.assert_array_equal(lf["b"], [True, False, True, False])
+    # from the right (rank r+1)
+    np.testing.assert_allclose(right["a"], [-7.0, 2.0, -7.0, 0.0])
+    np.testing.assert_allclose(
+        right["b"], [[12.0, 13.0], [-9.0, -9.0], [16.0, 17.0], [-9.0, -9.0]]
+    )

@@ -11,7 +11,6 @@ from eventgrad_tpu.train.loop import consensus_params, evaluate, train
 
 def test_mlp_eventgrad_end_to_end():
     topo = Ring(4)
-    # low-dim inputs so 2k samples generalize (teacher is 64x10)
     x, y = synthetic_dataset(2048, (8, 8, 1), seed=1)
     xt, yt = synthetic_dataset(256, (8, 8, 1), seed=1, split="test")
     state, hist = train(
@@ -29,7 +28,7 @@ def test_mlp_eventgrad_end_to_end():
     )
     assert hist[-1]["loss"] < hist[0]["loss"]
     assert 0.0 < hist[-1]["msgs_saved_pct"] < 100.0
-    assert hist[-1]["test_accuracy"] > 30.0  # 10 classes, teacher is linear
+    assert hist[-1]["test_accuracy"] > 50.0  # prototype task: well above chance
 
 
 def test_torus_dpsgd_runs():
