@@ -43,6 +43,17 @@ def consensus_params(stacked_params: Any) -> Any:
     return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked_params)
 
 
+def _loss_record(pass_base: int, s_i: int, r: int,
+                 loss_all: np.ndarray) -> Dict[str, Any]:
+    """Per-(pass, rank) loss record — the shared schema of the send trace's
+    train{r}.txt rider and the non-event values{r}.txt stream."""
+    return {
+        "pass": pass_base + s_i + 1,
+        "rank": r,
+        "loss": round(float(loss_all[s_i, r]), 6),
+    }
+
+
 def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
                  topo: Topology, state, carry: Dict[str, np.ndarray]) -> None:
     """Append the reference's file_write=1 instrumentation as JSONL.
@@ -80,19 +91,13 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
         steps = fired_all.shape[0]
         for s_i in range(steps):
             for r in range(n_ranks):
-                tf.write(
-                    json.dumps(
-                        {
-                            "pass": pass_base + s_i + 1,
-                            "rank": r,
-                            "loss": round(float(loss_all[s_i, r]), 6),
-                            "norm": [round(float(v), 6) for v in norm_all[s_i, r]],
-                            "thres": [round(float(v), 6) for v in thres_all[s_i, r]],
-                            "fired": [int(v) for v in fired_all[s_i, r]],
-                        }
-                    )
-                    + "\n"
+                rec = _loss_record(pass_base, s_i, r, loss_all)
+                rec.update(
+                    norm=[round(float(v), 6) for v in norm_all[s_i, r]],
+                    thres=[round(float(v), 6) for v in thres_all[s_i, r]],
+                    fired=[int(v) for v in fired_all[s_i, r]],
                 )
+                tf.write(json.dumps(rec) + "\n")
             for k, nb in enumerate(specs):
                 for r in range(n_ranks):
                     src = srcs[k][r]
@@ -300,6 +305,17 @@ def train(
                 _write_trace(
                     trace_file, m, total_passes - steps, topo, state, trace_carry
                 )
+            elif trace_file and multihost.is_primary():
+                # non-event algos: per-step per-rank loss records — the
+                # (epoch, loss) stream cent/decent call values{r}.txt
+                # (cent.cpp:124, decent.cpp:166)
+                loss_all = np.asarray(m["loss"])
+                with open(trace_file, "a") as tf:
+                    for s_i in range(steps):
+                        for r in range(topo.n_ranks):
+                            tf.write(json.dumps(_loss_record(
+                                total_passes - steps, s_i, r, loss_all
+                            )) + "\n")
             if x_test is not None and log_every_epoch and not multi:
                 # multi-process callers evaluate once at the end on
                 # allgathered params (multihost.to_host)

@@ -86,3 +86,26 @@ def test_trace_survives_resume(tmp_path):
     train(MLP(), Ring(4), x, y, epochs=2, trace_file=str(resumed),
           checkpoint_dir=ck, resume=True, **kw)
     assert straight.read_text() == resumed.read_text()
+
+
+def test_trace_loss_stream_for_non_event_algos(tmp_path):
+    """cent/decent write per-step (epoch, loss) to values{r}.txt
+    (cent.cpp:124, decent.cpp:166); with --trace-file the dpsgd/allreduce
+    paths emit the same stream as (pass, rank, loss) records."""
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=1)
+    for algo in ("dpsgd", "allreduce"):
+        path = tmp_path / f"{algo}.jsonl"
+        _, hist = train(
+            MLP(), Ring(4), x, y,
+            algo=algo, epochs=2, batch_size=8, learning_rate=0.05,
+            seed=0, trace_file=str(path), log_every_epoch=False,
+        )
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        steps = hist[0]["steps"]
+        assert len(recs) == 2 * steps * 4  # passes x ranks
+        assert all(set(r) == {"pass", "rank", "loss"} for r in recs)
+        assert max(r["pass"] for r in recs) == 2 * steps
+        assert all(np.isfinite(r["loss"]) for r in recs)
+        # the mean of the per-step stream reconciles with the epoch record
+        e1 = [r["loss"] for r in recs if r["pass"] <= steps]
+        assert abs(np.mean(e1) - hist[0]["loss"]) < 1e-4
