@@ -33,26 +33,92 @@ import sys
 import jax
 import numpy as np
 
-from eventgrad_tpu.data.datasets import load_or_synthesize
+from eventgrad_tpu.data.datasets import load_or_synthesize, synthetic_lm_dataset
 from eventgrad_tpu.models import MODEL_REGISTRY
 from eventgrad_tpu.parallel import multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import build_mesh
-from eventgrad_tpu.parallel.topology import Ring, Torus
+from eventgrad_tpu.parallel.topology import Ring, Topology, Torus
 from eventgrad_tpu.train.loop import consensus_params, evaluate, train
 from eventgrad_tpu.train.steps import ALGOS
 from eventgrad_tpu.utils.metrics import JsonlLogger
 
 
+#: axes that shard parameters (tensor/pipeline/expert parallelism); any
+#: other non-dp axis (e.g. "sp") replicates parameters and is aux
+_SHARDED_AXES = ("tp", "pp", "ep")
+
+#: transformer LM family — constructed from --dim/--heads/--layers/... and
+#: the mesh (unlike MODEL_REGISTRY's zero-argument image models)
+LM_MODELS = ("transformer", "transformer_tp", "transformer_pp", "transformer_moe")
+
+
+def build_lm_model(args, topo: Topology):
+    """Construct the requested transformer over the mesh's parallel axes."""
+    from eventgrad_tpu.models.moe import MoETransformerLM
+    from eventgrad_tpu.models.pp import PPTransformerLM
+    from eventgrad_tpu.models.tp import TPTransformerLM
+    from eventgrad_tpu.models.transformer import TransformerLM
+
+    def need(axis: str):
+        if axis not in topo.axes:
+            raise SystemExit(
+                f"--model {args.model} needs a {axis!r} axis in --mesh "
+                f"(e.g. --mesh dp:2,{axis}:2); got {topo.axes}"
+            )
+        return topo.axis_size(axis)
+
+    common = dict(vocab=args.vocab, dim=args.dim, n_heads=args.heads,
+                  n_layers=args.layers, max_len=args.seq_len)
+    if args.model == "transformer":
+        if args.attn in ("ring", "ulysses"):
+            need("sp")
+            return TransformerLM(**common, attn=args.attn, topo=topo,
+                                 sp_axis="sp")
+        return TransformerLM(**common, attn=args.attn)
+    if args.model == "transformer_tp":
+        return TPTransformerLM(**common, axis="tp", tp_size=need("tp"))
+    if args.model == "transformer_pp":
+        return PPTransformerLM(**common, axis="pp", pp_size=need("pp"))
+    return MoETransformerLM(**common, n_experts=args.n_experts, axis="ep",
+                            ep_size=need("ep"))
+
+
 def parse_mesh(spec: str):
     kind, _, dims = spec.partition(":")
-    if kind == "ring":
-        return Ring(int(dims))
-    if kind == "torus":
-        nx, ny = dims.lower().split("x")
-        return Torus(int(nx), int(ny))
-    raise argparse.ArgumentTypeError(f"bad mesh spec {spec!r} (ring:N or torus:XxY)")
+    try:
+        if kind == "ring":
+            return Ring(int(dims))
+        if kind == "torus":
+            nx, ny = dims.lower().split("x")
+            return Torus(int(nx), int(ny))
+        if "," in spec or kind in ("dp", "sp") + _SHARDED_AXES:
+            # hybrid grammar: comma-separated axis:N pairs, e.g.
+            # "dp:4,sp:2" or "dp:2,tp:2" — dp gossips, tp/pp/ep shard
+            # parameters, anything else (sp) is a replicated aux axis
+            axes, shape = [], []
+            for part in spec.split(","):
+                name, _, n = part.partition(":")
+                name = name.strip()
+                if name not in ("dp", "sp") + _SHARDED_AXES:
+                    raise ValueError(f"unknown axis {name!r}")
+                axes.append(name)
+                shape.append(int(n))
+            if len(set(axes)) != len(axes):
+                raise ValueError(f"duplicate axis in {spec!r}")
+            return Topology(
+                axes=tuple(axes),
+                shape=tuple(shape),
+                gossip_axes=tuple(a for a in axes if a == "dp"),
+                sharded_axes=tuple(a for a in axes if a in _SHARDED_AXES),
+            )
+    except (ValueError, TypeError) as e:
+        raise argparse.ArgumentTypeError(f"bad mesh spec {spec!r}: {e}")
+    raise argparse.ArgumentTypeError(
+        f"bad mesh spec {spec!r} (ring:N, torus:XxY, or axis:N[,axis:N...] "
+        f"with axes dp/sp/tp/pp/ep)"
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -61,9 +127,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=parse_mesh, default="ring:4", help="ring:N or torus:XxY")
     p.add_argument("--backend", choices=["sim", "mesh"], default="sim",
                    help="sim = vmap all ranks onto one chip; mesh = one rank per device")
-    p.add_argument("--dataset", choices=["mnist", "cifar10", "synthetic"], default="mnist")
+    p.add_argument("--dataset",
+                   choices=["mnist", "cifar10", "synthetic", "synthetic-lm"],
+                   default=None,
+                   help="default: mnist for image models, synthetic-lm for "
+                        "transformers")
     p.add_argument("--data-dir", default=None)
-    p.add_argument("--model", choices=sorted(MODEL_REGISTRY), default="cnn2")
+    p.add_argument("--model",
+                   choices=sorted(MODEL_REGISTRY) + sorted(LM_MODELS),
+                   default="cnn2")
+    # LM / transformer knobs (--model transformer*)
+    p.add_argument("--seq-len", type=int, default=128,
+                   help="global sequence length (sp ranks hold seq-len/n_sp)")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"],
+                   default="full",
+                   help="attention mode for --model transformer; ring/ulysses "
+                        "need an sp axis in --mesh")
+    p.add_argument("--n-experts", type=int, default=8,
+                   help="experts for --model transformer_moe")
     p.add_argument("--epochs", type=int, default=10)          # event.cpp:255
     p.add_argument("--batch-size", type=int, default=64)      # event.cpp:145 (per rank)
     p.add_argument("--global-batch", type=int, default=None,
@@ -131,20 +216,44 @@ def main(argv=None) -> int:
         args.log_file if primary else None, echo=primary
     )
 
-    # --dataset synthetic means "hermetic stand-in even if real data exists":
-    # drop data_dir so load_or_synthesize can't pick up on-disk files.
-    dataset = "mnist" if args.dataset == "synthetic" else args.dataset
-    data_dir = None if args.dataset == "synthetic" else args.data_dir
-    x, y = load_or_synthesize(dataset, data_dir, "train", args.n_synth, args.seed)
-    xt, yt = load_or_synthesize(
-        dataset, data_dir, "test", max(512, args.n_synth // 8), args.seed
-    )
+    is_lm = args.model in LM_MODELS
+    if args.dataset is None:
+        args.dataset = "synthetic-lm" if is_lm else "mnist"
+    if is_lm != (args.dataset == "synthetic-lm"):
+        raise SystemExit(
+            "--dataset synthetic-lm pairs with the transformer models "
+            "(--model transformer*) and vice versa"
+        )
+    if is_lm:
+        if args.augment:
+            raise SystemExit("--augment is an image transform; not for LM")
+        x, y = synthetic_lm_dataset(
+            args.n_synth, args.seq_len, args.vocab, args.seed
+        )
+        xt, yt = synthetic_lm_dataset(
+            max(512, args.n_synth // 8), args.seq_len, args.vocab, args.seed,
+            split="test",
+        )
+    else:
+        # --dataset synthetic means "hermetic stand-in even if real data
+        # exists": drop data_dir so load_or_synthesize can't pick up on-disk
+        # files.
+        dataset = "mnist" if args.dataset == "synthetic" else args.dataset
+        data_dir = None if args.dataset == "synthetic" else args.data_dir
+        x, y = load_or_synthesize(dataset, data_dir, "train", args.n_synth, args.seed)
+        xt, yt = load_or_synthesize(
+            dataset, data_dir, "test", max(512, args.n_synth // 8), args.seed
+        )
 
+    # data parallelism degree = the gossip axes' extent (hybrid meshes
+    # replicate batches across sp/tp/pp/ep ranks rather than splitting)
+    n_data = topo.n_gossip_ranks
+    hybrid = topo.is_hybrid
     batch = args.batch_size
     if args.global_batch:
-        batch = max(1, args.global_batch // topo.n_ranks)
+        batch = max(1, args.global_batch // n_data)
 
-    model = MODEL_REGISTRY[args.model]()
+    model = build_lm_model(args, topo) if is_lm else MODEL_REGISTRY[args.model]()
     mesh = build_mesh(topo) if args.backend == "mesh" else None
 
     event_cfg = EventConfig(
@@ -163,7 +272,7 @@ def main(argv=None) -> int:
         else contextlib.nullcontext()
     )
     with scope:
-        state, _ = train(
+        state, hist = train(
             model, topo, x, y,
             algo=args.algo, epochs=args.epochs, batch_size=batch,
             learning_rate=args.lr, momentum=args.momentum,
@@ -177,14 +286,24 @@ def main(argv=None) -> int:
             # metrics for the user, a liveness signal for supervise.py
         )
 
-    # allgathers are collective: every process participates...
-    params_host = multihost.to_host(state.params)
-    stats_host = multihost.to_host(state.batch_stats)
-    if primary:  # ...but only the primary spends the eval and logs it
-        cons = consensus_params(params_host)
-        stats0 = jax.tree.map(lambda s: s[0], stats_host)
-        final = evaluate(model, cons, stats0, xt, yt)
-        logger.log({"final": True, **final})
+    if hybrid:
+        # consensus averaging across sp/tp/pp/ep ranks would mix
+        # differently-sharded parameters; report final train metrics instead
+        # (hist can be empty when resuming from a final-epoch snapshot)
+        if primary:
+            rec = {"final": True, "consensus_eval": False}
+            if hist:
+                rec.update(loss=hist[-1]["loss"], train_acc=hist[-1]["train_acc"])
+            logger.log(rec)
+    else:
+        # allgathers are collective: every process participates...
+        params_host = multihost.to_host(state.params)
+        stats_host = multihost.to_host(state.batch_stats)
+        if primary:  # ...but only the primary spends the eval and logs it
+            cons = consensus_params(params_host)
+            stats0 = jax.tree.map(lambda s: s[0], stats_host)
+            final = evaluate(model, cons, stats0, xt, yt)
+            logger.log({"final": True, **final})
     logger.close()
     return 0
 

@@ -211,16 +211,38 @@ def shard_plan(
 def gather_batches(
     x: np.ndarray, y: np.ndarray, idx: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Assemble [*idx.shape, ...sample] batches with native memcpy gathers."""
+    """Assemble [*idx.shape, ...sample] batches with native memcpy gathers.
+
+    Samples may be any shape — images [H, W, C] with scalar labels, or
+    token sequences [T] with [T]-shaped targets; integer arrays gather as
+    int32, floats as float32 (eg_gather is a 4-byte-row memcpy, so int32
+    rides the same kernel through a bit view)."""
     lib = load_library()
     flat_idx = np.ascontiguousarray(idx.reshape(-1), np.int64)
+
+    def _norm(arr: np.ndarray) -> np.ndarray:
+        dt = np.int32 if np.issubdtype(arr.dtype, np.integer) else np.float32
+        return np.ascontiguousarray(arr, dt)
+
     if lib is None:
-        return x[flat_idx].reshape(idx.shape + x.shape[1:]), y[flat_idx].reshape(idx.shape)
-    x2 = np.ascontiguousarray(x, np.float32)
-    y2 = np.ascontiguousarray(y, np.int32)
-    elem = int(np.prod(x.shape[1:]))
-    xo = np.empty((flat_idx.size, elem), np.float32)
-    yo = np.empty(flat_idx.size, np.int32)
-    lib.eg_gather(x2.reshape(-1), elem, flat_idx, flat_idx.size, xo.reshape(-1))
-    lib.eg_gather_i32(y2, flat_idx, flat_idx.size, yo)
-    return xo.reshape(idx.shape + x.shape[1:]), yo.reshape(idx.shape)
+        x2, y2 = _norm(x), _norm(y)
+        return (
+            x2[flat_idx].reshape(idx.shape + x.shape[1:]),
+            y2[flat_idx].reshape(idx.shape + y.shape[1:]),
+        )
+
+    def _rowgather(arr: np.ndarray) -> np.ndarray:
+        a = _norm(arr)
+        elem = int(np.prod(a.shape[1:])) if a.ndim > 1 else 1
+        if elem == 1 and a.dtype == np.int32:
+            out = np.empty(flat_idx.size, np.int32)
+            lib.eg_gather_i32(a.reshape(-1), flat_idx, flat_idx.size, out)
+        else:
+            out = np.empty((flat_idx.size, elem), a.dtype)
+            lib.eg_gather(
+                a.reshape(-1).view(np.float32), elem,
+                flat_idx, flat_idx.size, out.reshape(-1).view(np.float32),
+            )
+        return out.reshape(idx.shape + a.shape[1:])
+
+    return _rowgather(x), _rowgather(y)

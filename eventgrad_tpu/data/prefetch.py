@@ -44,7 +44,9 @@ class EpochPrefetcher:
         seed: int = 0,
         last_epoch: Optional[int] = None,
     ):
-        self.x = np.ascontiguousarray(x, np.float32)
+        # preserve integer inputs (token sequences); images go to float32
+        x_dtype = np.int32 if np.issubdtype(np.asarray(x).dtype, np.integer) else np.float32
+        self.x = np.ascontiguousarray(x, x_dtype)
         self.y = np.ascontiguousarray(y, np.int32)
         self.n_ranks = n_ranks
         self.batch = batch_size

@@ -80,3 +80,44 @@ def batched_epoch(
         len(x), n_ranks, batch_size, random=random, seed=seed, epoch=epoch
     )
     return x[idx], y[idx]
+
+
+def expand_to_mesh(
+    xb: np.ndarray, yb: np.ndarray, topo, sp_axis: str = "sp"
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Lift gossip-sharded batches onto a hybrid mesh's full rank set.
+
+    `xb`/`yb` arrive in the stacked layout over the GOSSIP ranks only
+    ([n_gossip, steps, batch, ...] — each gossip rank owns a disjoint data
+    shard, the reference's sampler semantics). The full mesh may carry more
+    axes: a sequence-parallel axis (each rank holds its chunk of the token
+    dimension — ring attention's layout) and sharded/replicated aux axes
+    (tp/pp/ep — every rank in the group sees the same batch; the *model* is
+    what differs). Returns [topo.n_ranks, steps, batch, ...(chunked)] in the
+    topology's row-major rank order, matching `parallel.spmd.spmd`.
+    """
+    shape = topo.shape
+    gossip_idx = [topo.axes.index(a) for a in topo.gossip_axes]
+    sp_pos = topo.axes.index(sp_axis) if sp_axis in topo.axes else None
+    n_sp = shape[sp_pos] if sp_pos is not None else 1
+    if sp_pos is not None:
+        t_global = xb.shape[-1]
+        if t_global % n_sp:
+            raise ValueError(
+                f"sequence length {t_global} not divisible by {sp_axis} size {n_sp}"
+            )
+        t_local = t_global // n_sp
+
+    xs, ys = [], []
+    for r in range(topo.n_ranks):
+        multi = np.unravel_index(r, shape)
+        g = 0
+        for ax in gossip_idx:
+            g = g * shape[ax] + multi[ax]
+        xr, yr = xb[g], yb[g]
+        if sp_pos is not None:
+            sl = slice(multi[sp_pos] * t_local, (multi[sp_pos] + 1) * t_local)
+            xr, yr = xr[..., sl], yr[..., sl]
+        xs.append(xr)
+        ys.append(yr)
+    return np.stack(xs), np.stack(ys)

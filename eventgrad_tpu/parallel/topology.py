@@ -82,6 +82,17 @@ class Topology:
         return math.prod(self.shape)
 
     @property
+    def n_gossip_ranks(self) -> int:
+        """Extent of the gossip axes = the data-parallel degree (batches
+        shard across these; other axes replicate or chunk them)."""
+        return math.prod(self.axis_size(a) for a in self.gossip_axes)
+
+    @property
+    def is_hybrid(self) -> bool:
+        """True when the mesh carries non-gossip axes (sp/tp/pp/ep)."""
+        return self.n_gossip_ranks != self.n_ranks
+
+    @property
     def aux_axes(self) -> Tuple[str, ...]:
         """Replicated non-gossip axes (sequence/aux parallelism); ranks along
         these hold identical parameters and synchronize gradients by pmean."""

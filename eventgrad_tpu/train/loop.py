@@ -32,7 +32,8 @@ from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import spmd
 from eventgrad_tpu.parallel.topology import Topology
-from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.data.sharding import expand_to_mesh
+from eventgrad_tpu.train.state import init_train_state, init_train_state_spmd
 from eventgrad_tpu.train.steps import make_train_step
 from eventgrad_tpu.utils import checkpoint, trees
 from eventgrad_tpu.utils.metrics import msgs_saved_pct
@@ -133,6 +134,9 @@ def evaluate(model, params, batch_stats, x, y, batch_size: int = 1000) -> Dict[s
         xb = jnp.asarray(x[i : i + batch_size])
         yb = np.asarray(y[i : i + batch_size])
         out = np.asarray(fwd(xb))
+        if out.ndim == 3:  # LM logits [B, T, V]: score per token
+            out = out.reshape(-1, out.shape[-1])
+            yb = yb.reshape(-1)
         logp = out - np.log(np.sum(np.exp(out - out.max(-1, keepdims=True)), -1, keepdims=True)) - out.max(-1, keepdims=True)
         loss_sum += float(-logp[np.arange(len(yb)), yb].sum())
         correct += int((out.argmax(-1) == yb).sum())
@@ -193,8 +197,35 @@ def train(
             raise ValueError(f"bad fault_inject spec {fault_inject!r}")
         fault_epoch = int(n)
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
-    state = init_train_state(
-        model, x_train.shape[1:], tx, topo, algo, event_cfg, seed=seed
+
+    # hybrid meshes: data shards across the gossip axes only; sp ranks hold
+    # sequence chunks, sharded/replicated aux ranks (tp/pp/ep) see the same
+    # batch (the model, not the data, differs across them)
+    n_gossip = topo.n_gossip_ranks
+    hybrid = topo.is_hybrid
+    input_shape = tuple(x_train.shape[1:])
+    input_dtype = (
+        jnp.int32
+        if np.issubdtype(np.asarray(x_train).dtype, np.integer)
+        else jnp.float32
+    )
+    if "sp" in topo.axes and topo.axis_size("sp") > 1:
+        n_sp = topo.axis_size("sp")
+        if input_shape[-1] % n_sp:
+            raise ValueError(
+                f"sequence length {input_shape[-1]} not divisible by sp={n_sp}"
+            )
+        input_shape = input_shape[:-1] + (input_shape[-1] // n_sp,)
+    # sharded layers (tp/ep) and sp-offset attention read lax.axis_index at
+    # init time, so any non-gossip axis needs the SPMD-context initializer
+    init_fn = (
+        init_train_state_spmd
+        if (topo.sharded_axes or topo.aux_axes)
+        else init_train_state
+    )
+    state = init_fn(
+        model, input_shape, tx, topo, algo, event_cfg, seed=seed,
+        input_dtype=input_dtype,
     )
 
     multi = multihost.is_multiprocess()
@@ -263,12 +294,14 @@ def train(
     history: List[Dict[str, Any]] = []
 
     prefetcher = EpochPrefetcher(
-        x_train, y_train, topo.n_ranks, batch_size,
+        x_train, y_train, n_gossip, batch_size,
         random=random_sampler, seed=seed, last_epoch=epochs,
     )
     try:
         for epoch in range(start_epoch + 1, epochs + 1):
             xb, yb = prefetcher.get(epoch)
+            if hybrid:
+                xb, yb = expand_to_mesh(xb, yb, topo)
             steps = xb.shape[1]
             if mesh is not None:  # global placement (spans hosts if any)
                 xb = multihost.put_stacked(xb, mesh, topo)
@@ -289,7 +322,10 @@ def train(
                 "steps": steps,
                 "wall_s": dt,
                 "loss": float(m["loss"].mean()),
-                "train_acc": 100.0 * float(m["correct"].sum()) / (topo.n_ranks * steps * batch_size),
+                # targets per step per rank: batch for classification,
+                # batch x t_local for LM (correct counts tokens elementwise)
+                "train_acc": 100.0 * float(m["correct"].sum())
+                / (topo.n_ranks * steps * int(np.prod(yb.shape[2:]))),
                 "sent_bytes_per_step_per_chip": float(m["sent_bytes"][..., 0].mean()),
                 "n_params": n_params,
             }
@@ -316,9 +352,11 @@ def train(
                             tf.write(json.dumps(_loss_record(
                                 total_passes - steps, s_i, r, loss_all
                             )) + "\n")
-            if x_test is not None and log_every_epoch and not multi:
+            if x_test is not None and log_every_epoch and not multi and not hybrid:
                 # multi-process callers evaluate once at the end on
-                # allgathered params (multihost.to_host)
+                # allgathered params (multihost.to_host); hybrid meshes skip
+                # consensus eval — averaging across sp/tp/pp/ep ranks would
+                # mix differently-sharded parameters
                 cons = consensus_params(state.params)
                 stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
                 rec.update(

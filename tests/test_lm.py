@@ -1,0 +1,134 @@
+"""Language-model training end-to-end: the synthetic Markov-chain LM task,
+hybrid-mesh batch layout, and the transformer family through train()/CLI.
+
+Beyond-reference capability (the reference has no attention at all,
+SURVEY.md §2.5); this locks in the launcher-level story: every parallel
+family — dp-gossip x {sp, tp, pp, ep} — is reachable end-to-end from the
+same flags that drive the reference's four algorithms.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from eventgrad_tpu.cli import main, parse_mesh
+from eventgrad_tpu.data.datasets import synthetic_lm_dataset
+from eventgrad_tpu.data.sharding import expand_to_mesh
+from eventgrad_tpu.parallel.topology import Ring, Topology
+
+
+def test_lm_dataset_deterministic_learnable_markov():
+    x, y = synthetic_lm_dataset(128, 32, vocab=50, seed=3)
+    assert x.shape == y.shape == (128, 32) and x.dtype == np.int32
+    # targets are the next token
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+    x2, _ = synthetic_lm_dataset(128, 32, vocab=50, seed=3)
+    np.testing.assert_array_equal(x, x2)
+    xt, _ = synthetic_lm_dataset(128, 32, vocab=50, seed=3, split="test")
+    assert not np.array_equal(x, xt)
+    # peaked transitions: the most-likely successor of a token repeats far
+    # more often than uniform chance would allow
+    from collections import Counter
+
+    follows = Counter(zip(x[:, :-1].ravel(), x[:, 1:].ravel()))
+    top = follows.most_common(1)[0][1]
+    assert top > 5 * (x.size / 50 / 50)
+
+
+def test_expand_to_mesh_layouts():
+    topo = Topology(axes=("dp", "sp"), shape=(2, 2), gossip_axes=("dp",))
+    xb = np.arange(2 * 3 * 4 * 8).reshape(2, 3, 4, 8).astype(np.int32)
+    yb = xb + 1
+    xe, ye = expand_to_mesh(xb, yb, topo)
+    assert xe.shape == (4, 3, 4, 4)
+    # rank order row-major over (dp, sp): rank 1 = dp0/sp1 -> second chunk
+    np.testing.assert_array_equal(xe[1], xb[0][..., 4:])
+    np.testing.assert_array_equal(xe[2], xb[1][..., :4])
+    np.testing.assert_array_equal(ye[3], yb[1][..., 4:])
+
+    # sharded axis (tp): batches replicate, nothing is chunked
+    topo_tp = Topology(
+        axes=("dp", "tp"), shape=(2, 2), gossip_axes=("dp",), sharded_axes=("tp",)
+    )
+    xe, ye = expand_to_mesh(xb, yb, topo_tp)
+    assert xe.shape == (4, 3, 4, 8)
+    np.testing.assert_array_equal(xe[0], xe[1])
+    np.testing.assert_array_equal(xe[2], xb[1])
+
+    with pytest.raises(ValueError, match="not divisible"):
+        expand_to_mesh(xb[..., :7], yb[..., :7], topo)
+
+
+def test_parse_mesh_hybrid_specs():
+    t = parse_mesh("dp:4,sp:2")
+    assert t.axes == ("dp", "sp") and t.shape == (4, 2)
+    assert t.gossip_axes == ("dp",) and t.sharded_axes == ()
+    t = parse_mesh("dp:2,tp:2")
+    assert t.sharded_axes == ("tp",)
+    t = parse_mesh("tp:4")
+    assert t.gossip_axes == () and t.sharded_axes == ("tp",)
+    import argparse
+
+    for bad in ("dp:2,dp:2", "dp:x", "blah:3", "dp:2,qq:2"):
+        with pytest.raises(argparse.ArgumentTypeError):
+            parse_mesh(bad)
+
+
+LM_ARGS = [
+    "--dataset", "synthetic-lm", "--seq-len", "32", "--vocab", "64",
+    "--dim", "32", "--heads", "4", "--layers", "1", "--epochs", "2",
+    "--batch-size", "4", "--n-synth", "64", "--lr", "0.1",
+    "--warmup-passes", "2",
+]
+
+
+def _run(capsys, args):
+    assert main(args) == 0
+    return [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+
+
+def test_cli_transformer_ring_consensus_eval(capsys):
+    recs = _run(capsys, ["--algo", "eventgrad", "--mesh", "ring:4",
+                         "--model", "transformer"] + LM_ARGS)
+    final = recs[-1]
+    assert final["final"] and "accuracy" in final  # consensus eval ran
+    assert recs[1]["loss"] < recs[0]["loss"]
+    assert recs[-2]["msgs_saved_pct"] > 0
+
+
+def test_cli_transformer_ring_attention_dp_sp(capsys):
+    recs = _run(capsys, ["--algo", "eventgrad", "--mesh", "dp:2,sp:2",
+                         "--model", "transformer", "--attn", "ring"] + LM_ARGS)
+    assert recs[-1]["final"] and recs[-1]["consensus_eval"] is False
+    assert recs[1]["loss"] < recs[0]["loss"]
+
+
+def test_cli_transformer_tp_mesh_backend(capsys):
+    recs = _run(capsys, ["--algo", "eventgrad", "--mesh", "dp:2,tp:2",
+                         "--backend", "mesh", "--model", "transformer_tp"]
+                + LM_ARGS)
+    assert recs[1]["loss"] < recs[0]["loss"]
+
+
+def test_cli_transformer_pp_and_moe(capsys):
+    recs = _run(capsys, ["--algo", "dpsgd", "--mesh", "dp:2,pp:2",
+                         "--model", "transformer_pp"]
+                + LM_ARGS + ["--layers", "2"])
+    assert recs[1]["loss"] < recs[0]["loss"]
+    recs = _run(capsys, ["--algo", "sp_eventgrad", "--mesh", "dp:2,ep:2",
+                         "--model", "transformer_moe", "--topk-percent", "25"]
+                + LM_ARGS)
+    assert recs[1]["loss"] < recs[0]["loss"]
+
+
+def test_cli_lm_guards():
+    with pytest.raises(SystemExit):  # ring attention needs an sp axis
+        main(["--model", "transformer", "--attn", "ring",
+              "--mesh", "ring:4"] + LM_ARGS)
+    with pytest.raises(SystemExit):  # image model on LM data
+        main(["--model", "cnn2", "--dataset", "synthetic-lm"])
+    with pytest.raises(SystemExit):  # explicit image dataset on a transformer
+        main(["--model", "transformer"] + LM_ARGS + ["--dataset", "mnist"])
+    with pytest.raises(SystemExit):  # augment is an image transform
+        main(["--model", "transformer", "--augment"] + LM_ARGS)

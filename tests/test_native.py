@@ -166,3 +166,16 @@ def test_cifar10_jpeg_dir_loader(jpeg, tmp_path):
     x2, y2 = load_cifar10(str(tmp_path), "test")
     assert x2.shape == (6, 32, 32, 3)
     np.testing.assert_array_equal(np.unique(y2), [0, 1, 2])
+
+
+def test_gather_sequence_targets_and_int_inputs(lib):
+    """Token datasets: int32 x rows gather bit-exactly through the float
+    memcpy kernel, and [T]-shaped int targets keep their trailing dim."""
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 1000, (20, 16)).astype(np.int32)
+    y = rng.integers(0, 1000, (20, 16)).astype(np.int32)
+    idx = np.array([[4, 9], [0, 19]], np.int64)
+    xg, yg = native.gather_batches(x, y, idx)
+    assert xg.dtype == np.int32 and yg.shape == (2, 2, 16)
+    np.testing.assert_array_equal(xg, x[idx.reshape(-1)].reshape(2, 2, 16))
+    np.testing.assert_array_equal(yg, y[idx.reshape(-1)].reshape(2, 2, 16))
