@@ -72,3 +72,41 @@ def test_interrupted_save_falls_back_to_prev(tmp_path):
 
     state_res, hist_res = _run(ck, epochs=4, resume=True)
     assert [h["epoch"] for h in hist_res] == [3, 4]
+
+
+def test_hybrid_lm_resume_matches_uninterrupted(tmp_path):
+    """Hybrid meshes persist too: an EventGraD dp x sp ring-attention LM run
+    interrupted at epoch 2 and resumed matches the straight 4-epoch run."""
+    from eventgrad_tpu.data.datasets import synthetic_lm_dataset
+    from eventgrad_tpu.models.transformer import TransformerLM
+    from eventgrad_tpu.parallel.topology import Topology
+
+    topo = Topology(axes=("dp", "sp"), shape=(2, 2), gossip_axes=("dp",))
+    x, y = synthetic_lm_dataset(64, 32, vocab=64, seed=2)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=3)
+
+    def go(ck, *, epochs, resume):
+        model = TransformerLM(vocab=64, dim=32, n_heads=4, n_layers=1,
+                              max_len=32, attn="ring", topo=topo, sp_axis="sp")
+        return train(
+            model, topo, x, y,
+            algo="eventgrad", epochs=epochs, batch_size=4, learning_rate=0.1,
+            event_cfg=cfg, random_sampler=True, seed=5,
+            checkpoint_dir=str(ck) if ck else None, save_every=2,
+            resume=resume, log_every_epoch=False,
+        )
+
+    state_full, _ = go(None, epochs=4, resume=False)
+    ck = tmp_path / "ck"
+    go(ck, epochs=2, resume=False)
+    state_res, hist = go(ck, epochs=4, resume=True)
+
+    assert [h["epoch"] for h in hist] == [3, 4]
+    for a, b in zip(
+        jax.tree.leaves(state_full.params), jax.tree.leaves(state_res.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(state_full.event.num_events),
+        np.asarray(state_res.event.num_events),
+    )
