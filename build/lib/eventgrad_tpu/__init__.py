@@ -1,0 +1,45 @@
+"""eventgrad-tpu: TPU-native communication-efficient decentralized training.
+
+A from-scratch JAX/XLA rebuild of the capabilities of soumyadipghosh/eventgrad
+(reference at /root/reference, C++17/LibTorch/MPI): centralized AllReduce
+data-parallel SGD, decentralized D-PSGD ring gossip, event-triggered gossip
+(EventGraD), and top-k sparsified EventGraD — expressed as pure, jit-compiled
+SPMD programs over a named `jax.sharding.Mesh` instead of MPI processes.
+
+Design notes (TPU-first):
+  * The reference's MPI rank/ring setup (dmnist/event/event.cpp:105-124)
+    becomes a named-axis device mesh (`eventgrad_tpu.parallel.topology`).
+  * MPI_Allreduce (dmnist/cent/cent.cpp:135-140) becomes `jax.lax.pmean`.
+  * Ring neighbor sends (dmnist/decent/decent.cpp:192-208) become
+    `jax.lax.ppermute` shifts on the mesh axis — they ride the ICI torus.
+  * Event-triggered one-sided RMA puts (dmnist/event/event.cpp:346-360)
+    become *masked* ppermute: a fire bit plus a zero-masked payload, with the
+    receiver keeping its stale buffer when the bit is off. Deterministic by
+    construction, unlike the reference's torn-read RMA semantics.
+  * All mutable per-parameter state (thresholds, slope history, neighbor
+    buffers, top-k shadow replicas — event.cpp:181-225, spevent.cpp:128-136)
+    is explicit pytree state threaded through the train step.
+"""
+
+from eventgrad_tpu.version import __version__
+
+from eventgrad_tpu.parallel.topology import Ring, Torus, Topology
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks, build_mesh
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import EventConfig, EventState
+from eventgrad_tpu.parallel.sparsify import SparseConfig, SparseState
+
+__all__ = [
+    "__version__",
+    "Ring",
+    "Torus",
+    "Topology",
+    "spmd",
+    "stack_for_ranks",
+    "build_mesh",
+    "collectives",
+    "EventConfig",
+    "EventState",
+    "SparseConfig",
+    "SparseState",
+]

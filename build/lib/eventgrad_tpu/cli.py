@@ -128,22 +128,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["sim", "mesh"], default="sim",
                    help="sim = vmap all ranks onto one chip; mesh = one rank per device")
     p.add_argument("--dataset",
-                   choices=["mnist", "cifar10", "synthetic", "synthetic-lm",
-                            "synthetic-imagenet"],
+                   choices=["mnist", "cifar10", "synthetic", "synthetic-lm"],
                    default=None,
                    help="default: mnist for image models, synthetic-lm for "
-                        "transformers; synthetic-imagenet is the "
-                        "ImageNet-shaped scale-stress stand-in "
-                        "(--image-size/--num-classes)")
-    p.add_argument("--image-size", type=int, default=64,
-                   help="side length for --dataset synthetic-imagenet "
-                        "(224 = true ImageNet shape)")
-    p.add_argument("--num-classes", type=int, default=10,
-                   help="label count for synthetic-imagenet (resnet models "
-                        "only)")
-    p.add_argument("--num-filters", type=int, default=64,
-                   help="resnet stem width (64 = faithful; smaller for "
-                        "smoke runs)")
+                        "transformers")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--model",
                    choices=sorted(MODEL_REGISTRY) + sorted(LM_MODELS),
@@ -236,37 +224,15 @@ def main(argv=None) -> int:
             "--dataset synthetic-lm pairs with the transformer models "
             "(--model transformer*) and vice versa"
         )
-    if is_lm and args.augment:
-        raise SystemExit("--augment is an image transform; not for LM")
-    if not is_lm and not args.model.startswith("resnet") and (
-        args.num_classes != 10 or args.num_filters != 64
-    ):
-        raise SystemExit(
-            "--num-classes/--num-filters apply to resnet models only "
-            "(the reference's small CNNs have fixed heads)"
-        )
-
-    n_test = max(512, args.n_synth // 8)
     if is_lm:
+        if args.augment:
+            raise SystemExit("--augment is an image transform; not for LM")
         x, y = synthetic_lm_dataset(
             args.n_synth, args.seq_len, args.vocab, args.seed
         )
         xt, yt = synthetic_lm_dataset(
-            n_test, args.seq_len, args.vocab, args.seed, split="test"
-        )
-    elif args.dataset == "synthetic-imagenet":
-        # ImageNet-shaped scale stress (BASELINE's "ResNet-50 ImageNet on a
-        # v4-256 2D torus" config): hermetic class-prototype images at
-        # --image-size, --num-classes labels
-        from eventgrad_tpu.data.datasets import synthetic_dataset
-
-        shape = (args.image_size, args.image_size, 3)
-        x, y = synthetic_dataset(
-            args.n_synth, shape, num_classes=args.num_classes, seed=args.seed
-        )
-        xt, yt = synthetic_dataset(
-            n_test, shape, num_classes=args.num_classes,
-            seed=args.seed, split="test",
+            max(512, args.n_synth // 8), args.seq_len, args.vocab, args.seed,
+            split="test",
         )
     else:
         # --dataset synthetic means "hermetic stand-in even if real data
@@ -275,7 +241,9 @@ def main(argv=None) -> int:
         dataset = "mnist" if args.dataset == "synthetic" else args.dataset
         data_dir = None if args.dataset == "synthetic" else args.data_dir
         x, y = load_or_synthesize(dataset, data_dir, "train", args.n_synth, args.seed)
-        xt, yt = load_or_synthesize(dataset, data_dir, "test", n_test, args.seed)
+        xt, yt = load_or_synthesize(
+            dataset, data_dir, "test", max(512, args.n_synth // 8), args.seed
+        )
 
     # data parallelism degree = the gossip axes' extent (hybrid meshes
     # replicate batches across sp/tp/pp/ep ranks rather than splitting)
@@ -285,14 +253,7 @@ def main(argv=None) -> int:
     if args.global_batch:
         batch = max(1, args.global_batch // n_data)
 
-    if is_lm:
-        model = build_lm_model(args, topo)
-    elif args.num_classes != 10 or args.num_filters != 64:
-        model = MODEL_REGISTRY[args.model](  # resnet-only, validated above
-            num_classes=args.num_classes, num_filters=args.num_filters
-        )
-    else:
-        model = MODEL_REGISTRY[args.model]()
+    model = build_lm_model(args, topo) if is_lm else MODEL_REGISTRY[args.model]()
     mesh = build_mesh(topo) if args.backend == "mesh" else None
 
     event_cfg = EventConfig(

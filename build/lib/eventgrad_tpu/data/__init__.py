@@ -1,0 +1,7 @@
+from eventgrad_tpu.data.datasets import load_mnist, load_cifar10, synthetic_dataset
+from eventgrad_tpu.data.sharding import (
+    shard_sequential,
+    shard_random,
+    batched_epoch,
+)
+from eventgrad_tpu.data.augment import pad_flip_crop

@@ -1,0 +1,35 @@
+"""CIFAR-10 training augmentations, pure-JAX and jit/vmap-safe.
+
+Rebuild of /root/reference/dcifar10/common/transform.hpp applied in the order
+the reference composes them (dcifar10/event/event.cpp:94-98):
+ConstantPad(4) (:79-87) -> RandomHorizontalFlip p=.5 (:68-76) ->
+RandomCrop 32x32 (:90-101).
+
+Runs on-device inside the train step (per-batch, keyed by the train PRNG),
+so the host never touches pixels after the initial device_put — the TPU-
+native answer to the reference's per-sample OpenCV CPU transforms.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_flip_crop(key: jax.Array, images: jnp.ndarray, pad: int = 4) -> jnp.ndarray:
+    """images: [B, H, W, C] float32 -> same shape, per-sample random
+    horizontal flip and random crop from the `pad`-padded canvas."""
+    b, h, w, c = images.shape
+    kf, kx, ky = jax.random.split(key, 3)
+
+    flip = jax.random.bernoulli(kf, 0.5, (b,))
+    images = jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    ox = jax.random.randint(kx, (b,), 0, 2 * pad + 1)
+    oy = jax.random.randint(ky, (b,), 0, 2 * pad + 1)
+
+    def crop_one(img, x0, y0):
+        return jax.lax.dynamic_slice(img, (x0, y0, 0), (h, w, c))
+
+    return jax.vmap(crop_one)(padded, ox, oy)

@@ -1,0 +1,123 @@
+"""Distributed data sharding — the reference's samplers as array ops.
+
+torch's DistributedSequentialSampler / DistributedRandomSampler with
+allow_duplicates=false (/root/reference/dmnist/decent/decent.cpp:81-82,
+dmnist/cent/cent.cpp:59-60, dcifar10/event/event.cpp:102-105) give each of N
+ranks a disjoint 1/N slice of the dataset. Here a shard plan is materialized
+up front as index arrays in the stacked layout [n_ranks, steps, batch], so an
+entire epoch of per-rank batches is a single gather — friendly to
+`jax.device_put` once and `lax.scan` over steps.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def _per_rank_count(n: int, n_ranks: int) -> int:
+    """Samples per rank, dropping the remainder (allow_duplicates=false)."""
+    return n // n_ranks
+
+
+def shard_sequential(n: int, n_ranks: int) -> np.ndarray:
+    """[n_ranks, per_rank] contiguous index slices (sequential sampler)."""
+    per = _per_rank_count(n, n_ranks)
+    return np.arange(n_ranks * per, dtype=np.int64).reshape(n_ranks, per)
+
+
+def shard_random(n: int, n_ranks: int, seed: int = 0, epoch: int = 0) -> np.ndarray:
+    """[n_ranks, per_rank] disjoint shards of a global permutation
+    (random sampler); reshuffled per epoch via the seed mix."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    per = _per_rank_count(n, n_ranks)
+    perm = rng.permutation(n)[: n_ranks * per]
+    return perm.reshape(n_ranks, per).astype(np.int64)
+
+
+def epoch_index_plan(
+    n: int,
+    n_ranks: int,
+    batch_size: int,
+    *,
+    random: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+) -> np.ndarray:
+    """[n_ranks, steps, batch] sample indices for one epoch. Trailing
+    partial batches are dropped, matching the reference loaders'
+    full-batch iteration. The single source of truth for epoch assembly —
+    `batched_epoch` and `prefetch.EpochPrefetcher` both consume it."""
+    shards = (
+        shard_random(n, n_ranks, seed, epoch)
+        if random
+        else shard_sequential(n, n_ranks)
+    )
+    per = shards.shape[1]
+    steps = per // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"batch_size {batch_size} larger than per-rank shard {per} "
+            f"({n} samples / {n_ranks} ranks)"
+        )
+    return shards[:, : steps * batch_size].reshape(n_ranks, steps, batch_size)
+
+
+def batched_epoch(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_ranks: int,
+    batch_size: int,
+    *,
+    random: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One epoch of per-rank batches in stacked layout: (xb, yb) shaped
+    [n_ranks, steps, batch, ...] / [n_ranks, steps, batch]."""
+    idx = epoch_index_plan(
+        len(x), n_ranks, batch_size, random=random, seed=seed, epoch=epoch
+    )
+    return x[idx], y[idx]
+
+
+def expand_to_mesh(
+    xb: np.ndarray, yb: np.ndarray, topo, sp_axis: str = "sp"
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Lift gossip-sharded batches onto a hybrid mesh's full rank set.
+
+    `xb`/`yb` arrive in the stacked layout over the GOSSIP ranks only
+    ([n_gossip, steps, batch, ...] — each gossip rank owns a disjoint data
+    shard, the reference's sampler semantics). The full mesh may carry more
+    axes: a sequence-parallel axis (each rank holds its chunk of the token
+    dimension — ring attention's layout) and sharded/replicated aux axes
+    (tp/pp/ep — every rank in the group sees the same batch; the *model* is
+    what differs). Returns [topo.n_ranks, steps, batch, ...(chunked)] in the
+    topology's row-major rank order, matching `parallel.spmd.spmd`.
+    """
+    shape = topo.shape
+    gossip_idx = [topo.axes.index(a) for a in topo.gossip_axes]
+    sp_pos = topo.axes.index(sp_axis) if sp_axis in topo.axes else None
+    n_sp = shape[sp_pos] if sp_pos is not None else 1
+    if sp_pos is not None:
+        t_global = xb.shape[-1]
+        if t_global % n_sp:
+            raise ValueError(
+                f"sequence length {t_global} not divisible by {sp_axis} size {n_sp}"
+            )
+        t_local = t_global // n_sp
+
+    xs, ys = [], []
+    for r in range(topo.n_ranks):
+        multi = np.unravel_index(r, shape)
+        g = 0
+        for ax in gossip_idx:
+            g = g * shape[ax] + multi[ax]
+        xr, yr = xb[g], yb[g]
+        if sp_pos is not None:
+            sl = slice(multi[sp_pos] * t_local, (multi[sp_pos] + 1) * t_local)
+            xr, yr = xr[..., sl], yr[..., sl]
+        xs.append(xr)
+        ys.append(yr)
+    return np.stack(xs), np.stack(ys)

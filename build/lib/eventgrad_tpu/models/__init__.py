@@ -1,0 +1,28 @@
+from eventgrad_tpu.models.mlp import MLP
+from eventgrad_tpu.models.moe import MoETransformerLM
+from eventgrad_tpu.models.pp import PPTransformerLM
+from eventgrad_tpu.models.tp import TPTransformerLM
+from eventgrad_tpu.models.transformer import TransformerLM
+from eventgrad_tpu.models.cnn import CNN1, CNN2, LeNetCifar
+from eventgrad_tpu.models.resnet import (
+    ResNet,
+    BasicBlock,
+    Bottleneck,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+
+MODEL_REGISTRY = {
+    "mlp": MLP,
+    "cnn1": CNN1,
+    "cnn2": CNN2,
+    "lenet_cifar": LeNetCifar,
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+}

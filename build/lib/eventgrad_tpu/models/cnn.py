@@ -1,0 +1,82 @@
+"""MNIST CNNs (M2/M3) and the CIFAR LeNet (M5), TPU layout (NHWC).
+
+  * CNN1 — the EventGraD paper's first MNIST model, present but commented out
+    in the reference (/root/reference/dmnist/event/event.cpp:15-48):
+    conv(1->10,k5) pool relu, conv(10->20,k5) drop2d pool relu,
+    fc 320->100 relu, dropout .5, fc 100->10, log_softmax.
+  * CNN2 — the model `event` actually trains (event.cpp:50-83):
+    conv(1->10,k3) pool relu, conv(10->20,k3) drop2d pool relu,
+    fc 500->50 relu, dropout .5, fc 50->10, log_softmax.
+    27,480 params in 8 tensors (printed by event.cpp:162-165).
+  * LeNetCifar — dcifar10/common/nnet.hpp:3-33: conv(3->6,k5) relu pool,
+    conv(6->16,k5) drop2d relu? — note the reference order is
+    pool(relu(drop(conv2))) for conv2 (nnet.hpp:18) and pool(relu(conv1))
+    for conv1 (nnet.hpp:17); fc 400->120->84->10, log_softmax. ~62K params.
+
+All convolutions are VALID-padded like torch's default. Dropout2d (channel
+dropout) maps to nn.Dropout broadcast over the spatial dims of NHWC.
+Outputs are log-probabilities; pairing them with an NLL loss matches the
+reference's double-log_softmax quirk exactly, since log_softmax is
+idempotent (event.cpp:291 applies log_softmax to an already-log_softmax'd
+forward output).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _max_pool2(x):
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+class CNN1(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(10, (5, 5), padding="VALID")(x)
+        x = nn.relu(_max_pool2(x))
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.Dropout(0.5, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = nn.relu(_max_pool2(x))
+        x = x.reshape((x.shape[0], -1))  # 4*4*20 = 320
+        x = nn.relu(nn.Dense(100)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x, axis=-1)
+
+
+class CNN2(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(10, (3, 3), padding="VALID")(x)
+        x = nn.relu(_max_pool2(x))
+        x = nn.Conv(20, (3, 3), padding="VALID")(x)
+        x = nn.Dropout(0.5, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = nn.relu(_max_pool2(x))
+        x = x.reshape((x.shape[0], -1))  # 5*5*20 = 500
+        x = nn.relu(nn.Dense(50)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x, axis=-1)
+
+
+class LeNetCifar(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(6, (5, 5), padding="VALID")(x)
+        x = _max_pool2(nn.relu(x))
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.Dropout(0.5, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = _max_pool2(nn.relu(x))
+        x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x, axis=-1)

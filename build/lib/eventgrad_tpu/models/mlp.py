@@ -1,0 +1,28 @@
+"""The reference's 2-layer MNIST MLP (M1).
+
+Rebuild of `struct Model` at /root/reference/dmnist/cent/cent.cpp:16-35
+(identical copy in dmnist/decent/decent.cpp:19-38): 784 -> 128 ReLU -> 10
+ReLU. The ReLU on the *logits* (cent.cpp:29) is a reference quirk preserved
+behind `relu_logits` because it changes the training trajectory; the loss
+applies its own log_softmax (cent.cpp:119). 101,770 params in 4 tensors.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+    num_classes: int = 10
+    relu_logits: bool = True  # faithful to cent.cpp:29
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dense(self.num_classes)(x)
+        if self.relu_logits:
+            x = nn.relu(x)
+        return x

@@ -1,0 +1,214 @@
+"""Expert parallelism: Mixture-of-Experts layers with all_to_all dispatch.
+
+Not present in the reference (SURVEY §2.5 marks EP "absent" — its models are
+small dense CNNs), but part of the framework's scale story: the experts of
+an MoE MLP shard across a named mesh axis exactly like Megatron kernels
+shard across a TP axis (models/tp.py), and tokens reach their experts via
+one `lax.all_to_all` pair riding ICI.
+
+Design (GShard/Switch-style, TPU-dense):
+
+  * Router is a replicated Dense; top-`n_select` gating with renormalized
+    probabilities and a load-balancing auxiliary loss (sown into the
+    "losses" collection; `train.steps` adds it to the objective).
+  * Dispatch/combine are dense one-hot tensors of static shape
+    [tokens, experts, capacity] — fully jittable, MXU-friendly einsums,
+    no dynamic shapes. Tokens beyond an expert's capacity are dropped
+    (their combine weight is zero, so they pass through the residual).
+  * Expert weights live `ep_size`-way sharded: rank r owns experts
+    [r*E/N, (r+1)*E/N) as leading-axis slices of `tp_wi`/`tp_wo`. The
+    `tp_` prefix is the framework's sharded-leaf convention
+    (train/steps.py): gradients of these leaves divide by the axis size
+    (the all_to_all transpose has already summed every rank's
+    contribution), while router/attention/embedding leaves pmean.
+
+The EP axis doubles as a data axis (each rank routes its own tokens), so a
+pure-EP topology is `Topology(axes=("ep",), shape=(N,), sharded_axes=("ep",))`
+and hybrid gossip×EP meshes work like gossip×TP.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgrad_tpu.models.tp import sharded_lecun_init
+from eventgrad_tpu.parallel.ring_attention import full_attention
+
+
+def _dispatch_combine(probs, n_select: int, capacity: int, dtype):
+    """Dense dispatch/combine tensors from router probabilities.
+
+    probs: [S, E] softmax router output. Returns (dispatch [S,E,C] in {0,1},
+    combine [S,E,C] floats, routed [S,E] pre-capacity assignment counts for
+    the load-balancing loss). Selection is top-`n_select` per token with
+    gate weights renormalized over the selected experts; capacity is
+    granted in selection-priority order (all first choices before any
+    second choices), each expert keeping its first `capacity` takers in
+    token order — deterministic and shape-static.
+    """
+    s, e = probs.shape
+    gate_vals, gate_idx = lax.top_k(probs, n_select)  # [S, K]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx.T, e, dtype=jnp.int32)  # [K, S, E]
+    flat = onehot.reshape(n_select * s, e)  # priority-major ordering
+    pos = jnp.cumsum(flat, axis=0) - flat  # position within each expert
+    keep = (pos < capacity) & (flat > 0)
+    slot = jax.nn.one_hot(pos, capacity, dtype=dtype) * keep[..., None].astype(dtype)
+    comb = slot * gate_vals.T.reshape(-1)[:, None, None]
+    dispatch = slot.reshape(n_select, s, e, capacity).sum(0)
+    combine = comb.reshape(n_select, s, e, capacity).sum(0)
+    return dispatch, combine, onehot.sum(0)  # routed: [S, E] pre-capacity
+
+
+class ExpertParallelMLP(nn.Module):
+    """MoE feed-forward: top-k routed experts sharded over `axis`.
+
+    Input/output [B, T, D] per rank. With ep_size == 1 all experts are
+    local and no collective runs (the single-rank twin used by tests).
+    """
+
+    dim: int
+    hidden: int
+    n_experts: int  # GLOBAL expert count; rank-major ownership order
+    axis: str = "ep"
+    ep_size: int = 1
+    n_select: int = 2
+    capacity_factor: float = 2.0
+    aux_weight: float = 1e-2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        s = b * t
+        e = self.n_experts
+        if e % self.ep_size:
+            raise ValueError(f"n_experts {e} not divisible by ep_size {self.ep_size}")
+        e_local = e // self.ep_size
+        capacity = max(1, math.ceil(self.n_select * s * self.capacity_factor / e))
+        xf = x.reshape(s, d)
+
+        # replicated router (fp32 for stable softmax/top-k)
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, routed = _dispatch_combine(
+            probs, self.n_select, capacity, jnp.float32
+        )
+
+        # GShard load-balancing loss: E * sum_e mean_prob_e * mean_routed_e
+        aux = e * jnp.sum(probs.mean(0) * (routed.astype(jnp.float32) / self.n_select).mean(0))
+        self.sow("losses", "moe_aux", self.aux_weight * aux)
+
+        xin = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), xf)  # [E, C, D]
+        if self.ep_size > 1:
+            # ship each owner-rank's expert block to its owner; receive my
+            # experts' tokens from every source rank
+            xin = xin.reshape(self.ep_size, e_local, capacity, d)
+            xin = lax.all_to_all(xin, self.axis, split_axis=0, concat_axis=0, tiled=True)
+            # [src, e_local, C, D] -> [e_local, src*C, D]
+            xin = xin.transpose(1, 0, 2, 3).reshape(e_local, self.ep_size * capacity, d)
+
+        init = (
+            sharded_lecun_init(self.axis)
+            if self.ep_size > 1
+            else nn.initializers.lecun_normal()
+        )
+        wi = self.param("tp_wi", init, (e_local, d, self.hidden), jnp.float32)
+        wo = self.param("tp_wo", init, (e_local, self.hidden, d), jnp.float32)
+        h = jnp.einsum("ecd,edh->ech", xin, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("ech,ehd->ecd", h, wo.astype(self.dtype))
+
+        if self.ep_size > 1:
+            # route expert outputs back to the token owners
+            out = out.reshape(e_local, self.ep_size, capacity, d).transpose(1, 0, 2, 3)
+            out = lax.all_to_all(out, self.axis, split_axis=0, concat_axis=0, tiled=True)
+            out = out.reshape(e, capacity, d)
+
+        y = jnp.einsum("sec,ecd->sd", combine.astype(out.dtype), out)
+        return y.reshape(b, t, d)
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN Transformer block whose MLP is an expert-parallel MoE."""
+
+    dim: int
+    n_heads: int
+    n_experts: int
+    axis: str
+    ep_size: int
+    n_select: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        h = self.n_heads
+        d = self.dim // h
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype)(y)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
+        o = full_attention(q, k, v, causal=True)
+        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(
+            o.reshape(b, t, self.dim)
+        )
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = ExpertParallelMLP(
+            dim=self.dim,
+            hidden=4 * self.dim,
+            n_experts=self.n_experts,
+            axis=self.axis,
+            ep_size=self.ep_size,
+            n_select=self.n_select,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+        )(y)
+        return x + y
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with MoE blocks; attention/embeddings replicated
+    (they gossip normally across dp), experts sharded over the EP axis."""
+
+    vocab: int = 256
+    dim: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    n_experts: int = 8
+    max_len: int = 1024
+    axis: str = "ep"
+    ep_size: int = 1
+    n_select: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, dtype=self.dtype)(jnp.arange(t))
+        for _ in range(self.n_layers):
+            x = MoEBlock(
+                self.dim,
+                self.n_heads,
+                self.n_experts,
+                self.axis,
+                self.ep_size,
+                self.n_select,
+                self.capacity_factor,
+                self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab, dtype=self.dtype)(x).astype(jnp.float32)

@@ -1,0 +1,191 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a named mesh axis.
+
+Not present in the reference (SURVEY §2.5 marks PP "absent" — its models are
+four-tensor CNNs), but part of the framework's scale story alongside TP
+(models/tp.py) and EP (models/moe.py): Transformer blocks are split into
+`pp_size` stages, each pp rank owns one stage's parameters, and microbatches
+flow through the stages as a `lax.scan` over ticks with one
+`lax.ppermute` activation shift per tick riding the ICI ring.
+
+TPU-first design decisions:
+
+  * The schedule is a static scan of `n_micro + pp_size - 1` ticks — no
+    data-dependent control flow; XLA sees one compiled loop body whose
+    matmuls stay MXU-shaped ([micro_batch, T, D] per tick).
+  * Backward is free: AD through scan+ppermute yields exactly the reverse
+    GPipe schedule (cotangents ppermute backward through the stages).
+  * Stage parameters use the framework's `tp_` sharded-leaf convention
+    (train/steps.py): each pp rank owns distinct values of the same-named
+    leaves, their gradients divide by the axis size (the masked-psum loss
+    broadcast scales cotangents by pp_size under the psum-transpose rule),
+    and gossip/grad-pmean skip the pp axis entirely.
+  * Embeddings and the LM head stay replicated across pp (they gossip
+    normally across dp): every rank embeds the batch, only stage 0's copy
+    enters the pipeline (a `where` on the stage index), and the last
+    stage's output is broadcast back with one masked `psum` so every rank
+    computes the same loss — which keeps the generic train step unchanged.
+
+A pure-pp topology is `Topology(axes=("pp",), shape=(S,), sharded_axes=("pp",))`;
+hybrid gossip×pp meshes work like gossip×TP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgrad_tpu.models.tp import sharded_lecun_init
+from eventgrad_tpu.parallel.ring_attention import full_attention
+
+
+def gpipe(stage_fn, x_micro: jnp.ndarray, axis: str, pp_size: int) -> jnp.ndarray:
+    """Run the GPipe schedule for one forward pass.
+
+    `x_micro`: [n_micro, micro_batch, ...] microbatches, replicated across
+    the pp axis (only stage 0's copy is consumed). `stage_fn` is this rank's
+    stage, a pure function on one microbatch. Returns [n_micro, ...] stage
+    outputs — valid on the LAST stage only (other ranks hold garbage;
+    callers broadcast with a masked psum).
+
+    Tick t: stage 0 feeds microbatch t, every stage applies its fn to its
+    current activation, the last stage banks its result, and activations
+    shift one stage rightward (one ppermute per tick).
+    """
+    n_micro = x_micro.shape[0]
+    stage = lax.axis_index(axis)
+    perm = [(r, (r + 1) % pp_size) for r in range(pp_size)]
+    acts0 = jnp.zeros_like(x_micro[0])
+    out0 = jnp.zeros_like(x_micro)
+
+    def tick(carry, t):
+        acts, outs = carry
+        feed = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, feed, acts)
+        out = stage_fn(inp)
+        o_idx = jnp.clip(t - (pp_size - 1), 0, n_micro - 1)
+        bank = (stage == pp_size - 1) & (t >= pp_size - 1)
+        prev = lax.dynamic_index_in_dim(outs, o_idx, axis=0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(bank, out, prev), o_idx, axis=0
+        )
+        acts = lax.ppermute(out, axis, perm)
+        return (acts, outs), None
+
+    (_, outs), _ = lax.scan(
+        tick, (acts0, out0), jnp.arange(n_micro + pp_size - 1)
+    )
+    return outs
+
+
+def _layernorm(x, scale, bias):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + 1e-6) * scale + bias
+
+
+def _block_apply(p: Dict[str, jnp.ndarray], x, n_heads: int, dtype) -> jnp.ndarray:
+    """One pre-LN Transformer block as a pure function of its param dict —
+    kept functional (not a flax submodule) so it can run inside the gpipe
+    scan body without flax lifted-transform machinery."""
+    b, t, dim = x.shape
+    d = dim // n_heads
+    y = _layernorm(x, p["ln1_scale"], p["ln1_bias"]).astype(dtype)
+    qkv = y @ p["wqkv"].astype(dtype)
+    q, k, v = jnp.split(qkv.reshape(b, t, 3 * n_heads, d), 3, axis=2)
+    o = full_attention(q, k, v, causal=True)
+    x = x + o.reshape(b, t, dim) @ p["wo"].astype(dtype)
+    y = _layernorm(x, p["ln2_scale"], p["ln2_bias"]).astype(dtype)
+    y = nn.gelu(y @ p["wi"].astype(dtype)) @ p["wo2"].astype(dtype)
+    return x + y
+
+
+class PPTransformerLM(nn.Module):
+    """Decoder-only LM whose blocks are pipeline-sharded over `axis`.
+
+    `n_layers` is the GLOBAL layer count; each of the `pp_size` stages owns
+    `n_layers // pp_size` consecutive blocks (stage-major ownership: pp rank
+    r holds global layers [r*L, (r+1)*L)). Every stage parameter is a
+    `tp_l{i}_*` leaf — same names on every rank, distinct values. With
+    pp_size == 1 all layers are local and no collective runs (the
+    sequential twin used by tests)."""
+
+    vocab: int = 256
+    dim: int = 128
+    n_heads: int = 8
+    n_layers: int = 4
+    max_len: int = 1024
+    axis: str = "pp"
+    pp_size: int = 1
+    n_micro: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.n_layers % self.pp_size:
+            raise ValueError(
+                f"n_layers {self.n_layers} not divisible by pp_size {self.pp_size}"
+            )
+        if self.n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1, got {self.n_micro}")
+        b, t = tokens.shape
+        # GPipe output is microbatch-count invariant, so the batch-1 init
+        # trace may run unsplit; any other indivisible batch is a config
+        # error (silently unsplitting would defeat the memory schedule)
+        if b == 1:
+            n_micro = 1
+        elif b % self.n_micro == 0:
+            n_micro = self.n_micro
+        else:
+            raise ValueError(
+                f"batch {b} not divisible by n_micro {self.n_micro}"
+            )
+        layers_local = self.n_layers // self.pp_size
+        sharded = self.pp_size > 1
+        kinit = sharded_lecun_init(self.axis) if sharded else nn.initializers.lecun_normal()
+
+        def ones_init(key, shape, dtype=jnp.float32):
+            return jnp.ones(shape, dtype)
+
+        def zeros_init(key, shape, dtype=jnp.float32):
+            return jnp.zeros(shape, dtype)
+
+        stage_params: List[Dict[str, jnp.ndarray]] = []
+        for i in range(layers_local):
+            stage_params.append(
+                {
+                    "ln1_scale": self.param(f"tp_l{i}_ln1_scale", ones_init, (self.dim,)),
+                    "ln1_bias": self.param(f"tp_l{i}_ln1_bias", zeros_init, (self.dim,)),
+                    "wqkv": self.param(f"tp_l{i}_wqkv", kinit, (self.dim, 3 * self.dim), jnp.float32),
+                    "wo": self.param(f"tp_l{i}_wo", kinit, (self.dim, self.dim), jnp.float32),
+                    "ln2_scale": self.param(f"tp_l{i}_ln2_scale", ones_init, (self.dim,)),
+                    "ln2_bias": self.param(f"tp_l{i}_ln2_bias", zeros_init, (self.dim,)),
+                    "wi": self.param(f"tp_l{i}_wi", kinit, (self.dim, 4 * self.dim), jnp.float32),
+                    "wo2": self.param(f"tp_l{i}_wo2", kinit, (4 * self.dim, self.dim), jnp.float32),
+                }
+            )
+
+        def stage_fn(h):
+            for p in stage_params:
+                h = _block_apply(p, h, self.n_heads, self.dtype)
+            return h
+
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, dtype=self.dtype)(jnp.arange(t))
+
+        if sharded:
+            xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+            ym = gpipe(stage_fn, xm, self.axis, self.pp_size)
+            y = ym.reshape(x.shape)
+            last = lax.axis_index(self.axis) == self.pp_size - 1
+            y = lax.psum(jnp.where(last, y, jnp.zeros_like(y)), self.axis)
+        else:
+            y = stage_fn(x)
+
+        y = nn.LayerNorm(dtype=self.dtype)(y)
+        return nn.Dense(self.vocab, dtype=self.dtype)(y).astype(jnp.float32)

@@ -1,0 +1,144 @@
+"""Tensor parallelism: Megatron-style sharded Transformer layers.
+
+Not present in the reference (its models are tiny CNNs — SURVEY §2.5 marks
+TP "absent"), but required for the framework's scale story: the same named
+mesh axis machinery that carries gossip and ring attention here shards the
+weight matrices themselves.
+
+  * `ColParallelDense` — kernel [d_in, d_out/N] per rank; output stays
+    sharded over features (no collective).
+  * `RowParallelDense` — kernel [d_in/N, d_out] per rank; partial products
+    psum over the TP axis (one all-reduce per layer exit, riding ICI).
+  * `TPBlock` / `TPTransformerLM` — attention heads and MLP hidden units
+    sharded across the TP axis; activations enter and leave each block
+    replicated.
+
+Parameter shards are distinct per TP rank, so the topology must list the
+axis in `sharded_axes`: gossip and gradient-pmean skip it, and shard
+initialization uses a TP-rank-folded RNG (identical across dp/sp ranks,
+distinct across tp ranks — see `tp_init_rng`).
+
+All TP layers are bias-free (biases would need post-psum correction and
+contribute nothing at these widths — standard Megatron practice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgrad_tpu.parallel.ring_attention import full_attention
+from eventgrad_tpu.parallel.topology import Topology
+
+
+def sharded_lecun_init(axis: str):
+    """lecun_normal folded with the TP-axis index: under a shared init key,
+    sharded kernels come out distinct per TP rank while every non-TP
+    parameter (initialized with the unfolded key) stays replica-identical
+    across the whole mesh."""
+    base = nn.initializers.lecun_normal()
+
+    def init(key, shape, dtype=jnp.float32):
+        return base(jax.random.fold_in(key, lax.axis_index(axis)), shape, dtype)
+
+    return init
+
+
+class ColParallelDense(nn.Module):
+    features: int  # GLOBAL output features
+    axis: str
+    tp_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.features % self.tp_size == 0
+        local = self.features // self.tp_size
+        kernel = self.param(
+            "tp_kernel",
+            sharded_lecun_init(self.axis) if self.tp_size > 1
+            else nn.initializers.lecun_normal(),
+            (x.shape[-1], local),
+            jnp.float32,
+        )
+        return x @ kernel.astype(self.dtype)
+
+
+class RowParallelDense(nn.Module):
+    features: int  # GLOBAL output features
+    axis: str
+    tp_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [..., d_in/N] sharded on features; output replicated via psum
+        kernel = self.param(
+            "tp_kernel",
+            sharded_lecun_init(self.axis) if self.tp_size > 1
+            else nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        y = x @ kernel.astype(self.dtype)
+        if self.tp_size > 1:
+            y = lax.psum(y, self.axis)
+        return y
+
+
+class TPBlock(nn.Module):
+    dim: int
+    n_heads: int  # GLOBAL head count
+    axis: str
+    tp_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        assert self.n_heads % self.tp_size == 0
+        h_local = self.n_heads // self.tp_size
+        d = self.dim // self.n_heads
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = ColParallelDense(3 * self.dim, self.axis, self.tp_size, self.dtype)(y)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3 * h_local, d), 3, axis=2)
+        o = full_attention(q, k, v, causal=True)  # local heads, full sequence
+        o = RowParallelDense(self.dim, self.axis, self.tp_size, self.dtype)(
+            o.reshape(b, t, h_local * d)
+        )
+        x = x + o
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = ColParallelDense(4 * self.dim, self.axis, self.tp_size, self.dtype)(y)
+        y = nn.gelu(y)
+        y = RowParallelDense(self.dim, self.axis, self.tp_size, self.dtype)(y)
+        return x + y
+
+
+class TPTransformerLM(nn.Module):
+    """Decoder-only LM with TP-sharded blocks; embeddings and head stay
+    replicated (they gossip normally across dp)."""
+
+    vocab: int = 256
+    dim: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    max_len: int = 1024
+    axis: str = "tp"
+    tp_size: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, dtype=self.dtype)(jnp.arange(t))
+        for _ in range(self.n_layers):
+            x = TPBlock(self.dim, self.n_heads, self.axis, self.tp_size, self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab, dtype=self.dtype)(x).astype(jnp.float32)

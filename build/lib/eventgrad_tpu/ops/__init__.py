@@ -1,0 +1,6 @@
+from eventgrad_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_lse,
+    flash_attention_reference,
+)
+from eventgrad_tpu.ops.fused_update import fused_mix_sgd, mix_sgd_reference

@@ -1,0 +1,482 @@
+"""Pallas TPU kernel: FlashAttention-2 style fused attention (fwd + bwd).
+
+The Transformer/SP stack (beyond-reference capability; the reference trains
+only image CNNs) spends its FLOPs in attention. The jnp paths in
+`parallel/ring_attention.py` materialize [B,H,Tq,Tk] score tensors in HBM;
+these kernels stream one (128, D) K/V tile through VMEM per grid step with
+the online-softmax recurrence, so scores never leave the chip and VMEM
+residency is O(block), not O(T):
+
+    forward:  grid (B, H, nQ, nK) — TPU iterates the last grid dimension
+              sequentially, so (m, l, acc) live in VMEM scratch across the
+              nK sweep; the output block and logsumexp are written on the
+              final K step. Causal Q/K block pairs above the diagonal are
+              skipped with pl.when.
+    backward: recomputation-style FlashAttention-2 — a dQ kernel sweeping
+              KV blocks and a dK/dV kernel sweeping Q blocks, same
+              scratch-accumulator pattern; the score matrix is rebuilt
+              from (q, k, lse) one tile at a time.
+
+Layout contract matches the models: q/k/v are [B, T, H, D] (self-attention:
+all three share T). Internally heads move next to batch ([B, H, T, D]), T is
+padded to a multiple of the 128-row block and D to a multiple of the
+128-lane tile; padded K columns are masked, padded Q rows are sliced off
+(their dK/dV contributions vanish because the padded dOut rows are zero).
+
+`interpret=None` auto-selects the Pallas interpreter off-TPU, so the same
+code path runs in the CPU-mesh test harness and compiled on real chips.
+`flash_attention_reference` (= `parallel.ring_attention.full_attention`)
+is the materialized-score twin used by tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from eventgrad_tpu.parallel.ring_attention import full_attention
+
+try:  # TPU memory spaces only exist on TPU builds; interpret mode elsewhere
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_LANES = 128
+_BLOCK = 128  # Q and KV block rows; (128, 128) tiles feed the MXU directly
+_NEG_INF = -1e30  # finite mask value; exact zeros guaranteed by masking p
+
+flash_attention_reference = full_attention
+
+
+def _spec(block_shape, index_map, interpret):
+    kw = {} if (interpret or _VMEM is None) else {"memory_space": _VMEM}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+def _any_scratch(shape):
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _compiler_params(interpret):
+    """B/H/Q grid dims are independent (megacore-partitionable); only the
+    innermost accumulation sweep is sequential."""
+    if interpret:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
+    }
+
+
+def _causal_kv_index(causal):
+    """K/V block index for fwd/dq grid step (qi, kj). For causal steps above
+    the diagonal (skipped by pl.when) revisit block qi instead: Pallas elides
+    the DMA when the block index repeats, halving causal HBM traffic."""
+    if causal:
+        return lambda b, h, i, j: (b, h, jnp.minimum(j, i), 0)
+    return lambda b, h, i, j: (b, h, j, 0)
+
+
+def _causal_q_index(causal):
+    """Q-side block index for the dkv grid step (kj, qi innermost): causal
+    steps with qi < kj are skipped, so revisit block kj there."""
+    if causal:
+        return lambda b, h, j, i: (b, h, jnp.maximum(i, j), 0)
+    return lambda b, h, j, i: (b, h, i, 0)
+
+
+def _block_mask(qi, kj, t_real_k, causal, q_off=0, k_off=0):
+    """Validity of score block (qi, kj). The padding mask is in local
+    coordinates; the causal comparison adds the global offsets (ring hops
+    pass the rank origins of the resident Q and K shards)."""
+    qpos = qi * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 0)
+    kpos = kj * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 1)
+    valid = kpos < t_real_k
+    if causal:
+        valid &= (q_off + qpos) >= (k_off + kpos)
+    return valid
+
+
+def _unpack(args, n_scratch, has_offsets):
+    """Split pallas kernel args into (offs_ref|None, io_refs, scratch_refs)."""
+    scratch = args[len(args) - n_scratch:]
+    io = args[: len(args) - n_scratch]
+    if has_offsets:
+        return io[0], io[1:], scratch
+    return None, io, scratch
+
+
+def _dot(a, b, trans=False):
+    dims = (((1,), (1,)), ((), ())) if trans else (((1,), (0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
+    offs_ref, (q_ref, k_ref, v_ref, o_ref, lse_ref), (m_s, l_s, a_s) = _unpack(
+        args, 3, has_offsets
+    )
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
+    qi, kj = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s[...], _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s[...])
+        a_s[...] = jnp.zeros_like(a_s[...])
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = _dot(q, k, trans=True)  # [bq, bk]
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev, l_prev = m_s[...], l_s[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new) * valid  # masked p is exactly 0
+        corr = jnp.exp(m_prev - m_new)
+        m_s[...] = m_new
+        l_s[...] = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        a_s[...] = a_s[...] * corr + _dot(p, v)
+
+    if causal and not has_offsets:  # skip KV blocks above the diagonal
+        pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
+    else:  # offset diagonals are dynamic: mask handles everything
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0, 0] = (a_s[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[...] + jnp.log(l_safe)
+
+
+def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
+    offs_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref), (dq_s,) = (
+        _unpack(args, 1, has_offsets)
+    )
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
+    qi, kj = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s[...])
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
+        s = _dot(q, k, trans=True)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse) * valid
+        dp = _dot(do, v, trans=True)
+        ds = p * (dp - delta) * scale
+        dq_s[...] += _dot(ds, k)
+
+    if causal and not has_offsets:
+        pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets):
+    (
+        offs_ref,
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref),
+        (dk_s, dv_s),
+    ) = _unpack(args, 2, has_offsets)
+    q_off = offs_ref[0, 0] if has_offsets else 0
+    k_off = offs_ref[0, 1] if has_offsets else 0
+    kj, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s[...])
+        dv_s[...] = jnp.zeros_like(dv_s[...])
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
+        s = scale * _dot(q, k, trans=True)  # [bq, bk]
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse) * valid
+        dv_s[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = _dot(do, v, trans=True)
+        ds = p * (dp - delta) * scale
+        dk_s[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal and not has_offsets:
+        # Q blocks strictly before this KV block contribute nothing
+        pl.when((qi + 1) * _BLOCK > kj * _BLOCK)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _pad_to(x, t_pad, d_pad):
+    b, h, t, d = x.shape
+    return jnp.pad(x, ((0, 0), (0, 0), (0, t_pad - t), (0, d_pad - d)))
+
+
+def _dims(t, d):
+    t_pad = max(_BLOCK, -(-t // _BLOCK) * _BLOCK)
+    d_pad = max(_LANES, -(-d // _LANES) * _LANES)
+    return t_pad, d_pad, t_pad // _BLOCK
+
+
+def _offs_spec(interpret):
+    """(1, 2) int32 [q_offset, k_offset] — scalar memory on real TPU."""
+    kw = {}
+    if not interpret and pltpu is not None:
+        kw["memory_space"] = pltpu.SMEM
+    return pl.BlockSpec((1, 2), lambda b_, h_, i, j: (0, 0), **kw)
+
+
+def _run_fwd(q, k, v, causal, interpret, offsets=None):
+    """q/k/v: [B, H, T, D] (already transposed). Returns (out, lse [B,H,T,1]).
+
+    offsets: traced (1, 2) int32 [q_offset, k_offset] shifting the causal
+    mask to global positions (ring attention hops), or None."""
+    b, h, t, d = q.shape
+    t_pad, d_pad, n = _dims(t, d)
+    qp, kp, vp = (_pad_to(x, t_pad, d_pad) for x in (q, k, v))
+    scale = 1.0 / float(d) ** 0.5
+    has_offs = offsets is not None
+
+    q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    kv_blk = _spec(
+        (1, 1, _BLOCK, d_pad), _causal_kv_index(causal and not has_offs), interpret
+    )
+    row_blk = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    in_specs = [q_blk, kv_blk, kv_blk]
+    operands = [qp, kp, vp]
+    if has_offs:
+        in_specs.insert(0, _offs_spec(interpret))
+        operands.insert(0, offsets)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, t_real=t, nk=n,
+            has_offsets=has_offs,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t_pad, 1), jnp.float32),
+        ),
+        grid=(b, h, n, n),
+        in_specs=in_specs,
+        out_specs=(q_blk, row_blk),
+        scratch_shapes=[
+            _any_scratch((_BLOCK, 1)),
+            _any_scratch((_BLOCK, 1)),
+            _any_scratch((_BLOCK, d_pad)),
+        ],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(*operands)
+    return out[:, :, :t, :d], lse[:, :, :t, :]
+
+
+def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
+    """FA2 backward. dlse (cotangent of the logsumexp output, [B,H,T,1])
+    folds into the delta term: ds = p * (dp - (delta - dlse))."""
+    b, h, t, d = q.shape
+    t_pad, d_pad, n = _dims(t, d)
+    qp, kp, vp, op, dop = (_pad_to(x, t_pad, d_pad) for x in (q, k, v, out, do))
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    scale = 1.0 / float(d) ** 0.5
+    has_offs = offsets is not None
+    delta = (dop.astype(jnp.float32) * op.astype(jnp.float32)).sum(-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - jnp.pad(
+            dlse.astype(jnp.float32), ((0, 0), (0, 0), (0, t_pad - t), (0, 0))
+        )
+    skip = causal and not has_offs
+
+    q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    kv_blk = _spec((1, 1, _BLOCK, d_pad), _causal_kv_index(skip), interpret)
+    row_q = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    dq_specs = [q_blk, kv_blk, kv_blk, q_blk, row_q, row_q]
+    dq_ops = [qp, kp, vp, dop, lsep, delta]
+    if has_offs:
+        dq_specs.insert(0, _offs_spec(interpret))
+        dq_ops.insert(0, offsets)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, t_real=t, nk=n,
+            has_offsets=has_offs,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
+        grid=(b, h, n, n),
+        in_specs=dq_specs,
+        out_specs=q_blk,
+        scratch_shapes=[_any_scratch((_BLOCK, d_pad))],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(*dq_ops)
+
+    # grid order (..., kv-block, q-block): the Q sweep is innermost
+    kv_outer = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, j, i: (b_, h_, j, 0), interpret)
+    q_inner = _spec((1, 1, _BLOCK, d_pad), _causal_q_index(skip), interpret)
+    row_inner = _spec((1, 1, _BLOCK, 1), _causal_q_index(skip), interpret)
+    dkv_specs = [q_inner, kv_outer, kv_outer, q_inner, row_inner, row_inner]
+    dkv_ops = [qp, kp, vp, dop, lsep, delta]
+    if has_offs:
+        dkv_specs.insert(0, _offs_spec(interpret))
+        dkv_ops.insert(0, offsets)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, t_real=t, nq=n,
+            has_offsets=has_offs,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, t_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t_pad, d_pad), v.dtype),
+        ),
+        grid=(b, h, n, n),
+        in_specs=dkv_specs,
+        out_specs=(kv_outer, kv_outer),
+        scratch_shapes=[_any_scratch((_BLOCK, d_pad)), _any_scratch((_BLOCK, d_pad))],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(*dkv_ops)
+    cut = lambda x: x[:, :, :t, :d]
+    return cut(dq), cut(dk), cut(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhtd(q, k, v, causal, interpret):
+    out, _ = _run_fwd(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    out, lse = _run_fwd(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    q, k, v, out, lse = res
+    # do stays in its incoming (usually f32) dtype: kernels upcast anyway,
+    # and truncating the cotangent to a bf16 q.dtype would lose precision
+    dq, dk, dv = _run_bwd(q, k, v, out, lse, do, causal, interpret)
+    return dq, dk, dv
+
+
+_flash_bhtd.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_lse_bhtd(q, k, v, offs, causal, interpret):
+    return _run_fwd(q, k, v, causal, interpret, offsets=offs)
+
+
+def _flash_lse_fwd(q, k, v, offs, causal, interpret):
+    out, lse = _run_fwd(q, k, v, causal, interpret, offsets=offs)
+    return (out, lse), (q, k, v, offs, out, lse)
+
+
+def _flash_lse_bwd(causal, interpret, res, cts):
+    q, k, v, offs, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _run_bwd(
+        q, k, v, out, lse, do, causal, interpret, offsets=offs, dlse=dlse
+    )
+    d_offs = np.zeros(offs.shape, jax.dtypes.float0)  # int operand: no tangent
+    return dq, dk, dv, d_offs
+
+
+_flash_lse_bhtd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    q_offset=0,
+    k_offset=0,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention returning (out [B,T,H,D], logsumexp [B,T,H]).
+
+    The lse output makes partial results mergeable with the online-softmax
+    combine rule — ring attention computes each KV hop through this kernel
+    and folds the hops together (parallel/ring_attention.py). q_offset and
+    k_offset (traced ints) shift the causal mask to global sequence
+    positions: hop blocks are fully-visible, diagonal, or fully-masked
+    depending on the ranks' relative positions. Differentiable in q/k/v,
+    including through lse (the dlse cotangent folds into the delta term of
+    the FA2 backward)."""
+    if not (q.shape == k.shape == v.shape):
+        raise ValueError(
+            f"flash_attention_lse: q/k/v shapes must match, got "
+            f"{q.shape}, {k.shape}, {v.shape}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offs = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )[None, :]
+    to_bhtd = lambda x: jnp.swapaxes(x, 1, 2)
+    out, lse = _flash_lse_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), offs, causal, bool(interpret)
+    )
+    return to_bhtd(out), jnp.swapaxes(lse[..., 0], 1, 2)  # lse -> [B,T,H]
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused self-attention on [B, T, H, D] tensors (model layout).
+
+    Differentiable (custom FA2 backward). q, k, v must share one sequence
+    length. interpret=None auto-selects the Pallas interpreter off-TPU so
+    tests run on the CPU mesh; on TPU the kernels compile to Mosaic.
+    """
+    if not (q.shape == k.shape == v.shape):
+        raise ValueError(
+            f"flash_attention is self-attention: q/k/v shapes must match, "
+            f"got {q.shape}, {k.shape}, {v.shape}"
+        )
+    if pltpu is None:  # no pallas-tpu module: kernels (incl. their VMEM
+        return full_attention(q, k, v, causal=causal)  # scratch) can't build
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    to_bhtd = lambda x: jnp.swapaxes(x, 1, 2)
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, bool(interpret))
+    return to_bhtd(out)

@@ -1,0 +1,141 @@
+"""EventGraD: per-parameter event-triggered communication state machine.
+
+Pure-functional rebuild of the sender-side state of
+/root/reference/dmnist/event/event.cpp:
+
+  * event condition  (event.cpp:343):
+        fire_i  <-  |‖p_i‖₂ − last_sent_norm_i| >= thres_i
+                    OR pass_num < warmup_passes          (warmup, :262)
+  * threshold decay BEFORE the check (adaptive: thres *= horizon, :330-332;
+    constant mode: thres = constant, :332-334)
+  * on fire (adaptive): slope history ring-buffer shifts in
+    value_diff/iter_diff and thres becomes the history mean (:363-378);
+    last_sent_norm/iter update (:380-382)
+  * num_events += n_neighbors per fired parameter (:344 counts 2 on a ring)
+
+The reference keeps this state in C scalar arrays indexed by parameter
+(:181-225); here it is a pytree-of-scalars mirroring the param pytree, so the
+whole update is a fused elementwise program under jit — no per-parameter
+Python loop survives tracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from eventgrad_tpu.parallel.topology import Topology
+from eventgrad_tpu.utils import trees
+
+
+@dataclasses.dataclass(frozen=True)
+class EventConfig:
+    """Static event-trigger configuration (the reference's argv[2]/argv[3],
+    event.cpp:88-100).
+
+    adaptive=True  -> thres decays by `horizon` each pass and resets to the
+                      mean send slope on fire.
+    adaptive=False -> thres is the fixed `constant` every pass.
+    constant=0 (or horizon=0) makes every pass fire: exact D-PSGD
+    (dmnist/event/README.md's baseline-equivalence knob).
+    """
+
+    adaptive: bool = True
+    horizon: float = 0.95
+    constant: float = 0.0
+    warmup_passes: int = 30
+    history: int = 2
+
+
+class EventState(struct.PyTreeNode):
+    """Sender-side per-parameter state + per-neighbor receive buffers.
+
+    thres / last_sent_norm / last_sent_iter: pytree of f32 scalars per param.
+    slopes: pytree of f32[history] per param (sent_slopes_norm, :187).
+    bufs:   one pytree-like-params per topology neighbor — the RMA window
+            halves (:169-179), zero-initialized exactly like the reference
+            (:177-179; the /3 mixing still divides by 3 before any message
+            arrives, which warmup makes moot after pass 1).
+    num_events: local int32 event counter (:264).
+    """
+
+    thres: Any
+    last_sent_norm: Any
+    last_sent_iter: Any
+    slopes: Any
+    bufs: Tuple[Any, ...]
+    num_events: jnp.ndarray
+
+    @classmethod
+    def init(cls, params: Any, topo: Topology, cfg: EventConfig) -> "EventState":
+        zeros = trees.tree_scalar_zeros(params)
+        return cls(
+            thres=zeros,
+            last_sent_norm=zeros,
+            last_sent_iter=zeros,
+            slopes=jax.tree.map(lambda _: jnp.zeros((cfg.history,), jnp.float32), params),
+            bufs=tuple(trees.tree_zeros_like(params) for _ in topo.neighbors),
+            num_events=jnp.zeros((), jnp.int32),
+        )
+
+
+def decide_and_update(
+    params: Any,
+    state: EventState,
+    pass_num: jnp.ndarray,
+    cfg: EventConfig,
+    n_neighbors: int,
+) -> Tuple[Any, EventState]:
+    """One pass of the sender state machine for every parameter at once.
+
+    Returns (fire, new_state) where `fire` is a pytree of bools per param.
+    `pass_num` is 1-based and already incremented for this pass, matching
+    `pass_num++` at the top of the batch loop (event.cpp:273).
+    """
+    pass_f = pass_num.astype(jnp.float32)
+
+    curr_norm = trees.tree_norm(params)
+    value_diff = jax.tree.map(
+        lambda c, l: jnp.abs(c - l), curr_norm, state.last_sent_norm
+    )
+    iter_diff = jax.tree.map(lambda l: pass_f - l, state.last_sent_iter)
+
+    # threshold decay/assignment happens before the check (:330-334)
+    if cfg.adaptive:
+        thres = jax.tree.map(lambda t: t * cfg.horizon, state.thres)
+    else:
+        thres = jax.tree.map(lambda t: jnp.full_like(t, cfg.constant), state.thres)
+
+    warm = pass_num < cfg.warmup_passes
+    fire = jax.tree.map(lambda vd, t: (vd >= t) | warm, value_diff, thres)
+
+    # slope ring buffer: drop oldest, append value_diff/iter_diff (:363-373)
+    new_slopes = jax.tree.map(
+        lambda s, vd, idf: jnp.concatenate([s[1:], (vd / idf)[None]]),
+        state.slopes,
+        value_diff,
+        iter_diff,
+    )
+    slope_avg = jax.tree.map(lambda s: jnp.mean(s), new_slopes)
+
+    if cfg.adaptive:
+        thres_on_fire = slope_avg  # (:376-378)
+    else:
+        thres_on_fire = thres
+
+    new_state = state.replace(
+        thres=trees.tree_where(fire, thres_on_fire, thres),
+        last_sent_norm=trees.tree_where(fire, curr_norm, state.last_sent_norm),
+        last_sent_iter=trees.tree_where(
+            fire, jax.tree.map(lambda _: pass_f, curr_norm), state.last_sent_iter
+        ),
+        slopes=trees.tree_where(fire, new_slopes, state.slopes),
+        num_events=state.num_events
+        + n_neighbors
+        * sum(f.astype(jnp.int32) for f in jax.tree.leaves(fire)),
+    )
+    return fire, new_state

@@ -1,0 +1,205 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no attention or sequence dimension at all (image CNNs
+only — SURVEY §5 "long-context: absent"), but its core communication
+primitive — a ring of neighbor exchanges — is *exactly* the collective that
+long-context attention needs. This module generalizes the framework's ring
+machinery (`collectives.recv_from` on a named mesh axis) from gossiping
+parameters to rotating KV blocks, making long-sequence training a
+first-class capability of the same topology layer:
+
+  * `ring_attention`: the sequence is sharded across the ring axis; each
+    rank keeps its Q shard resident and the (K, V) shards rotate one hop
+    per step (N ppermutes on ICI), accumulating attention with an online
+    (flash-style) running max/denominator — memory O(T/N) per chip,
+    overlap-friendly, exact.
+  * `ulysses_attention`: all-to-all switches sequence sharding to head
+    sharding, computes full attention locally over heads, and switches
+    back — one collective pair instead of N hops; needs n_heads % N == 0.
+
+Both are pure per-rank SPMD functions: lift with `parallel.spmd` under
+vmap (tests, single chip) or shard_map (real mesh), like every other
+collective here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgrad_tpu.parallel.topology import NeighborSpec, Topology
+
+
+def _block_attend(q, k, v, bias):
+    """Scaled dot-product scores of a local Q block against one KV block.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D]; bias broadcastable to [B,H,Tq,Tk].
+    Returns (scores [B,H,Tq,Tk] fp32, v) ready for online-softmax merge.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
+    if bias is not None:
+        scores = scores + bias
+    return scores
+
+
+def _online_merge(m, l, o, scores, v):
+    """Numerically-stable streaming softmax accumulation (the flash
+    recurrence): fold one block's scores/values into running (max m,
+    denominator l, unnormalized output o)."""
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])  # [B,H,Tq,Tk]
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    topo: Topology,
+    axis: Optional[str] = None,
+    causal: bool = False,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on a ring axis.
+
+    q/k/v: per-rank shards [B, T_local, H, D]; global sequence length is
+    T_local * axis_size, shard r owning positions [r*T_local, (r+1)*T_local).
+    Returns the local output shard [B, T_local, H, D] (q.dtype).
+
+    use_flash=True computes each hop's block attention with the Pallas
+    FlashAttention kernel (out + logsumexp, global-position causal offsets)
+    and folds hops together with the two-way online-softmax merge — scores
+    stay in VMEM instead of materializing [B,H,T/N,T/N] per hop.
+    """
+    axis = axis or topo.axes[0]
+    n = topo.axis_size(axis)
+    nb = NeighborSpec(axis, -1)  # KV block arrives from the left each hop
+    b, t_local, h, d = q.shape
+    my_rank = lax.axis_index(axis)
+
+    if use_flash:
+        from eventgrad_tpu.ops.attention import flash_attention_lse
+
+        def body_flash(step, carry):
+            o, lse, kv = carry  # o [B,T,H,D] f32; lse [B,T,H] f32
+            k_cur, v_cur = kv
+            src = (my_rank - step) % n
+            o_blk, lse_blk = flash_attention_lse(
+                q, k_cur, v_cur, causal=causal,
+                q_offset=my_rank * t_local, k_offset=src * t_local,
+            )
+            lse_new = jnp.logaddexp(lse, lse_blk)
+            w_old = jnp.exp(lse - lse_new)[..., None]
+            w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+            o = o * w_old + o_blk.astype(jnp.float32) * w_blk
+            kv = jax.tree.map(lambda x: lax.ppermute(
+                x, axis, [((r + nb.offset) % n, r) for r in range(n)]), kv)
+            return o, lse_new, kv
+
+        o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+        lse0 = jnp.full((b, t_local, h), -jnp.inf, jnp.float32)
+        o, _, _ = lax.fori_loop(0, n, body_flash, (o0, lse0, (k, v)))
+        return o.astype(q.dtype)
+
+    m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_local), jnp.float32)
+    o = jnp.zeros((b, t_local, h, d), jnp.float32)
+
+    def body(step, carry):
+        m, l, o, kv = carry
+        k_cur, v_cur = kv
+        # after `step` hops the resident KV block originated at rank r-step
+        src = (my_rank - step) % n
+        bias = None
+        if causal:
+            q_pos = my_rank * t_local + jnp.arange(t_local)
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+        scores = _block_attend(q, k_cur, v_cur, bias)
+        m, l, o = _online_merge(m, l, o, scores, v_cur)
+        kv = jax.tree.map(lambda x: lax.ppermute(
+            x, axis, [((r + nb.offset) % n, r) for r in range(n)]), kv)
+        return m, l, o, kv
+
+    m, l, o, _ = lax.fori_loop(0, n, body, (m, l, o, (k, v)))
+    # guard fully-masked rows (can't happen for causal with aligned shards,
+    # but keeps the primitive total)
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    topo: Topology,
+    axis: Optional[str] = None,
+    causal: bool = False,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style SP: all_to_all seq-sharded -> head-sharded,
+    full local attention, all_to_all back. Requires H % axis_size == 0.
+
+    use_flash=True runs the local attention through the Pallas
+    FlashAttention kernel (ops/attention.py) — after the all_to_all each
+    rank holds full-sequence causal self-attention over its head shard,
+    which is exactly the kernel's contract."""
+    axis = axis or topo.axes[0]
+    n = topo.axis_size(axis)
+    b, t_local, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"n_heads {h} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # [B, T/N, H, D] -> [B, T, H/N, D]: head chunk i ships to rank i,
+        # received shards concatenate in rank order along the sequence
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        # [B, T, H/N, D] -> [B, T/N, H, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from eventgrad_tpu.ops.attention import flash_attention
+
+        return heads_to_seq(flash_attention(qg, kg, vg, causal=causal))
+    t = t_local * n
+    bias = None
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+    scores = _block_attend(qg, kg, vg, bias)
+    m = scores.max(axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / p.sum(-1, keepdims=True)).astype(vg.dtype),
+                     vg, preferred_element_type=jnp.float32)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def full_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
+    """Single-device reference attention (for tests and 1-rank fallback)."""
+    t, s = q.shape[1], k.shape[1]
+    bias = None
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), bool))
+        bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+    scores = _block_attend(q, k, v, bias)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

@@ -1,0 +1,131 @@
+"""Lift per-rank SPMD functions onto devices (shard_map) or one chip (vmap).
+
+The reference runs N MPI processes, each executing one `main()` body
+(/root/reference/dmnist/event/event.cpp:86). Here the per-rank program is a
+*pure function* written against named collective axes, and this module lifts
+it two ways:
+
+  * `spmd(fn, topo, mesh=...)` — `jax.shard_map` over a real
+    `jax.sharding.Mesh`: one rank per device/chip, collectives ride ICI/DCN.
+  * `spmd(fn, topo)` — nested `jax.vmap(axis_name=...)`: all ranks batched
+    onto whatever device the arrays live on. `lax.ppermute`/`psum` work
+    identically over vmap axes, so the *same* per-rank code simulates an
+    N-rank ring on a single TPU chip — the MXU sees one big batched matmul
+    per step, which is exactly how a TPU wants this workload shaped.
+
+Global arrays use the "stacked" layout: one leading axis of size
+`topo.n_ranks` (row-major over `topo.shape`). The per-rank `fn` never sees
+that axis.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from eventgrad_tpu.parallel.topology import Topology
+
+
+def build_mesh(topo: Topology, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A `jax.sharding.Mesh` shaped like the topology.
+
+    Replaces `MPI_Init`/`MPI_Comm_size`/`MPI_Comm_rank`
+    (/root/reference/dmnist/cent/cent.cpp:42-44). On real hardware pass the
+    TPU devices; JAX handles multi-host DCN meshes with the same API.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = topo.n_ranks
+    if len(devices) < n:
+        raise ValueError(
+            f"topology needs {n} devices, only {len(devices)} available; "
+            "use spmd(fn, topo) with mesh=None to simulate on one device"
+        )
+    dev_array = np.asarray(devices[:n]).reshape(topo.shape)
+    return Mesh(dev_array, topo.axes)
+
+
+def stacked_spec(topo: Topology) -> P:
+    """PartitionSpec of the stacked layout: the single leading [n_ranks]
+    axis sharded over every mesh axis, row-major."""
+    return P(topo.axes if len(topo.axes) > 1 else topo.axes[0])
+
+
+def stack_for_ranks(tree: Any, topo: Topology) -> Any:
+    """Broadcast a per-rank pytree to the stacked layout: every leaf gains a
+    leading `n_ranks` axis holding identical copies (the reference seeds all
+    ranks identically — torch::manual_seed(0), event.cpp:150 — so replicated
+    initial state is the faithful starting point)."""
+    n = topo.n_ranks
+    return jax.tree.map(lambda x: jax.numpy.broadcast_to(x[None], (n,) + x.shape), tree)
+
+
+def _reshape_leading(tree: Any, new_lead: tuple) -> Any:
+    return jax.tree.map(lambda x: x.reshape(new_lead + x.shape[1:]), tree)
+
+
+def spmd(
+    fn: Callable,
+    topo: Topology,
+    mesh: Optional[Mesh] = None,
+    check_vma: bool = False,
+) -> Callable:
+    """Lift per-rank `fn(*args) -> out` to stacked global arrays.
+
+    All positional args and outputs must be pytrees whose every leaf carries
+    the stacked leading axis of size `topo.n_ranks`. Python scalars/static
+    config must be closed over in `fn`, not passed as args.
+    """
+    n = topo.n_ranks
+
+    if mesh is None:
+        # vmap simulation path: reshape [N, ...] -> topo.shape + [...] and
+        # nest one named vmap per topology axis (outermost axis first).
+        inner = fn
+        for axis in reversed(topo.axes):
+            inner = jax.vmap(inner, axis_name=axis)
+
+        n_axes = len(topo.shape)
+
+        @functools.wraps(fn)
+        def simulated(*args):
+            args = tuple(_reshape_leading(a, topo.shape) for a in args)
+            out = inner(*args)
+            return jax.tree.map(lambda x: x.reshape((n,) + x.shape[n_axes:]), out)
+
+        return simulated
+
+    # shard_map path: leading stacked axis sharded over all mesh axes
+    # (row-major, matching the stacked layout); per-shard leading dim is 1,
+    # squeezed away so `fn` sees true per-rank shapes.
+    spec = stacked_spec(topo)
+
+    def shard_body(*args):
+        args = tuple(jax.tree.map(lambda x: x[0], a) for a in args)
+        out = fn(*args)
+        return jax.tree.map(lambda x: x[None], out)
+
+    mapped = jax.shard_map(
+        shard_body, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=check_vma
+    )
+
+    @functools.wraps(fn)
+    def sharded(*args):
+        return mapped(*args)
+
+    return sharded
+
+
+def rank_index(topo: Topology) -> jax.Array:
+    """Flattened rank id inside a per-rank fn (replaces MPI_Comm_rank)."""
+    import jax.lax as lax
+
+    idx = lax.axis_index(topo.axes[0])
+    for axis in topo.axes[1:]:
+        idx = idx * topo.axis_size(axis) + lax.axis_index(axis)
+    return idx

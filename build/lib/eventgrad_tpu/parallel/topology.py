@@ -1,0 +1,153 @@
+"""Neighbor topologies on named mesh axes.
+
+TPU-native replacement for the reference's MPI ring arithmetic
+(`left = (rank-1+N) % N`, `right = (rank+1) % N`,
+/root/reference/dmnist/event/event.cpp:113-122,
+/root/reference/dmnist/decent/decent.cpp:56-64): instead of integer rank
+bookkeeping, a topology names mesh axes and enumerates neighbor *shifts*.
+Each shift compiles to a single `jax.lax.ppermute` that rides the ICI
+links of the physical TPU torus.
+
+A `Ring` has two neighbors (offset -1 and +1 on one axis) and reproduces
+the reference exactly. A `Torus` generalizes to 4 neighbors on two axes —
+the BASELINE stress configuration (v4-256 2D torus) — with uniform
+1/(1+n_neighbors) mixing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class NeighborSpec:
+    """One neighbor direction: a shift of `offset` along mesh axis `axis`.
+
+    `offset=-1` means "the value I receive comes from my left neighbor"
+    (rank r receives from rank r-1 mod n, matching the reference's `left`).
+    """
+
+    axis: str
+    offset: int
+
+    @property
+    def name(self) -> str:
+        sign = "m" if self.offset < 0 else "p"
+        return f"{self.axis}_{sign}{abs(self.offset)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A named-axis layout of ranks plus the gossip neighbor set.
+
+    Three axis classes:
+      * gossip axes (`gossip_axes`, default all): carry the decentralized
+        neighbor exchanges; per-rank parameters differ and mix by averaging.
+      * replicated aux axes (everything else not in `sharded_axes`): e.g. a
+        sequence-parallel axis — ranks hold identical parameters and pmean
+        their gradients (see `ring_attention` and `train.steps`).
+      * sharded axes (`sharded_axes`): tensor/expert parallelism — each rank
+        owns a distinct parameter shard; activations are synchronized inside
+        the model (psum/all_to_all in the TP layers), so the train step must
+        NOT average parameters or gradients across them.
+    """
+
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    gossip_axes: Tuple[str, ...] = None  # type: ignore[assignment]
+    sharded_axes: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if len(self.axes) != len(self.shape):
+            raise ValueError(f"axes {self.axes} vs shape {self.shape} length mismatch")
+        if any(s < 1 for s in self.shape):
+            raise ValueError(f"invalid topology shape {self.shape}")
+        if self.gossip_axes is None:
+            object.__setattr__(
+                self,
+                "gossip_axes",
+                tuple(a for a in self.axes if a not in self.sharded_axes),
+            )
+        elif any(a not in self.axes for a in self.gossip_axes):
+            raise ValueError(f"gossip_axes {self.gossip_axes} not all in {self.axes}")
+        if any(a not in self.axes for a in self.sharded_axes):
+            raise ValueError(f"sharded_axes {self.sharded_axes} not all in {self.axes}")
+        if set(self.gossip_axes) & set(self.sharded_axes):
+            raise ValueError("an axis cannot be both gossip and sharded")
+
+    @property
+    def n_ranks(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def n_gossip_ranks(self) -> int:
+        """Extent of the gossip axes = the data-parallel degree (batches
+        shard across these; other axes replicate or chunk them)."""
+        return math.prod(self.axis_size(a) for a in self.gossip_axes)
+
+    @property
+    def is_hybrid(self) -> bool:
+        """True when the mesh carries non-gossip axes (sp/tp/pp/ep)."""
+        return self.n_gossip_ranks != self.n_ranks
+
+    @property
+    def aux_axes(self) -> Tuple[str, ...]:
+        """Replicated non-gossip axes (sequence/aux parallelism); ranks along
+        these hold identical parameters and synchronize gradients by pmean."""
+        return tuple(
+            a
+            for a in self.axes
+            if a not in self.gossip_axes and a not in self.sharded_axes
+        )
+
+    @property
+    def neighbors(self) -> Tuple[NeighborSpec, ...]:
+        """Neighbor shifts, one per gossip partner.
+
+        On an axis of size 1 there are no neighbors in that direction;
+        on an axis of size 2, -1 and +1 are the same rank but the reference
+        still sends both messages (two puts), so we keep both shifts.
+        """
+        specs = []
+        for axis, size in zip(self.axes, self.shape):
+            if size > 1 and axis in self.gossip_axes:
+                specs.append(NeighborSpec(axis, -1))
+                specs.append(NeighborSpec(axis, +1))
+        return tuple(specs)
+
+    @property
+    def n_neighbors(self) -> int:
+        return len(self.neighbors)
+
+    @property
+    def mix_weight(self) -> float:
+        """Uniform gossip mixing weight: 1/3 on a ring (event.cpp:469-471),
+        1/5 on a 2D torus."""
+        return 1.0 / (1.0 + self.n_neighbors)
+
+    def axis_size(self, axis: str) -> int:
+        return self.shape[self.axes.index(axis)]
+
+    def neighbor_source(self, rank: int, spec: NeighborSpec) -> int:
+        """Flat rank whose payload arrives at `rank` via `spec`, under the
+        row-major stacked layout (matches collectives.recv_from's ppermute:
+        rank r receives from the rank `spec.offset` away along `spec.axis`,
+        so offset=-1 is the reference's `left`, decent.cpp:56-64)."""
+        import numpy as np
+
+        ax = self.axes.index(spec.axis)
+        coords = list(np.unravel_index(rank, self.shape))
+        coords[ax] = (coords[ax] + spec.offset) % self.shape[ax]
+        return int(np.ravel_multi_index(coords, self.shape))
+
+
+def Ring(n: int, axis: str = "ring") -> Topology:
+    """1-D ring of `n` ranks — the reference's only topology."""
+    return Topology(axes=(axis,), shape=(n,))
+
+
+def Torus(nx: int, ny: int, axes: Tuple[str, str] = ("x", "y")) -> Topology:
+    """2-D torus (nx × ny) with 4 neighbors per rank."""
+    return Topology(axes=tuple(axes), shape=(nx, ny))

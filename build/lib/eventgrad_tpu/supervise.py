@@ -1,0 +1,162 @@
+"""Elastic supervisor: failure detection + automatic restart-from-snapshot.
+
+The reference has no failure story at all — a dead rank leaves its ring
+neighbors blocked in MPI_Recv forever (/root/reference/dmnist/decent/
+decent.cpp:200-205) and an MPI RMA window silently freezes. Here the
+training job runs under a supervisor that detects both failure modes:
+
+  * **crash** — the child exits nonzero;
+  * **hang** — the child stays alive but its heartbeat (the metrics
+    log / checkpoint dir) stops advancing for `--timeout` seconds, the
+    moral equivalent of a wedged collective.
+
+Either way the child is killed and relaunched with `--resume`, restoring
+the full gossip TrainState (params, optimizer moments, event thresholds,
+stale neighbor buffers) from the latest orbax snapshot — so recovery costs
+at most one `--save-every` interval of recomputation. Pair with the train
+loop's `fault_inject` ("crash:N" / "hang:N") for end-to-end drills.
+
+Usage:
+    python -m eventgrad_tpu.supervise --timeout 120 --max-restarts 3 -- \
+        --algo eventgrad --mesh ring:8 --dataset cifar10 --model resnet18 \
+        --checkpoint-dir /ckpt --save-every 1 --log-file /logs/run.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+
+def _latest_mtime(path: str) -> float:
+    """Newest mtime under `path` (file, or dir scanned recursively)."""
+    if not os.path.exists(path):
+        return 0.0
+    newest = os.path.getmtime(path)
+    if os.path.isdir(path):
+        for root, _, files in os.walk(path):
+            for f in files:
+                try:
+                    newest = max(newest, os.path.getmtime(os.path.join(root, f)))
+                except OSError:
+                    pass  # snapshot promotion may race the walk
+    return newest
+
+
+def _flag_value(args: Sequence[str], flag: str) -> Optional[str]:
+    for i, a in enumerate(args):
+        if a == flag and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _terminate(proc: subprocess.Popen, grace: float = 10.0) -> None:
+    proc.terminate()
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def supervise(
+    child_args: List[str],
+    timeout: float = 0.0,
+    max_restarts: int = 3,
+    heartbeat: Optional[str] = None,
+    poll_s: float = 0.5,
+) -> int:
+    """Run the CLI under supervision; returns the final exit code (0 on
+    eventual success). `child_args` are eventgrad_tpu.cli flags and must
+    include --checkpoint-dir (restarts would lose all progress otherwise)."""
+    ckpt_dir = _flag_value(child_args, "--checkpoint-dir")
+    if not ckpt_dir:
+        raise SystemExit("supervise: child args must include --checkpoint-dir")
+    heartbeat = heartbeat or _flag_value(child_args, "--log-file") or ckpt_dir
+
+    attempt = 0
+    while True:
+        argv = list(child_args)
+        if attempt > 0 and "--resume" not in argv:
+            argv.append("--resume")
+        cmd = [sys.executable, "-m", "eventgrad_tpu.cli", *argv]
+        started = time.time()
+        proc = subprocess.Popen(cmd)
+        reason = None
+        # stat the heartbeat at a fraction of the timeout, not every poll —
+        # a checkpoint-dir heartbeat on shared storage shouldn't see a
+        # metadata storm from its own supervisor
+        hb_every = max(poll_s, timeout / 4.0) if timeout else poll_s
+        last_hb_check, last_hb = 0.0, 0.0
+        while proc.poll() is None:
+            time.sleep(poll_s)
+            if not timeout:
+                continue
+            now = time.time()
+            if now - last_hb_check >= hb_every:
+                last_hb_check = now
+                last_hb = _latest_mtime(heartbeat)
+            if now - max(started, last_hb) > timeout:
+                # the cached mtime may be up to hb_every stale — re-stat
+                # before declaring a live child hung
+                last_hb_check = now
+                last_hb = _latest_mtime(heartbeat)
+                if now - max(started, last_hb) <= timeout:
+                    continue
+                reason = f"no heartbeat on {heartbeat} for {timeout:.0f}s"
+                _terminate(proc)
+                break
+        rc = proc.returncode
+        if rc == 0:
+            return 0
+        attempt += 1
+        desc = reason or f"exit code {rc}"
+        print(
+            f"supervise: attempt {attempt} failed ({desc}); "
+            + ("restarting from latest snapshot" if attempt <= max_restarts
+               else "giving up"),
+            file=sys.stderr, flush=True,
+        )
+        if attempt > max_restarts:
+            if rc is None:
+                return 1
+            # signal deaths (rc < 0) would wrap around in sys.exit; report
+            # them the shell way
+            return 128 + abs(rc) if rc < 0 else rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="eventgrad-tpu-supervise", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--timeout", type=float, default=0.0,
+                   help="seconds without heartbeat progress before the child "
+                        "is declared hung and killed (0 = crash detection only)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--heartbeat", default=None,
+                   help="file/dir whose mtime is the liveness signal "
+                        "(default: the child's --log-file, else its "
+                        "--checkpoint-dir)")
+    p.add_argument("child", nargs=argparse.REMAINDER,
+                   help="-- followed by eventgrad_tpu.cli flags")
+    args = p.parse_args(argv)
+    child = args.child
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        raise SystemExit("supervise: pass CLI flags after --")
+    return supervise(
+        child, timeout=args.timeout, max_restarts=args.max_restarts,
+        heartbeat=args.heartbeat,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

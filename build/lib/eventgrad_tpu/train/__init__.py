@@ -1,0 +1,3 @@
+from eventgrad_tpu.train.state import TrainState, init_train_state
+from eventgrad_tpu.train.steps import make_train_step, ALGOS
+from eventgrad_tpu.train.loop import train, evaluate, consensus_params

@@ -1,0 +1,391 @@
+"""Epoch driver: scan-compiled training, consensus, and evaluation.
+
+Mirrors the reference's shared skeleton (epoch loop -> batch loop -> comm ->
+step -> accuracy, e.g. /root/reference/dmnist/event/event.cpp:269-500) but
+compiles the *entire epoch* as one `lax.scan` over steps, so the TPU runs
+back-to-back fused steps with no host round-trips; per-epoch metrics come
+back as stacked arrays. Host batch assembly for epoch E+1 overlaps epoch
+E's device compute via `data.prefetch.EpochPrefetcher` (native shard-plan
++ memcpy gathers on a background thread).
+
+End-of-training consensus: the reference allreduce-averages parameters and
+lets rank 0 evaluate (event.cpp:517-525). Here `consensus_params` means over
+the stacked rank axis — numerically the same reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from eventgrad_tpu.data.prefetch import EpochPrefetcher
+from eventgrad_tpu.parallel import multihost
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.sparsify import SparseConfig
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Topology
+from eventgrad_tpu.data.sharding import expand_to_mesh
+from eventgrad_tpu.train.state import init_train_state, init_train_state_spmd
+from eventgrad_tpu.train.steps import make_train_step
+from eventgrad_tpu.utils import checkpoint, trees
+from eventgrad_tpu.utils.metrics import msgs_saved_pct
+
+
+def consensus_params(stacked_params: Any) -> Any:
+    """Average the per-rank models into the final consensus model."""
+    return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked_params)
+
+
+def _loss_record(pass_base: int, s_i: int, r: int,
+                 loss_all: np.ndarray) -> Dict[str, Any]:
+    """Per-(pass, rank) loss record — the shared schema of the send trace's
+    train{r}.txt rider and the non-event values{r}.txt stream."""
+    return {
+        "pass": pass_base + s_i + 1,
+        "rank": r,
+        "loss": round(float(loss_all[s_i, r]), 6),
+    }
+
+
+def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
+                 topo: Topology, state, carry: Dict[str, np.ndarray]) -> None:
+    """Append the reference's file_write=1 instrumentation as JSONL.
+
+    Send side (send{r}.txt, event.cpp:337-339,385-391): one record per
+    (pass, rank) with per-parameter norm/thres/fired vectors in leaf-major
+    order, plus the step's train loss (= train{r}.txt, the per-step loss
+    file of dcifar10/event/event.cpp:271-273). Receive side (recv{r}.txt, event.cpp:418-425,446-461): one record
+    per (pass, rank, neighbor) with the received-buffer norm and a changed
+    bit — here derived deterministically from the sender's fire bit, with
+    `carry` holding the stale norm between messages (the buffers start as
+    zeros, like the reference's window, event.cpp:177-179). A header record
+    names the parameter leaves and neighbor directions on first write."""
+    n_ranks = topo.n_ranks
+    fired_all = np.asarray(m["trace_fired"])
+    norm_all = np.asarray(m["trace_norm"])
+    thres_all = np.asarray(m["trace_thres"])
+    loss_all = np.asarray(m["loss"])
+    specs = topo.neighbors
+    last = carry["recv_norm"]
+    srcs = [
+        [topo.neighbor_source(r, nb) for r in range(n_ranks)] for nb in specs
+    ]
+    first = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a") as tf:
+        if first:
+            names = [
+                "/".join(str(getattr(p, "key", p)) for p in kp)
+                for kp, _ in jax.tree_util.tree_flatten_with_path(state.params)[0]
+            ]
+            tf.write(json.dumps({
+                "trace_params": names,
+                "trace_neighbors": [nb.name for nb in specs],
+            }) + "\n")
+        steps = fired_all.shape[0]
+        for s_i in range(steps):
+            for r in range(n_ranks):
+                rec = _loss_record(pass_base, s_i, r, loss_all)
+                rec.update(
+                    norm=[round(float(v), 6) for v in norm_all[s_i, r]],
+                    thres=[round(float(v), 6) for v in thres_all[s_i, r]],
+                    fired=[int(v) for v in fired_all[s_i, r]],
+                )
+                tf.write(json.dumps(rec) + "\n")
+            for k, nb in enumerate(specs):
+                for r in range(n_ranks):
+                    src = srcs[k][r]
+                    ch = fired_all[s_i, src]
+                    last[k, r] = np.where(ch, norm_all[s_i, src], last[k, r])
+                    tf.write(
+                        json.dumps(
+                            {
+                                "pass": pass_base + s_i + 1,
+                                "rank": r,
+                                "recv": nb.name,
+                                "changed": [int(v) for v in ch],
+                                "norm": [round(float(v), 6) for v in last[k, r]],
+                            }
+                        )
+                        + "\n"
+                    )
+
+
+def evaluate(model, params, batch_stats, x, y, batch_size: int = 1000) -> Dict[str, float]:
+    """Rank-0-style test pass (event.cpp:535-586) on a single device."""
+    variables = {"params": params}
+    if batch_stats is not None and jax.tree.leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+
+    @jax.jit
+    def fwd(xb):
+        return model.apply(variables, xb, train=False)
+
+    n = (len(x) // batch_size) * batch_size or len(x)
+    correct, total, loss_sum = 0, 0, 0.0
+    for i in range(0, n, batch_size):
+        xb = jnp.asarray(x[i : i + batch_size])
+        yb = np.asarray(y[i : i + batch_size])
+        out = np.asarray(fwd(xb))
+        if out.ndim == 3:  # LM logits [B, T, V]: score per token
+            out = out.reshape(-1, out.shape[-1])
+            yb = yb.reshape(-1)
+        logp = out - np.log(np.sum(np.exp(out - out.max(-1, keepdims=True)), -1, keepdims=True)) - out.max(-1, keepdims=True)
+        loss_sum += float(-logp[np.arange(len(yb)), yb].sum())
+        correct += int((out.argmax(-1) == yb).sum())
+        total += len(yb)
+    return {"accuracy": 100.0 * correct / total, "loss": loss_sum / total}
+
+
+def train(
+    model,
+    topo: Topology,
+    x_train: np.ndarray,
+    y_train: np.ndarray,
+    *,
+    algo: str = "dpsgd",
+    epochs: int = 2,
+    batch_size: int = 64,
+    learning_rate: float = 0.05,
+    momentum: float = 0.0,
+    event_cfg: Optional[EventConfig] = None,
+    sparse_cfg: Optional[SparseConfig] = None,
+    augment: bool = False,
+    random_sampler: bool = False,
+    sync_bn: bool = False,
+    mesh=None,
+    seed: int = 0,
+    x_test: Optional[np.ndarray] = None,
+    y_test: Optional[np.ndarray] = None,
+    log_every_epoch: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    save_every: int = 0,
+    resume: bool = False,
+    trace_file: Optional[str] = None,
+    fused_update: bool = False,
+    fault_inject: Optional[str] = None,
+    on_epoch: Optional[Any] = None,
+) -> Tuple[Any, List[Dict[str, Any]]]:
+    """Run the full training job; returns (final_state, per-epoch history).
+
+    With `checkpoint_dir`, the full gossip TrainState (+ epoch counter) is
+    snapshotted every `save_every` epochs (always at the end); `resume=True`
+    restores the latest snapshot and continues from its epoch — the elastic
+    story the reference lacks entirely (a dead MPI rank just hangs it,
+    decent.cpp:200-205).
+
+    fused_update=True routes the gossip-mix + SGD tail of each step through
+    the Pallas fused kernel (ops/fused_update.py) — one HBM read/write per
+    parameter element. Gossip algorithms only (allreduce keeps optax).
+
+    fault_inject ("crash:N" or "hang:N") kills or wedges the process right
+    after epoch N's work (post-snapshot) — the fault-injection half of the
+    elastic-recovery story (eventgrad_tpu/supervise.py); the reference has neither
+    (a dead rank just hangs its peers' MPI_Recv, decent.cpp:200-205).
+    """
+    fault_mode, fault_epoch = None, -1
+    if fault_inject:
+        fault_mode, _, n = fault_inject.partition(":")
+        if fault_mode not in ("crash", "hang") or not n.isdigit():
+            raise ValueError(f"bad fault_inject spec {fault_inject!r}")
+        fault_epoch = int(n)
+    tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
+
+    # hybrid meshes: data shards across the gossip axes only; sp ranks hold
+    # sequence chunks, sharded/replicated aux ranks (tp/pp/ep) see the same
+    # batch (the model, not the data, differs across them)
+    n_gossip = topo.n_gossip_ranks
+    hybrid = topo.is_hybrid
+    input_shape = tuple(x_train.shape[1:])
+    input_dtype = (
+        jnp.int32
+        if np.issubdtype(np.asarray(x_train).dtype, np.integer)
+        else jnp.float32
+    )
+    if "sp" in topo.axes and topo.axis_size("sp") > 1:
+        n_sp = topo.axis_size("sp")
+        if input_shape[-1] % n_sp:
+            raise ValueError(
+                f"sequence length {input_shape[-1]} not divisible by sp={n_sp}"
+            )
+        input_shape = input_shape[:-1] + (input_shape[-1] // n_sp,)
+    # sharded layers (tp/ep) and sp-offset attention read lax.axis_index at
+    # init time, so any non-gossip axis needs the SPMD-context initializer
+    init_fn = (
+        init_train_state_spmd
+        if (topo.sharded_axes or topo.aux_axes)
+        else init_train_state
+    )
+    state = init_fn(
+        model, input_shape, tx, topo, algo, event_cfg, seed=seed,
+        input_dtype=input_dtype,
+    )
+
+    multi = multihost.is_multiprocess()
+    ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+    n_params = trees.tree_count_params(
+        jax.tree.map(lambda p: p[0], state.params)
+    )
+    sz = trees.tree_num_leaves(state.params)
+    # recv-trace staleness carry — part of the snapshot so a resumed run's
+    # recv{r} records continue the interrupted trajectory exactly
+    trace_carry: Dict[str, np.ndarray] = {
+        "recv_norm": np.zeros((topo.n_neighbors, topo.n_ranks, sz))
+    }
+    start_epoch = 0
+    if ckpt_path and resume:
+        found = checkpoint.latest(ckpt_path)
+        if found:
+            try:
+                restored = checkpoint.restore(
+                    found,
+                    {"state": state, "epoch": np.int64(0),
+                     "trace_carry": trace_carry},
+                )
+                trace_carry = restored["trace_carry"]
+            except Exception as e:
+                # snapshot from before the trace carry existed: resume the
+                # training state, let the carry start from zeros (loud — a
+                # corrupt carry also lands here and recv traces diverge)
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint has no restorable trace_carry ({e!r}); "
+                    "recv-trace staleness restarts from zeros"
+                )
+                restored = checkpoint.restore(
+                    found, {"state": state, "epoch": np.int64(0)}
+                )
+            state = restored["state"]
+            start_epoch = int(restored["epoch"])
+
+    # host-side pass counter (the sharded pass_num leaf is not addressable
+    # across processes); read once here, advance arithmetically per epoch
+    start_passes = int(np.asarray(state.pass_num).reshape(-1)[0])
+    if mesh is not None:
+        state = multihost.put_stacked(state, mesh, topo)
+    step = make_train_step(
+        model, tx, topo, algo,
+        event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
+        sync_bn=sync_bn, trace=trace_file is not None,
+        fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
+    )
+    lifted = spmd(step, topo, mesh=mesh)
+
+    # donate the carried state: the scan updates params/opt/event state in
+    # place instead of holding two copies in HBM (batches can't alias — the
+    # steps-major swapaxes relayouts them)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_epoch(st, xb, yb):
+        def body(s, batch):
+            return lifted(s, batch)
+
+        # [n_ranks, steps, ...] -> scan over steps
+        xs = (jnp.swapaxes(xb, 0, 1), jnp.swapaxes(yb, 0, 1))
+        return jax.lax.scan(body, st, xs)
+
+    history: List[Dict[str, Any]] = []
+
+    prefetcher = EpochPrefetcher(
+        x_train, y_train, n_gossip, batch_size,
+        random=random_sampler, seed=seed, last_epoch=epochs,
+    )
+    try:
+        for epoch in range(start_epoch + 1, epochs + 1):
+            xb, yb = prefetcher.get(epoch)
+            if hybrid:
+                xb, yb = expand_to_mesh(xb, yb, topo)
+            steps = xb.shape[1]
+            if mesh is not None:  # global placement (spans hosts if any)
+                xb = multihost.put_stacked(xb, mesh, topo)
+                yb = multihost.put_stacked(yb, mesh, topo)
+            else:
+                xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+            t0 = time.perf_counter()
+            state, m = run_epoch(state, xb, yb)
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
+
+            # metrics are [steps, n_ranks]
+            m = multihost.to_host(m)
+            total_passes = start_passes + (epoch - start_epoch) * steps
+            rec = {
+                "epoch": epoch,
+                "algo": algo,
+                "steps": steps,
+                "wall_s": dt,
+                "loss": float(m["loss"].mean()),
+                # targets per step per rank: batch for classification,
+                # batch x t_local for LM (correct counts tokens elementwise)
+                "train_acc": 100.0 * float(m["correct"].sum())
+                / (topo.n_ranks * steps * int(np.prod(yb.shape[2:]))),
+                "sent_bytes_per_step_per_chip": float(m["sent_bytes"][..., 0].mean()),
+                "n_params": n_params,
+            }
+            if algo in ("eventgrad", "sp_eventgrad"):
+                # msgs-saved vs D-PSGD: events/(n_neighbors * passes * sz) fired
+                events_total = int(m["num_events"][-1].sum())
+                rec["num_events"] = events_total
+                rec["msgs_saved_pct"] = msgs_saved_pct(
+                    events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
+                )
+                rec["fired_frac"] = float(m["fired_frac"].mean())
+            if trace_file and "trace_fired" in m and multihost.is_primary():
+                _write_trace(
+                    trace_file, m, total_passes - steps, topo, state, trace_carry
+                )
+            elif trace_file and multihost.is_primary():
+                # non-event algos: per-step per-rank loss records — the
+                # (epoch, loss) stream cent/decent call values{r}.txt
+                # (cent.cpp:124, decent.cpp:166)
+                loss_all = np.asarray(m["loss"])
+                with open(trace_file, "a") as tf:
+                    for s_i in range(steps):
+                        for r in range(topo.n_ranks):
+                            tf.write(json.dumps(_loss_record(
+                                total_passes - steps, s_i, r, loss_all
+                            )) + "\n")
+            if x_test is not None and log_every_epoch and not multi and not hybrid:
+                # multi-process callers evaluate once at the end on
+                # allgathered params (multihost.to_host); hybrid meshes skip
+                # consensus eval — averaging across sp/tp/pp/ep ranks would
+                # mix differently-sharded parameters
+                cons = consensus_params(state.params)
+                stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+                rec.update(
+                    {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
+                )
+            history.append(rec)
+            if on_epoch is not None:  # live metrics (and liveness signal)
+                on_epoch(rec)
+            if ckpt_path and (
+                epoch == epochs or (save_every and epoch % save_every == 0)
+            ):
+                # multi-process: allgather the global-mesh state to host;
+                # checkpoint.save coordinates the one-writer snapshot
+                # (checkpoint_dir must be visible to all processes)
+                save_state = multihost.to_host(state) if multi else state
+                checkpoint.save(
+                    ckpt_path,
+                    {
+                        "state": save_state,
+                        "epoch": np.int64(epoch),
+                        "trace_carry": trace_carry,
+                    },
+                )
+            if epoch == fault_epoch:
+                if fault_mode == "crash":
+                    os._exit(13)
+                while True:  # "hang": alive but no progress (no heartbeat)
+                    time.sleep(3600)
+    finally:
+        prefetcher.close()
+
+    return state, history

@@ -1,0 +1,120 @@
+"""Train state: everything a rank mutates, as one explicit pytree.
+
+The reference's mutable per-rank state is scattered across the model, the
+torch optimizer, loop counters, and raw C arrays
+(/root/reference/dmnist/event/event.cpp:181-264). Here it is a single
+`TrainState` pytree threaded through a jit-compiled step, created directly
+in the *stacked* layout ([n_ranks, ...] leading axis): parameters replicate
+the same initialization across ranks (the reference seeds every rank with
+torch::manual_seed(0), event.cpp:150), while PRNG keys differ per rank so
+dropout/augmentation decorrelate like the reference's per-rank data order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from eventgrad_tpu.parallel.events import EventConfig, EventState
+from eventgrad_tpu.parallel.sparsify import SparseState
+from eventgrad_tpu.parallel.topology import Topology
+from eventgrad_tpu.parallel.spmd import stack_for_ranks
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # rank-local BatchNorm stats; never gossiped (see resnet.py)
+    pass_num: jnp.ndarray  # int32, pre-incremented each batch (event.cpp:273)
+    rng: jax.Array
+    event: Optional[EventState] = None
+    sparse: Optional[SparseState] = None
+
+
+def init_train_state(
+    model,
+    input_shape,
+    tx: optax.GradientTransformation,
+    topo: Topology,
+    algo: str,
+    event_cfg: Optional[EventConfig] = None,
+    seed: int = 0,
+    input_dtype=jnp.float32,
+) -> TrainState:
+    """Build a stacked TrainState for `topo.n_ranks` ranks."""
+    root = jax.random.PRNGKey(seed)
+    variables = model.init(root, jnp.zeros((1,) + tuple(input_shape), input_dtype))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+
+    event = None
+    sparse = None
+    if algo in ("eventgrad", "sp_eventgrad"):
+        event = EventState.init(params, topo, event_cfg or EventConfig())
+    if algo == "sp_eventgrad":
+        sparse = SparseState.init(params, topo)
+
+    per_rank = TrainState(
+        params=params,
+        opt_state=opt_state,
+        batch_stats=batch_stats,
+        pass_num=jnp.zeros((), jnp.int32),
+        rng=root,
+        event=event,
+        sparse=sparse,
+    )
+    stacked = stack_for_ranks(per_rank, topo)
+    # decorrelate per-rank PRNG streams
+    keys = jax.random.split(jax.random.fold_in(root, 1), topo.n_ranks)
+    return stacked.replace(rng=keys)
+
+
+def init_train_state_spmd(
+    model,
+    input_shape,
+    tx: optax.GradientTransformation,
+    topo: Topology,
+    algo: str,
+    event_cfg: Optional[EventConfig] = None,
+    seed: int = 0,
+    input_dtype=jnp.float32,
+) -> TrainState:
+    """Per-rank initialization inside the SPMD context — required when the
+    topology has `sharded_axes` (tensor/expert parallelism): sharded layers
+    fold the axis index into their own initializers (models/tp.py
+    `sharded_lecun_init`), so they need `lax.axis_index` available at init
+    time. Every rank receives the same root key; replicated parameters come
+    out identical mesh-wide, sharded kernels distinct per TP rank. Runs on
+    the vmap simulator (init is cheap); the resulting stacked state works
+    under either backend."""
+    from eventgrad_tpu.parallel.spmd import spmd
+
+    def per_rank_init(key):
+        variables = model.init(key, jnp.zeros((1,) + tuple(input_shape), input_dtype))
+        params = variables["params"]
+        event = None
+        sparse = None
+        if algo in ("eventgrad", "sp_eventgrad"):
+            event = EventState.init(params, topo, event_cfg or EventConfig())
+        if algo == "sp_eventgrad":
+            sparse = SparseState.init(params, topo)
+        return TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=variables.get("batch_stats", {}),
+            pass_num=jnp.zeros((), jnp.int32),
+            rng=key,
+            event=event,
+            sparse=sparse,
+        )
+
+    root = jax.random.PRNGKey(seed)
+    keys = jnp.broadcast_to(root, (topo.n_ranks,) + root.shape)
+    state = spmd(per_rank_init, topo)(keys)
+    rngs = jax.random.split(jax.random.fold_in(root, 1), topo.n_ranks)
+    return state.replace(rng=rngs)

@@ -1,0 +1,260 @@
+"""Per-rank train steps for all four algorithm families.
+
+One builder per reference executable:
+
+  algo="allreduce"    — E1 `cent`: psum-mean of gradients, then SGD
+                        (/root/reference/dmnist/cent/cent.cpp:130-145).
+  algo="dpsgd"        — E2 `decent`: ppermute params to both ring neighbors,
+                        mix (p+l+r)/3 between backward and step — exact
+                        D-PSGD ordering (decent.cpp:173-246).
+  algo="eventgrad"    — E3/E4 `event`: per-parameter event bits gate a
+                        masked exchange; receivers hold stale buffers
+                        (event.cpp:306-488).
+  algo="sp_eventgrad" — E5 `spevent`: fired parameters ship top-k
+                        (value, index) payloads scattered into persistent
+                        neighbor replicas (spevent.cpp:339-542).
+
+The returned `step(state, batch)` is pure per-rank SPMD code (collectives on
+named axes); lift it with `parallel.spmd` under either a real mesh or the
+single-chip vmap simulator, and wrap in `jax.jit` with donated state.
+
+Loss: softmax cross-entropy on the model output. For models that already
+emit log-probabilities this equals the reference's double-log_softmax
+(nll_loss∘log_softmax of a log_softmax output, event.cpp:291) because
+log_softmax is idempotent; for logit models (MLP/ResNet) it equals
+nll_loss∘log_softmax (cent.cpp:119) and cross_entropy
+(dcifar10/event/event.cpp:268) respectively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from eventgrad_tpu.data.augment import pad_flip_crop
+from eventgrad_tpu.ops.fused_update import fused_mix_sgd
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import EventConfig, decide_and_update
+from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
+from eventgrad_tpu.parallel.topology import Topology
+from eventgrad_tpu.utils import trees
+
+ALGOS = ("allreduce", "dpsgd", "eventgrad", "sp_eventgrad")
+
+
+def _xent(output: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax cross-entropy over the trailing class axis; `labels` has the
+    output's shape minus that axis (so this serves both [B,C] classification
+    and [B,T,V] next-token prediction)."""
+    logp = jax.nn.log_softmax(output, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def _param_bytes(params: Any) -> int:
+    return 4 * trees.tree_count_params(params)
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    topo: Topology,
+    algo: str = "dpsgd",
+    event_cfg: Optional[EventConfig] = None,
+    sparse_cfg: Optional[SparseConfig] = None,
+    augment: bool = False,
+    sync_bn: bool = False,
+    fused_sgd: Optional[Tuple[float, float]] = None,
+    trace: bool = False,
+) -> Callable:
+    """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
+
+    fused_sgd=(lr, momentum): replace the mix + optax tail of gossip
+    algorithms with the Pallas fused_mix_sgd kernel (ops/fused_update.py) —
+    one HBM read/write per parameter element. The values MUST match the
+    `tx` the state was initialized with (plain SGD, optional trace
+    momentum); interpret mode is selected automatically off-TPU.
+
+    trace=True (event algorithms only) adds per-parameter send-side trace
+    vectors to the metrics — current norm, threshold, fired bit, leaf-major
+    order — the reference's `file_write=1` send{r}.txt instrumentation
+    (event.cpp:337-339,385-391).
+    """
+    if algo not in ALGOS:
+        raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
+    event_cfg = event_cfg or EventConfig()
+    sparse_cfg = sparse_cfg or SparseConfig()
+    n_nb = topo.n_neighbors
+    fused_interpret = jax.default_backend() != "tpu"
+
+    def step(state, batch):
+        x, y = batch
+        rng, k_aug, k_drop = jax.random.split(state.rng, 3)
+        pass_num = state.pass_num + 1
+
+        if augment:
+            x = pad_flip_crop(k_aug, x)
+
+        has_bn = bool(jax.tree.leaves(state.batch_stats))
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+            # "losses" collects auxiliary objectives sown by the model (e.g.
+            # the MoE load-balancing loss, models/moe.py); empty otherwise.
+            out, updated = model.apply(
+                variables,
+                x,
+                train=True,
+                rngs={"dropout": k_drop},
+                mutable=["batch_stats", "losses"],
+            )
+            new_stats = updated["batch_stats"] if has_bn else state.batch_stats
+            loss = _xent(out, y)
+            for leaf in jax.tree.leaves(updated.get("losses", {})):
+                loss = loss + jnp.sum(leaf)
+            return loss, (out, new_stats)
+
+        (loss, (out, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+
+        # auxiliary (non-gossip) parallelism axes — e.g. sequence parallelism:
+        # ranks along them hold identical parameters and share one logical
+        # batch, so gradients (and BN stats) are plain data-parallel pmeans
+        # there; gossip applies only across topo.gossip_axes.
+        for aux in topo.aux_axes:
+            grads = lax.pmean(grads, aux)
+            if has_bn:
+                new_stats = lax.pmean(new_stats, aux)
+
+        # tensor/expert-parallel axes: each rank owns distinct shards of the
+        # parameters named with the `tp_` prefix (models/tp.py convention).
+        # JAX's psum transpose under both vmap and shard_map(check_vma=False)
+        # scales every cotangent by the axis size (transpose(psum) == psum of
+        # replicated cotangents), so: sharded leaves divide by N (their
+        # per-rank grad is already the right shard), replicated leaves pmean
+        # (sum of per-rank path contributions / N) — verified against an
+        # unsharded twin in tests/test_tensor_parallel.py.
+        for ax in topo.sharded_axes:
+            n_ax = topo.axis_size(ax)
+
+            def fix(path, g, _ax=ax, _n=n_ax):
+                sharded = any(
+                    getattr(p, "key", "").startswith("tp_") for p in path
+                )
+                return g / _n if sharded else lax.pmean(g, _ax)
+
+            grads = jax.tree_util.tree_map_with_path(fix, grads)
+
+        params = state.params
+        event_state = state.event
+        sparse_state = state.sparse
+        total_bytes = jnp.float32(_param_bytes(params))
+        fired_frac = jnp.float32(1.0)
+        sent_bytes = jnp.float32(n_nb) * total_bytes
+
+        bufs = ()
+        if algo == "allreduce":
+            # E1: average gradients across all ranks, params stay replicated.
+            grads = collectives.allreduce_mean(grads, topo)
+            sent_bytes = total_bytes  # one all-reduce share per chip per step
+
+        elif algo == "dpsgd":
+            bufs = collectives.neighbor_vals(params, topo)
+
+        elif algo == "eventgrad":
+            fire, event_state = decide_and_update(
+                params, event_state, pass_num, event_cfg, n_nb
+            )
+            bufs, _ = collectives.masked_neighbor_vals(
+                params, fire, event_state.bufs, topo
+            )
+            event_state = event_state.replace(bufs=bufs)
+            fired = [
+                (f.astype(jnp.float32), p.size)
+                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
+            ]
+            sent_bytes = jnp.float32(n_nb) * 4.0 * sum(f * n for f, n in fired)
+            fired_frac = sum(f for f, _ in fired) / len(fired)
+
+        elif algo == "sp_eventgrad":
+            fire, event_state = decide_and_update(
+                params, event_state, pass_num, event_cfg, n_nb
+            )
+            sparse_state = sparse_exchange(params, fire, sparse_state, topo, sparse_cfg)
+            bufs = sparse_state.replicas
+            fired = [
+                (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
+                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
+            ]
+            # values + int32 indices: 8 bytes per selected element per neighbor
+            sent_bytes = jnp.float32(n_nb) * 8.0 * sum(f * k for f, k in fired)
+            fired_frac = sum(f for f, _ in fired) / len(fired)
+
+        if fused_sgd is not None and algo != "allreduce":
+            # Pallas fused tail: mix + momentum-SGD in one HBM pass.
+            lr_f, mom_f = fused_sgd
+            buf_sum = trees.tree_zeros_like(params)
+            for buf in bufs:
+                buf_sum = jax.tree.map(jnp.add, buf_sum, buf)
+            if mom_f:
+                mom_trace = state.opt_state[0].trace
+            else:
+                mom_trace = trees.tree_zeros_like(params)
+            params, new_trace = fused_mix_sgd(
+                params, buf_sum, grads, mom_trace,
+                lr_f, mom_f, topo.mix_weight, interpret=fused_interpret,
+            )
+            if mom_f:
+                opt_state = (state.opt_state[0]._replace(trace=new_trace),) + tuple(
+                    state.opt_state[1:]
+                )
+            else:
+                opt_state = state.opt_state
+        else:
+            mixed = collectives.mix(params, bufs, topo) if bufs else params
+            # optimizer applies gradients (computed at pre-mix params) to the
+            # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
+            updates, opt_state = tx.update(grads, state.opt_state, mixed)
+            params = optax.apply_updates(mixed, updates)
+
+        if sync_bn and has_bn:
+            new_stats = collectives.allreduce_mean(new_stats, topo)
+
+        new_state = state.replace(
+            params=params,
+            opt_state=opt_state,
+            batch_stats=new_stats,
+            pass_num=pass_num,
+            rng=rng,
+            event=event_state,
+            sparse=sparse_state,
+        )
+        metrics = {
+            "loss": loss,
+            "correct": jnp.sum(jnp.argmax(out, axis=-1) == y).astype(jnp.int32),
+            "fired_frac": fired_frac,
+            "sent_bytes": sent_bytes,
+            "num_events": (
+                event_state.num_events if event_state is not None else jnp.int32(0)
+            ),
+        }
+        if trace and algo in ("eventgrad", "sp_eventgrad"):
+            # send{r}.txt columns: norm of the (pre-mix) param at the event
+            # check, the post-decay/post-fire threshold, and the fire bit
+            metrics["trace_norm"] = jnp.stack(
+                jax.tree.leaves(trees.tree_norm(state.params))
+            )
+            metrics["trace_thres"] = jnp.stack(jax.tree.leaves(event_state.thres))
+            metrics["trace_fired"] = jnp.stack(
+                [f.astype(jnp.float32) for f in jax.tree.leaves(fire)]
+            )
+        return new_state, metrics
+
+    return step

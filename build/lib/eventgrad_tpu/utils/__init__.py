@@ -1,0 +1,1 @@
+from eventgrad_tpu.utils import trees

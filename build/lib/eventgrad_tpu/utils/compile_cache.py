@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache for the launcher/bench entry points.
+
+First compilation of the flagship ResNet train step costs tens of seconds
+on TPU; the reference pays nothing comparable (its "compile" is cmake,
+once). Caching compiled executables on disk makes every run after the
+first start in milliseconds — including separate processes, so the bench
+harness and repeated CLI invocations don't re-pay XLA.
+
+Off by default for library use; entry points opt in via `enable()`.
+`EG_COMPILE_CACHE=off` disables, `EG_COMPILE_CACHE=<dir>` relocates
+(default: `<repo>/.jax_cache`, git-ignored).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def honor_cpu_pin() -> None:
+    """Honor an explicit JAX_PLATFORMS=cpu env pin over accelerator plugins
+    that registered themselves ahead of it (jax config may read
+    "plugin,cpu"). Must run before the first backend use; shared by the
+    CLI and bench entry points."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def enable(path: str | None = None) -> str | None:
+    """Turn on the persistent compilation cache; returns the dir (or None
+    when disabled via EG_COMPILE_CACHE=off/0)."""
+    path = path or os.environ.get("EG_COMPILE_CACHE") or os.path.join(
+        _REPO_ROOT, ".jax_cache"
+    )
+    if path.lower() in ("0", "off", "none"):
+        return None
+    # XLA:CPU AOT reload is brittle across host-feature detection (loader
+    # warns about possible SIGILL); the compile-time win is a TPU concern,
+    # so skip caching when the process resolves to the CPU backend. Prefer
+    # the config pin — resolving the backend initializes it, which callers
+    # may not be ready for (jax.distributed.initialize must come first).
+    plats = (jax.config.jax_platforms or "").split(",")
+    backend = plats[0] if plats and plats[0] else jax.default_backend()
+    if backend == "cpu":
+        return None
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every executable, not just the slowest ones
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return path

@@ -1,0 +1,42 @@
+"""Structured metrics — the reference's flat-file logs, upgraded to JSONL.
+
+The reference writes per-rank `send{r}.txt`/`recv{r}.txt`/`train{r}.txt`
+plus stdout accuracy (/root/reference/dmnist/event/event.cpp:232-252,
+337-339, 385-391; dcifar10/event/event.cpp:271-273). Here every record is a
+JSON line with the BASELINE metrics first-class: msgs-saved-%,
+grad-sync bytes/step/chip, test-acc vs epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class JsonlLogger:
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self._fh = open(path, "a") if path else None
+
+    def log(self, record: Dict[str, Any]) -> None:
+        record = {"ts": round(time.time(), 3), **record}
+        line = json.dumps(record, default=float)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self.echo:
+            print(line)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+
+
+def msgs_saved_pct(num_events: int, passes: int, n_tensors: int, n_neighbors: int, n_ranks: int) -> float:
+    """1 - events/possible, the reference's headline metric
+    (events counted per neighbor per tensor per pass, event.cpp:344,527-532)."""
+    possible = n_neighbors * passes * n_tensors * n_ranks
+    return 100.0 * (1.0 - num_events / possible) if possible else 0.0

@@ -1,0 +1,74 @@
+"""Profiling & tracing — the reference's MPI_Wtime timers, upgraded.
+
+The reference brackets the whole training loop with `MPI_Wtime`
+(/root/reference/dmnist/cent/cent.cpp:98,158) and prints one number. Here:
+
+  * `timed_steps` — a block_until_ready step-timing harness giving
+    compile time and steady-state per-step latency percentiles.
+  * `trace` — a context manager around `jax.profiler` emitting an XPlane
+    trace viewable in TensorBoard/Perfetto (no-op with a warning when the
+    backend can't trace, e.g. over the axon tunnel).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+import numpy as np
+
+
+def timed_steps(
+    step_fn: Callable,
+    state: Any,
+    batches: Sequence[Any],
+    warmup: int = 1,
+) -> Dict[str, float]:
+    """Run step_fn(state, batch) over batches; first call times compile.
+
+    Returns {"compile_s", "step_ms_mean", "step_ms_p50", "step_ms_p95"} and
+    leaves the final state in "state".
+    """
+    assert len(batches) > warmup, "need more batches than warmup steps"
+    t0 = time.perf_counter()
+    state, _ = step_fn(state, batches[0])
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for batch in batches[1:]:
+        t0 = time.perf_counter()
+        state, _ = step_fn(state, batch)
+        jax.block_until_ready(state)
+        times.append(time.perf_counter() - t0)
+    steady = times[max(0, warmup - 1):]
+    ms = 1000 * np.asarray(steady)
+    return {
+        "compile_s": compile_s,
+        "step_ms_mean": float(ms.mean()),
+        "step_ms_p50": float(np.percentile(ms, 50)),
+        "step_ms_p95": float(np.percentile(ms, 95)),
+        "state": state,
+    }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace scope; degrades to a no-op if tracing is
+    unsupported on the active backend."""
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - backend dependent
+        import sys
+
+        # stderr: stdout may carry a JSONL metrics stream (cli.py)
+        print(f"[profiling] trace unavailable: {e}", file=sys.stderr)
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()

@@ -1,0 +1,43 @@
+"""Small pytree helpers shared across the framework."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_norm(tree: Any) -> Any:
+    """Per-leaf L2 norm of the flattened leaf — the event metric
+    `torch::norm(flatten(param))` (/root/reference/dmnist/event/event.cpp:325),
+    returned as a pytree of scalars."""
+    return jax.tree.map(lambda x: jnp.linalg.norm(x.reshape(-1)), tree)
+
+
+def tree_scalar_zeros(tree: Any, dtype=jnp.float32) -> Any:
+    """A pytree of scalar zeros matching `tree`'s structure — the per-parameter
+    C arrays of the reference (event.cpp:181-225) as explicit state."""
+    return jax.tree.map(lambda _: jnp.zeros((), dtype), tree)
+
+
+def tree_zeros_like(tree: Any) -> Any:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_where(cond_tree: Any, a: Any, b: Any) -> Any:
+    """Per-leaf select; `cond_tree` holds scalars broadcast against leaves."""
+    return jax.tree.map(lambda c, x, y: jnp.where(c, x, y), cond_tree, a, b)
+
+
+def tree_count_params(tree: Any) -> int:
+    """Total element count (reference prints this at startup, event.cpp:158-165)."""
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_num_leaves(tree: Any) -> int:
+    return len(jax.tree.leaves(tree))
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)

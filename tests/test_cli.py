@@ -79,3 +79,24 @@ def test_reference_argv_semantics_thres_constant_zero(capsys):
     np.testing.assert_allclose(
         ep["loss"], [r for r in d if "epoch" in r][0]["loss"], atol=1e-6
     )
+
+
+def test_cli_synthetic_imagenet_stress_config(capsys):
+    """BASELINE's scale-stress config (ResNet-50-family EventGraD on a 2D
+    torus over ImageNet-shaped data) expressed through the launcher, at
+    smoke scale (--num-filters shrinks the stem; --image-size 224 and
+    --num-filters 64 recover the real op-point on hardware)."""
+    recs = _run(capsys, [
+        "--algo", "sp_eventgrad", "--mesh", "torus:2x2", "--model", "resnet50",
+        "--dataset", "synthetic-imagenet", "--image-size", "48",
+        "--num-classes", "16", "--num-filters", "8", "--epochs", "1",
+        "--batch-size", "4", "--n-synth", "64", "--lr", "0.01",
+        "--momentum", "0.9", "--warmup-passes", "2", "--topk-percent", "10",
+    ])
+    assert recs[-1]["final"] and "accuracy" in recs[-1]
+    assert np.isfinite(recs[0]["loss"])
+
+
+def test_cli_model_knob_guard():
+    with pytest.raises(SystemExit):  # width/classes knobs are resnet-only
+        main(["--model", "cnn2", "--num-classes", "100"])
