@@ -26,6 +26,7 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.flatten_util import ravel_pytree
 
 from eventgrad_tpu.parallel.topology import NeighborSpec, Topology
 
@@ -57,24 +58,6 @@ def _packable(tree: Any) -> bool:
     return len(leaves) > 1 and all(l.dtype == leaves[0].dtype for l in leaves)
 
 
-def _pack(tree: Any) -> Any:
-    return jnp.concatenate([l.ravel() for l in jax.tree.leaves(tree)])
-
-
-def _unpack(flat: Any, tree: Any) -> Any:
-    """Split a packed buffer back into `tree`'s structure/shapes (static
-    split points — leaf sizes are trace-time constants)."""
-    leaves, treedef = jax.tree.flatten(tree)
-    splits, acc = [], 0
-    for l in leaves[:-1]:
-        acc += l.size
-        splits.append(acc)
-    chunks = jnp.split(flat, splits)
-    return jax.tree.unflatten(
-        treedef, [c.reshape(l.shape) for c, l in zip(chunks, leaves)]
-    )
-
-
 def _recv_packed(tree: Any, topo: Topology, nb: NeighborSpec) -> Any:
     """recv_from through one contiguous buffer: a model is one ICI transfer
     per neighbor, not one per parameter tensor. The reference pays the
@@ -83,7 +66,8 @@ def _recv_packed(tree: Any, topo: Topology, nb: NeighborSpec) -> Any:
     per-message overhead and gives the ICI DMA one large contiguous op."""
     if not _packable(tree):
         return recv_from(tree, topo, nb)
-    return _unpack(recv_from(_pack(tree), topo, nb), tree)
+    flat, unravel = ravel_pytree(tree)
+    return unravel(recv_from(flat, topo, nb))
 
 
 def neighbor_vals(tree: Any, topo: Topology) -> Tuple[Any, ...]:
@@ -124,11 +108,12 @@ def masked_neighbor_vals(
         # one wire buffer (+ one fire-bit vector) per neighbor: the whole
         # model rides a single ICI transfer instead of one per tensor
         fire_leaves, fire_def = jax.tree.flatten(fire)
-        packed, fire_vec = _pack(masked), jnp.stack(fire_leaves)
+        packed, unravel = ravel_pytree(masked)
+        fire_vec = jnp.stack(fire_leaves)
 
         def receive(nb):
             got_flat, got_vec = recv_from((packed, fire_vec), topo, nb)
-            return _unpack(got_flat, masked), jax.tree.unflatten(
+            return unravel(got_flat), jax.tree.unflatten(
                 fire_def, [got_vec[i] for i in range(len(fire_leaves))]
             )
     else:

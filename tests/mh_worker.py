@@ -1,9 +1,11 @@
 """Worker process for tests/test_multihost.py (not a test module).
 
 Each of N processes owns 4 virtual CPU devices; together they form one
-global 8-device ring. Trains MLP/EventGraD through the CLI train() path on
-the global mesh, then compares the allgathered final parameters against an
-in-process single-device vmap simulation of the identical run.
+global 8-device mesh. Trains MLP/EventGraD on an 8-ring (gossip hops cross
+the process boundary) and a ring-attention transformer on an sp:2,dp:4
+hybrid (sp outer, so every sequence hop crosses the process boundary)
+through the train() path, then compares the allgathered final parameters
+against an in-process single-device vmap simulation of the identical runs.
 """
 
 import os
@@ -76,6 +78,43 @@ assert hist_res[0]["num_events"] == hist_sim[2]["num_events"]
 np.testing.assert_allclose(hist_res[0]["loss"], hist_sim[2]["loss"], atol=1e-5)
 params_res = multihost.to_host(state_res.params)
 for a, b in zip(jax.tree.leaves(params_res), jax.tree.leaves(params_sim)):
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+# hybrid leg: EventGraD gossip across dp while ring attention shards the
+# sequence across sp. sp is the OUTER mesh axis: build_mesh reshapes the 8
+# global devices row-major, so sp partners pair device i (process 0) with
+# device i+4 (process 1) — every ring-attention sequence hop crosses the
+# process boundary (cross-process dp gossip is covered by the ring leg
+# above). Must match the in-process vmap simulation exactly.
+from eventgrad_tpu.data.datasets import synthetic_lm_dataset  # noqa: E402
+from eventgrad_tpu.models.transformer import TransformerLM  # noqa: E402
+from eventgrad_tpu.parallel.topology import Topology  # noqa: E402
+
+topo_h = Topology(axes=("sp", "dp"), shape=(2, 4), gossip_axes=("dp",))
+xl, yl = synthetic_lm_dataset(64, 32, vocab=64, seed=13)
+
+
+def lm_model():
+    return TransformerLM(vocab=64, dim=32, n_heads=4, n_layers=1,
+                         max_len=32, attn="ring", topo=topo_h, sp_axis="sp")
+
+
+kwargs_h = dict(
+    algo="eventgrad", epochs=2, batch_size=4, learning_rate=0.1,
+    event_cfg=EventConfig(adaptive=True, horizon=0.9, warmup_passes=2),
+    seed=9, log_every_epoch=False,
+)
+state_hm, hist_hm = train(lm_model(), topo_h, xl, yl,
+                          mesh=build_mesh(topo_h), **kwargs_h)
+state_hs, hist_hs = train(lm_model(), topo_h, xl, yl, mesh=None, **kwargs_h)
+for hm, hs in zip(hist_hm, hist_hs):
+    assert hm["num_events"] == hs["num_events"], (hm, hs)
+    np.testing.assert_allclose(hm["loss"], hs["loss"], atol=1e-5)
+params_hm = multihost.to_host(state_hm.params)
+for a, b in zip(
+    jax.tree.leaves(params_hm),
+    jax.tree.leaves(jax.tree.map(np.asarray, state_hs.params)),
+):
     np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
 
 print(f"MH-WORKER-{pid}-OK", flush=True)
