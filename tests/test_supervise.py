@@ -23,7 +23,8 @@ def _cli_args(tmp, tag, extra):
     ] + extra
 
 
-def _run_supervised(tmp, tag, extra, timeout=0.0, max_restarts=3):
+def _run_supervised(tmp, tag, extra, timeout=0.0, max_restarts=3,
+                    cli_args=None):
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env["JAX_PLATFORMS"] = "cpu"
@@ -31,7 +32,7 @@ def _run_supervised(tmp, tag, extra, timeout=0.0, max_restarts=3):
     cmd = [
         sys.executable, "-m", "eventgrad_tpu.supervise",
         "--timeout", str(timeout), "--max-restarts", str(max_restarts), "--",
-    ] + _cli_args(tmp, tag, extra)
+    ] + (cli_args if cli_args is not None else _cli_args(tmp, tag, extra))
     return subprocess.run(
         cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=600
     )
@@ -109,3 +110,38 @@ def test_supervisor_requires_checkpoint_dir(tmp_path):
         from eventgrad_tpu.supervise import supervise
 
         supervise(["--algo", "dpsgd"])
+
+
+def test_crash_recovery_hybrid_lm(tmp_path):
+    """Elastic recovery composes with hybrid meshes: a dp x sp
+    ring-attention LM run crash-injected after epoch 1 is restarted from
+    its snapshot and replays the uninterrupted trajectory exactly."""
+    tmp = str(tmp_path)
+
+    def go(tag, extra):
+        lm_args = [
+            "--algo", "eventgrad", "--mesh", "dp:2,sp:2",
+            "--model", "transformer", "--attn", "ring",
+            "--seq-len", "32", "--vocab", "64", "--dim", "32",
+            "--heads", "4", "--layers", "1", "--epochs", "3",
+            "--batch-size", "4", "--n-synth", "64", "--lr", "0.1",
+            "--warmup-passes", "2",
+            "--log-file", os.path.join(tmp, f"{tag}.jsonl"),
+        ] + extra
+        return _run_supervised(tmp, tag, [], cli_args=lm_args)
+
+    straight = go("straight", ["--checkpoint-dir", os.path.join(tmp, "ck0"),
+                               "--save-every", "1"])
+    assert straight.returncode == 0, straight.stderr[-2000:]
+    crashed = go("crashed", ["--checkpoint-dir", os.path.join(tmp, "ck1"),
+                             "--save-every", "1", "--fault-inject", "crash:1"])
+    assert crashed.returncode == 0, crashed.stderr[-2000:]
+    # the injection must actually have fired and the supervisor restarted
+    assert "attempt 1 failed (exit code 13)" in crashed.stderr
+
+    s = [r for r in _records(tmp, "straight") if "epoch" in r]
+    c = [r for r in _records(tmp, "crashed") if "epoch" in r]
+    assert [r["epoch"] for r in c] == [1, 2, 3]
+    for rs, rc in zip(s, c):
+        assert rs["num_events"] == rc["num_events"]
+        np.testing.assert_allclose(rs["loss"], rc["loss"], atol=1e-6)
