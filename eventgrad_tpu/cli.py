@@ -174,6 +174,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--history", type=int, default=2)          # event.cpp:103
     p.add_argument("--topk-percent", type=float, default=10.0)
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
+    p.add_argument("--wire-bf16", action="store_true",
+                   help="ship gossip payloads as bfloat16 on the wire — half "
+                        "the ICI/DCN bytes of the reference's float32 MPI "
+                        "wire; local params and event state stay full "
+                        "precision (gossip algos only)")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
                         "(gossip algorithms; plain/momentum SGD only)")
@@ -320,6 +325,7 @@ def main(argv=None) -> int:
             sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
             checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
             resume=args.resume, trace_file=args.trace_file,
+            wire_bf16=args.wire_bf16,
             fused_update=args.fused, fault_inject=args.fault_inject,
             on_epoch=logger.log,  # records stream as epochs finish: live
             # metrics for the user, a liveness signal for supervise.py

@@ -58,19 +58,43 @@ def _packable(tree: Any) -> bool:
     return len(leaves) > 1 and all(l.dtype == leaves[0].dtype for l in leaves)
 
 
-def _recv_packed(tree: Any, topo: Topology, nb: NeighborSpec) -> Any:
+def _wire_out(x: Any, wire_dtype) -> Any:
+    """Downcast a wire payload (array or pytree of floats) for transfer."""
+    cast = lambda a: (
+        a.astype(wire_dtype)
+        if wire_dtype is not None and jnp.issubdtype(a.dtype, jnp.floating)
+        and a.dtype != wire_dtype
+        else a
+    )
+    return jax.tree.map(cast, x)
+
+
+def _wire_in(x: Any, like: Any) -> Any:
+    """Upcast received payload back to the local dtypes."""
+    return jax.tree.map(lambda a, ref: a.astype(ref.dtype), x, like)
+
+
+def _recv_packed(
+    tree: Any, topo: Topology, nb: NeighborSpec, wire_dtype=None
+) -> Any:
     """recv_from through one contiguous buffer: a model is one ICI transfer
     per neighbor, not one per parameter tensor. The reference pays the
     per-tensor cost (86 x 2 MPI_Puts per step on its ResNet,
     dcifar10/event/event.cpp:282,320-332); packing amortizes every
-    per-message overhead and gives the ICI DMA one large contiguous op."""
+    per-message overhead and gives the ICI DMA one large contiguous op.
+    `wire_dtype` (e.g. bfloat16) downcasts the buffer for the transfer and
+    upcasts on receipt — half the ICI/DCN bytes for float32 models."""
     if not _packable(tree):
-        return recv_from(tree, topo, nb)
+        got = recv_from(_wire_out(tree, wire_dtype), topo, nb)
+        return _wire_in(got, tree)
     flat, unravel = ravel_pytree(tree)
-    return unravel(recv_from(flat, topo, nb))
+    got = recv_from(_wire_out(flat, wire_dtype), topo, nb)
+    return unravel(got.astype(flat.dtype))
 
 
-def neighbor_vals(tree: Any, topo: Topology) -> Tuple[Any, ...]:
+def neighbor_vals(
+    tree: Any, topo: Topology, wire_dtype=None
+) -> Tuple[Any, ...]:
     """D-PSGD exchange: the full pytree from every gossip neighbor.
 
     Ring: returns (from_left, from_right) — the payloads of
@@ -78,7 +102,9 @@ def neighbor_vals(tree: Any, topo: Topology) -> Tuple[Any, ...]:
     risk because ppermute is a collective. Packed: one wire buffer per
     neighbor regardless of how many parameter tensors the model has.
     """
-    return tuple(_recv_packed(tree, topo, nb) for nb in topo.neighbors)
+    return tuple(
+        _recv_packed(tree, topo, nb, wire_dtype) for nb in topo.neighbors
+    )
 
 
 def masked_neighbor_vals(
@@ -86,6 +112,7 @@ def masked_neighbor_vals(
     fire: Any,
     last_bufs: Tuple[Any, ...],
     topo: Topology,
+    wire_dtype=None,
 ) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
     """Event-triggered exchange (EventGraD's RMA window, deterministic form).
 
@@ -109,17 +136,21 @@ def masked_neighbor_vals(
         # model rides a single ICI transfer instead of one per tensor
         fire_leaves, fire_def = jax.tree.flatten(fire)
         packed, unravel = ravel_pytree(masked)
+        wire = _wire_out(packed, wire_dtype)
         fire_vec = jnp.stack(fire_leaves)
 
         def receive(nb):
-            got_flat, got_vec = recv_from((packed, fire_vec), topo, nb)
-            return unravel(got_flat), jax.tree.unflatten(
+            got_flat, got_vec = recv_from((wire, fire_vec), topo, nb)
+            return unravel(got_flat.astype(packed.dtype)), jax.tree.unflatten(
                 fire_def, [got_vec[i] for i in range(len(fire_leaves))]
             )
     else:
 
         def receive(nb):
-            return recv_from((masked, fire), topo, nb)
+            got_p, got_f = recv_from(
+                (_wire_out(masked, wire_dtype), fire), topo, nb
+            )
+            return _wire_in(got_p, masked), got_f
 
     new_bufs, recv_fires = [], []
     for nb, last in zip(topo.neighbors, last_bufs):

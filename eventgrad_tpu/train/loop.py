@@ -170,6 +170,7 @@ def train(
     resume: bool = False,
     trace_file: Optional[str] = None,
     fused_update: bool = False,
+    wire_bf16: bool = False,
     fault_inject: Optional[str] = None,
     on_epoch: Optional[Any] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
@@ -276,6 +277,7 @@ def train(
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
         sync_bn=sync_bn, trace=trace_file is not None,
         fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
+        wire_bf16=wire_bf16,
     )
     lifted = spmd(step, topo, mesh=mesh)
 

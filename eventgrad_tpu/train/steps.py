@@ -55,10 +55,6 @@ def _xent(output: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(ll)
 
 
-def _param_bytes(params: Any) -> int:
-    return 4 * trees.tree_count_params(params)
-
-
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -70,6 +66,7 @@ def make_train_step(
     sync_bn: bool = False,
     fused_sgd: Optional[Tuple[float, float]] = None,
     trace: bool = False,
+    wire_bf16: bool = False,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -78,6 +75,12 @@ def make_train_step(
     one HBM read/write per parameter element. The values MUST match the
     `tx` the state was initialized with (plain SGD, optional trace
     momentum); interpret mode is selected automatically off-TPU.
+
+    wire_bf16=True downcasts gossip payloads to bfloat16 for the transfer
+    (half the ICI/DCN bytes of the reference's float32 MPI wire); local
+    parameters, event norms, and thresholds stay full precision — only the
+    received neighbor values round. Gossip algorithms only (allreduce
+    gradients keep full precision).
 
     trace=True (event algorithms only) adds per-parameter send-side trace
     vectors to the metrics — current norm, threshold, fired bit, leaf-major
@@ -90,6 +93,7 @@ def make_train_step(
     sparse_cfg = sparse_cfg or SparseConfig()
     n_nb = topo.n_neighbors
     fused_interpret = jax.default_backend() != "tpu"
+    wire_dtype = jnp.bfloat16 if wire_bf16 else None
 
     def step(state, batch):
         x, y = batch
@@ -155,46 +159,60 @@ def make_train_step(
         params = state.params
         event_state = state.event
         sparse_state = state.sparse
-        total_bytes = jnp.float32(_param_bytes(params))
+        # wire accounting: bytes per payload element on the exchange
+        val_bytes = 2.0 if wire_bf16 else 4.0
+        total_bytes = jnp.float32(
+            val_bytes * trees.tree_count_params(params)
+        )
         fired_frac = jnp.float32(1.0)
         sent_bytes = jnp.float32(n_nb) * total_bytes
 
         bufs = ()
         if algo == "allreduce":
-            # E1: average gradients across all ranks, params stay replicated.
+            # E1: average gradients across all ranks, params stay replicated;
+            # gradients keep full precision (4 bytes/elem) regardless of the
+            # gossip wire dtype
             grads = collectives.allreduce_mean(grads, topo)
-            sent_bytes = total_bytes  # one all-reduce share per chip per step
+            sent_bytes = jnp.float32(4.0 * trees.tree_count_params(params))
 
         elif algo == "dpsgd":
-            bufs = collectives.neighbor_vals(params, topo)
+            bufs = collectives.neighbor_vals(params, topo, wire_dtype)
 
         elif algo == "eventgrad":
             fire, event_state = decide_and_update(
                 params, event_state, pass_num, event_cfg, n_nb
             )
             bufs, _ = collectives.masked_neighbor_vals(
-                params, fire, event_state.bufs, topo
+                params, fire, event_state.bufs, topo, wire_dtype
             )
             event_state = event_state.replace(bufs=bufs)
             fired = [
                 (f.astype(jnp.float32), p.size)
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
-            sent_bytes = jnp.float32(n_nb) * 4.0 * sum(f * n for f, n in fired)
+            sent_bytes = (
+                jnp.float32(n_nb) * val_bytes * sum(f * n for f, n in fired)
+            )
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
         elif algo == "sp_eventgrad":
             fire, event_state = decide_and_update(
                 params, event_state, pass_num, event_cfg, n_nb
             )
-            sparse_state = sparse_exchange(params, fire, sparse_state, topo, sparse_cfg)
+            sparse_state = sparse_exchange(
+                params, fire, sparse_state, topo, sparse_cfg, wire_dtype
+            )
             bufs = sparse_state.replicas
             fired = [
                 (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
-            # values + int32 indices: 8 bytes per selected element per neighbor
-            sent_bytes = jnp.float32(n_nb) * 8.0 * sum(f * k for f, k in fired)
+            # values + int32 indices per selected element per neighbor
+            sent_bytes = (
+                jnp.float32(n_nb)
+                * (val_bytes + 4.0)
+                * sum(f * k for f, k in fired)
+            )
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
         if fused_sgd is not None and algo != "allreduce":

@@ -141,6 +141,7 @@ def test_crash_recovery_hybrid_lm(tmp_path):
 
     s = [r for r in _records(tmp, "straight") if "epoch" in r]
     c = [r for r in _records(tmp, "crashed") if "epoch" in r]
+    assert [r["epoch"] for r in s] == [1, 2, 3]
     assert [r["epoch"] for r in c] == [1, 2, 3]
     for rs, rc in zip(s, c):
         assert rs["num_events"] == rc["num_events"]

@@ -1,0 +1,65 @@
+"""bfloat16 wire format: gossip payloads downcast for the transfer (half
+the ICI/DCN bytes of the reference's float32 MPI wire), upcast on receipt;
+local parameters, event norms, and thresholds stay full precision."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+
+
+def _go(algo, wire_bf16, **kw):
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=6)
+    return train(
+        MLP(), Ring(4), x, y,
+        algo=algo, epochs=2, batch_size=8, learning_rate=0.05,
+        event_cfg=EventConfig(adaptive=True, horizon=0.9, warmup_passes=2),
+        seed=1, log_every_epoch=False, wire_bf16=wire_bf16, **kw,
+    )
+
+
+def test_bytes_halve_and_training_stays_close():
+    state32, hist32 = _go("eventgrad", False)
+    state16, hist16 = _go("eventgrad", True)
+    # accounting: same fired pattern costs half the bytes on the wire
+    assert hist16[0]["num_events"] == hist32[0]["num_events"]
+    np.testing.assert_allclose(
+        hist16[0]["sent_bytes_per_step_per_chip"],
+        hist32[0]["sent_bytes_per_step_per_chip"] / 2,
+    )
+    # training dynamics stay in the same regime (bf16 has ~3 decimal digits)
+    assert abs(hist16[-1]["loss"] - hist32[-1]["loss"]) < 0.1
+    for a, b in zip(
+        jax.tree.leaves(state16.params), jax.tree.leaves(state32.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2)
+
+
+def test_threshold0_equivalence_holds_on_bf16_wire():
+    """eventgrad with threshold 0 must remain bitwise D-PSGD when both ride
+    the bf16 wire (identical rounding on both paths)."""
+    cfg0 = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=6)
+    kw = dict(epochs=2, batch_size=8, learning_rate=0.05, seed=1,
+              log_every_epoch=False, wire_bf16=True)
+    s_ev, _ = train(MLP(), Ring(4), x, y, algo="eventgrad",
+                    event_cfg=cfg0, **kw)
+    s_dp, _ = train(MLP(), Ring(4), x, y, algo="dpsgd", **kw)
+    for a, b in zip(jax.tree.leaves(s_ev.params), jax.tree.leaves(s_dp.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sparse_wire_bf16_runs_and_counts_6_bytes():
+    _, h32 = _go("sp_eventgrad", False)
+    _, h16 = _go("sp_eventgrad", True)
+    assert h16[0]["num_events"] == h32[0]["num_events"]
+    np.testing.assert_allclose(
+        h16[0]["sent_bytes_per_step_per_chip"] / h32[0]["sent_bytes_per_step_per_chip"],
+        6.0 / 8.0,  # bf16 value + int32 index vs f32 value + int32 index
+    )
+    assert np.isfinite(h16[-1]["loss"])
