@@ -174,6 +174,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--history", type=int, default=2)          # event.cpp:103
     p.add_argument("--topk-percent", type=float, default=10.0)
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
+    p.add_argument("--staleness", type=int, default=0, choices=[0, 1],
+                   help="1 = mix with the previous step's received buffers "
+                        "(deterministic model of the reference's one-sided "
+                        "RMA asynchrony; lets XLA overlap the exchange with "
+                        "compute; event algorithms only)")
     p.add_argument("--wire-bf16", action="store_true",
                    help="ship gossip payloads as bfloat16 on the wire — half "
                         "the ICI/DCN bytes of the reference's float32 MPI "
@@ -243,6 +248,19 @@ def main(argv=None) -> int:
         )
     if is_lm and args.augment:
         raise SystemExit("--augment is an image transform; not for LM")
+    if args.wire_bf16 and args.algo == "allreduce":
+        raise SystemExit(
+            "--wire-bf16 applies to gossip exchanges; allreduce gradients "
+            "keep full precision"
+        )
+    if args.staleness:
+        if args.algo not in ("eventgrad", "sp_eventgrad"):
+            raise SystemExit("--staleness applies to the event algorithms only")
+        if args.trace_file:
+            raise SystemExit(
+                "--trace-file records the synchronous exchange; not "
+                "available with --staleness"
+            )
     if not is_lm and not args.model.startswith("resnet") and (
         args.num_classes != 10 or args.num_filters != 64
     ):
@@ -325,7 +343,7 @@ def main(argv=None) -> int:
             sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
             checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
             resume=args.resume, trace_file=args.trace_file,
-            wire_bf16=args.wire_bf16,
+            wire_bf16=args.wire_bf16, staleness=args.staleness,
             fused_update=args.fused, fault_inject=args.fault_inject,
             on_epoch=logger.log,  # records stream as epochs finish: live
             # metrics for the user, a liveness signal for supervise.py

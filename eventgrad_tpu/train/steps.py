@@ -67,6 +67,7 @@ def make_train_step(
     fused_sgd: Optional[Tuple[float, float]] = None,
     trace: bool = False,
     wire_bf16: bool = False,
+    staleness: int = 0,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -82,6 +83,15 @@ def make_train_step(
     received neighbor values round. Gossip algorithms only (allreduce
     gradients keep full precision).
 
+    staleness=1 (event algorithms only) mixes with the PREVIOUS step's
+    received buffers and lets this step's exchange land for the next one —
+    the deterministic model of the reference's real RMA asynchrony (a rank
+    may read its window before the neighbor's Put arrives,
+    event.cpp:348-360 vs :399-438; pass 1 then averages the zero-initial
+    window exactly as event.cpp:177-179,469-471 allows). On TPU this also
+    frees XLA to overlap the ppermute with the next step's compute, since
+    nothing in the current step consumes its result.
+
     trace=True (event algorithms only) adds per-parameter send-side trace
     vectors to the metrics — current norm, threshold, fired bit, leaf-major
     order — the reference's `file_write=1` send{r}.txt instrumentation
@@ -89,6 +99,18 @@ def make_train_step(
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
+    if staleness not in (0, 1):
+        raise ValueError(f"staleness must be 0 or 1, got {staleness}")
+    if staleness and algo not in ("eventgrad", "sp_eventgrad"):
+        raise ValueError(
+            "staleness models the one-sided RMA asynchrony of the event "
+            "algorithms; allreduce/dpsgd are synchronous in the reference"
+        )
+    if staleness and trace:
+        raise ValueError(
+            "trace records model the synchronous exchange; not available "
+            "with staleness > 0"
+        )
     event_cfg = event_cfg or EventConfig()
     sparse_cfg = sparse_cfg or SparseConfig()
     n_nb = topo.n_neighbors
@@ -182,10 +204,13 @@ def make_train_step(
             fire, event_state = decide_and_update(
                 params, event_state, pass_num, event_cfg, n_nb
             )
-            bufs, _ = collectives.masked_neighbor_vals(
+            new_bufs, _ = collectives.masked_neighbor_vals(
                 params, fire, event_state.bufs, topo, wire_dtype
             )
-            event_state = event_state.replace(bufs=bufs)
+            # staleness=1: mix with what had arrived as of the PREVIOUS
+            # step; this step's exchange lands for the next one
+            bufs = event_state.bufs if staleness else new_bufs
+            event_state = event_state.replace(bufs=new_bufs)
             fired = [
                 (f.astype(jnp.float32), p.size)
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
@@ -199,10 +224,11 @@ def make_train_step(
             fire, event_state = decide_and_update(
                 params, event_state, pass_num, event_cfg, n_nb
             )
+            stale_replicas = sparse_state.replicas
             sparse_state = sparse_exchange(
                 params, fire, sparse_state, topo, sparse_cfg, wire_dtype
             )
-            bufs = sparse_state.replicas
+            bufs = stale_replicas if staleness else sparse_state.replicas
             fired = [
                 (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))

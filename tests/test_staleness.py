@@ -1,0 +1,107 @@
+"""Delayed (staleness-1) gossip: the deterministic model of the reference's
+one-sided RMA asynchrony — a rank may read its window before the neighbor's
+Put arrives (event.cpp:348-360 vs :399-438), so mixing uses the previous
+step's received values; pass 1 averages the zero-initialized window
+(event.cpp:177-179,469-471)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import _xent, make_train_step
+
+N, LR = 4, 0.05
+
+
+def _setup(staleness):
+    topo = Ring(N)
+    model = MLP()
+    tx = optax.sgd(LR)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=10)  # all fire
+    state = init_train_state(model, (28, 28, 1), tx, topo, "eventgrad", cfg)
+    step = make_train_step(model, tx, topo, "eventgrad", event_cfg=cfg,
+                           staleness=staleness)
+    lifted = jax.jit(spmd(step, topo))
+    x, y = synthetic_dataset(N * 8, (28, 28, 1), seed=9)
+    xb = jnp.asarray(x.reshape(N, 8, 28, 28, 1))
+    yb = jnp.asarray(y.reshape(N, 8))
+    return topo, model, state, lifted, xb, yb
+
+
+def _manual_grads(model, params_r, xb_r, yb_r):
+    def loss_fn(p):
+        out = model.apply({"params": p}, xb_r, train=True,
+                          rngs={"dropout": jax.random.PRNGKey(0)})
+        if isinstance(out, tuple):
+            out = out[0]
+        return _xent(out, yb_r)
+
+    return jax.grad(loss_fn)(params_r)
+
+
+def test_step1_mixes_zero_window():
+    """With staleness=1 the first step averages the zero-init buffers
+    (p/3 on a ring) before SGD — the exact event.cpp:177-179,469-471 case."""
+    topo, model, state, lifted, xb, yb = _setup(staleness=1)
+    p0 = jax.tree.map(lambda a: np.asarray(a[0]), state.params)  # replicated
+    new_state, _ = lifted(state, (xb, yb))
+
+    for r in range(N):
+        g = _manual_grads(model, jax.tree.map(jnp.asarray, p0),
+                          xb[r], yb[r])
+        expect = jax.tree.map(
+            lambda p, gg: p / 3.0 - LR * np.asarray(gg), p0, g
+        )
+        got = jax.tree.map(lambda a, _r=r: np.asarray(a[_r]), new_state.params)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(expect)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_step2_uses_step1_buffers():
+    """Step 2 must mix with the values exchanged AT step 1 (one-step-stale),
+    not with step 2's own exchange."""
+    topo, model, state, lifted, xb, yb = _setup(staleness=1)
+    s1, _ = lifted(state, (xb, yb))
+    bufs1 = jax.tree.map(np.asarray, s1.event.bufs)  # landed during step 1
+    s2, _ = lifted(s1, (xb, yb))
+
+    for r in range(N):
+        p1_r = jax.tree.map(lambda a, _r=r: np.asarray(a[_r]), s1.params)
+        g = _manual_grads(model, jax.tree.map(jnp.asarray, p1_r), xb[r], yb[r])
+        expect = jax.tree.map(
+            lambda p, bl, br, gg: (p + bl[r] + br[r]) / 3.0 - LR * np.asarray(gg),
+            p1_r, bufs1[0], bufs1[1], g,
+        )
+        got = jax.tree.map(lambda a, _r=r: np.asarray(a[_r]), s2.params)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(expect)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_delayed_eventgrad_converges():
+    x, y = synthetic_dataset(256, (28, 28, 1), seed=3)
+    _, hist = train(
+        MLP(), Ring(4), x, y, algo="eventgrad", epochs=4, batch_size=8,
+        learning_rate=0.05,
+        event_cfg=EventConfig(adaptive=True, horizon=0.9, warmup_passes=3),
+        seed=0, log_every_epoch=False, staleness=1,
+    )
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    assert hist[-1]["msgs_saved_pct"] > 0
+
+
+def test_staleness_guards():
+    topo = Ring(4)
+    with pytest.raises(ValueError, match="event"):
+        make_train_step(MLP(), optax.sgd(0.1), topo, "dpsgd", staleness=1)
+    with pytest.raises(ValueError, match="trace"):
+        make_train_step(MLP(), optax.sgd(0.1), topo, "eventgrad",
+                        staleness=1, trace=True)

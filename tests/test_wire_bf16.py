@@ -63,3 +63,12 @@ def test_sparse_wire_bf16_runs_and_counts_6_bytes():
         6.0 / 8.0,  # bf16 value + int32 index vs f32 value + int32 index
     )
     assert np.isfinite(h16[-1]["loss"])
+
+
+def test_cli_wire_bf16_rejects_allreduce():
+    import pytest as _pytest
+
+    from eventgrad_tpu.cli import main
+
+    with _pytest.raises(SystemExit):
+        main(["--algo", "allreduce", "--wire-bf16"])
