@@ -93,15 +93,16 @@ def parse_mesh(spec: str):
         if kind == "torus":
             nx, ny = dims.lower().split("x")
             return Torus(int(nx), int(ny))
-        if "," in spec or kind in ("dp", "sp") + _SHARDED_AXES:
+        if "," in spec or kind in ("dp", "ddp", "sp") + _SHARDED_AXES:
             # hybrid grammar: comma-separated axis:N pairs, e.g.
             # "dp:4,sp:2" or "dp:2,tp:2" — dp gossips, tp/pp/ep shard
-            # parameters, anything else (sp) is a replicated aux axis
+            # parameters, ddp forms allreduce subgroups that shard data,
+            # sp is a replicated aux axis sharing its group's batch
             axes, shape = [], []
             for part in spec.split(","):
                 name, _, n = part.partition(":")
                 name = name.strip()
-                if name not in ("dp", "sp") + _SHARDED_AXES:
+                if name not in ("dp", "ddp", "sp") + _SHARDED_AXES:
                     raise ValueError(f"unknown axis {name!r}")
                 axes.append(name)
                 shape.append(int(n))
@@ -112,12 +113,13 @@ def parse_mesh(spec: str):
                 shape=tuple(shape),
                 gossip_axes=tuple(a for a in axes if a == "dp"),
                 sharded_axes=tuple(a for a in axes if a in _SHARDED_AXES),
+                data_aux_axes=tuple(a for a in axes if a == "ddp"),
             )
     except (ValueError, TypeError) as e:
         raise argparse.ArgumentTypeError(f"bad mesh spec {spec!r}: {e}")
     raise argparse.ArgumentTypeError(
         f"bad mesh spec {spec!r} (ring:N, torus:XxY, or axis:N[,axis:N...] "
-        f"with axes dp/sp/tp/pp/ep)"
+        f"with axes dp/ddp/sp/tp/pp/ep)"
     )
 
 
@@ -300,9 +302,10 @@ def main(argv=None) -> int:
         x, y = load_or_synthesize(dataset, data_dir, "train", args.n_synth, args.seed)
         xt, yt = load_or_synthesize(dataset, data_dir, "test", n_test, args.seed)
 
-    # data parallelism degree = the gossip axes' extent (hybrid meshes
-    # replicate batches across sp/tp/pp/ep ranks rather than splitting)
-    n_data = topo.n_gossip_ranks
+    # data parallelism degree = the data axes' extent: gossip ranks plus
+    # any ddp allreduce subgroups split the batch; sp/tp/pp/ep ranks
+    # replicate or chunk it instead
+    n_data = topo.n_data_ranks
     hybrid = topo.is_hybrid
     batch = args.batch_size
     if args.global_batch:

@@ -85,19 +85,21 @@ def batched_epoch(
 def expand_to_mesh(
     xb: np.ndarray, yb: np.ndarray, topo, sp_axis: str = "sp"
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Lift gossip-sharded batches onto a hybrid mesh's full rank set.
+    """Lift data-sharded batches onto a hybrid mesh's full rank set.
 
-    `xb`/`yb` arrive in the stacked layout over the GOSSIP ranks only
-    ([n_gossip, steps, batch, ...] — each gossip rank owns a disjoint data
-    shard, the reference's sampler semantics). The full mesh may carry more
-    axes: a sequence-parallel axis (each rank holds its chunk of the token
-    dimension — ring attention's layout) and sharded/replicated aux axes
-    (tp/pp/ep — every rank in the group sees the same batch; the *model* is
-    what differs). Returns [topo.n_ranks, steps, batch, ...(chunked)] in the
-    topology's row-major rank order, matching `parallel.spmd.spmd`.
+    `xb`/`yb` arrive in the stacked layout over the DATA ranks only
+    ([n_data, steps, batch, ...] — each data rank owns a disjoint shard,
+    the reference's sampler semantics; data axes = the gossip axes plus a
+    "ddp" hierarchical-allreduce axis if present). The full mesh may carry
+    more axes: a sequence-parallel axis (each rank holds its chunk of the
+    token dimension — ring attention's layout) and sharded/replicated aux
+    axes (tp/pp/ep — every rank in the group sees the same batch; the
+    *model* is what differs). Returns [topo.n_ranks, steps, batch,
+    ...(chunked)] in the topology's row-major rank order, matching
+    `parallel.spmd.spmd`.
     """
     shape = topo.shape
-    gossip_idx = [topo.axes.index(a) for a in topo.gossip_axes]
+    data_idx = [topo.axes.index(a) for a in topo.data_axes]
     sp_pos = topo.axes.index(sp_axis) if sp_axis in topo.axes else None
     n_sp = shape[sp_pos] if sp_pos is not None else 1
     if sp_pos is not None:
@@ -112,7 +114,7 @@ def expand_to_mesh(
     for r in range(topo.n_ranks):
         multi = np.unravel_index(r, shape)
         g = 0
-        for ax in gossip_idx:
+        for ax in data_idx:
             g = g * shape[ax] + multi[ax]
         xr, yr = xb[g], yb[g]
         if sp_pos is not None:

@@ -58,6 +58,11 @@ class Topology:
     shape: Tuple[int, ...]
     gossip_axes: Tuple[str, ...] = None  # type: ignore[assignment]
     sharded_axes: Tuple[str, ...] = ()
+    #: aux axes that SHARD the data (hierarchical data parallelism):
+    #: ranks along them hold identical parameters and pmean gradients like
+    #: any aux axis, but each sees its own data shard — synchronous
+    #: allreduce subgroups inside every gossip rank
+    data_aux_axes: Tuple[str, ...] = ()
 
     def __post_init__(self):
         if len(self.axes) != len(self.shape):
@@ -76,6 +81,17 @@ class Topology:
             raise ValueError(f"sharded_axes {self.sharded_axes} not all in {self.axes}")
         if set(self.gossip_axes) & set(self.sharded_axes):
             raise ValueError("an axis cannot be both gossip and sharded")
+        if any(a not in self.axes for a in self.data_aux_axes):
+            raise ValueError(
+                f"data_aux_axes {self.data_aux_axes} not all in {self.axes}"
+            )
+        if set(self.data_aux_axes) & (
+            set(self.gossip_axes) | set(self.sharded_axes)
+        ):
+            raise ValueError(
+                "data_aux_axes must be replicated aux axes (not gossip or "
+                "sharded)"
+            )
 
     @property
     def n_ranks(self) -> int:
@@ -83,14 +99,32 @@ class Topology:
 
     @property
     def n_gossip_ranks(self) -> int:
-        """Extent of the gossip axes = the data-parallel degree (batches
-        shard across these; other axes replicate or chunk them)."""
+        """Extent of the gossip axes."""
         return math.prod(self.axis_size(a) for a in self.gossip_axes)
 
     @property
     def is_hybrid(self) -> bool:
-        """True when the mesh carries non-gossip axes (sp/tp/pp/ep)."""
-        return self.n_gossip_ranks != self.n_ranks
+        """True when the mesh carries axes that do NOT shard the data
+        (sp/tp/pp/ep): batches then need `expand_to_mesh` replication or
+        chunking, and consensus averaging across all ranks would mix
+        differently-sharded parameters."""
+        return self.n_data_ranks != self.n_ranks
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Axes that shard the DATA: the gossip axes plus any declared
+        `data_aux_axes` (hierarchical data parallelism). Other aux/sharded
+        axes (sp/tp/pp/ep) replicate or chunk batches instead."""
+        return tuple(
+            a
+            for a in self.axes
+            if a in self.gossip_axes or a in self.data_aux_axes
+        )
+
+    @property
+    def n_data_ranks(self) -> int:
+        """The data-parallel degree: batches shard across `data_axes`."""
+        return math.prod(self.axis_size(a) for a in self.data_axes)
 
     @property
     def aux_axes(self) -> Tuple[str, ...]:

@@ -203,7 +203,7 @@ def train(
     # hybrid meshes: data shards across the gossip axes only; sp ranks hold
     # sequence chunks, sharded/replicated aux ranks (tp/pp/ep) see the same
     # batch (the model, not the data, differs across them)
-    n_gossip = topo.n_gossip_ranks
+    n_data = topo.n_data_ranks
     hybrid = topo.is_hybrid
     input_shape = tuple(x_train.shape[1:])
     input_dtype = (
@@ -297,7 +297,7 @@ def train(
     history: List[Dict[str, Any]] = []
 
     prefetcher = EpochPrefetcher(
-        x_train, y_train, n_gossip, batch_size,
+        x_train, y_train, n_data, batch_size,
         random=random_sampler, seed=seed, last_epoch=epochs,
     )
     try:

@@ -110,3 +110,25 @@ def test_hybrid_lm_resume_matches_uninterrupted(tmp_path):
         np.asarray(state_full.event.num_events),
         np.asarray(state_res.event.num_events),
     )
+
+
+def test_delayed_gossip_resume_matches_uninterrupted(tmp_path):
+    """staleness=1 carries its pending exchange in EventState.bufs, which is
+    part of the snapshot — an interrupted delayed-gossip run resumes onto
+    the exact uninterrupted trajectory."""
+    x, y = synthetic_dataset(256, (28, 28, 1), seed=4)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=3)
+    kw = dict(
+        algo="eventgrad", batch_size=16, learning_rate=0.05, event_cfg=cfg,
+        random_sampler=True, seed=7, staleness=1, save_every=2,
+    )
+    state_full, _ = train(MLP(), Ring(4), x, y, epochs=4, resume=False, **kw)
+    ck = str(tmp_path / "ck")
+    train(MLP(), Ring(4), x, y, epochs=2, resume=False, checkpoint_dir=ck, **kw)
+    state_res, hist = train(MLP(), Ring(4), x, y, epochs=4, resume=True,
+                            checkpoint_dir=ck, **kw)
+    assert [h["epoch"] for h in hist] == [3, 4]
+    for a, b in zip(
+        jax.tree.leaves(state_full.params), jax.tree.leaves(state_res.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -70,5 +70,5 @@ def test_cli_wire_bf16_rejects_allreduce():
 
     from eventgrad_tpu.cli import main
 
-    with _pytest.raises(SystemExit):
+    with _pytest.raises(SystemExit, match="wire-bf16"):
         main(["--algo", "allreduce", "--wire-bf16"])
