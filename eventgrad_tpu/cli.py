@@ -250,6 +250,11 @@ def main(argv=None) -> int:
         )
     if is_lm and args.augment:
         raise SystemExit("--augment is an image transform; not for LM")
+    if args.algo != "allreduce" and not topo.gossip_axes:
+        raise SystemExit(
+            f"--algo {args.algo} needs a gossip axis (dp) in --mesh; "
+            f"{tuple(topo.axes)} has none (did you mean dp instead of ddp?)"
+        )
     if args.wire_bf16 and args.algo == "allreduce":
         raise SystemExit(
             "--wire-bf16 applies to gossip exchanges; allreduce gradients "

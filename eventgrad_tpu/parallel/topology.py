@@ -73,7 +73,12 @@ class Topology:
             object.__setattr__(
                 self,
                 "gossip_axes",
-                tuple(a for a in self.axes if a not in self.sharded_axes),
+                tuple(
+                    a
+                    for a in self.axes
+                    if a not in self.sharded_axes
+                    and a not in self.data_aux_axes
+                ),
             )
         elif any(a not in self.axes for a in self.gossip_axes):
             raise ValueError(f"gossip_axes {self.gossip_axes} not all in {self.axes}")

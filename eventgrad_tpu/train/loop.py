@@ -200,9 +200,10 @@ def train(
         fault_epoch = int(n)
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
 
-    # hybrid meshes: data shards across the gossip axes only; sp ranks hold
-    # sequence chunks, sharded/replicated aux ranks (tp/pp/ep) see the same
-    # batch (the model, not the data, differs across them)
+    # data shards across the data axes (gossip + any declared ddp
+    # allreduce subgroups); sp ranks hold sequence chunks, sharded/
+    # replicated aux ranks (tp/pp/ep) see the same batch (the model, not
+    # the data, differs across them)
     n_data = topo.n_data_ranks
     hybrid = topo.is_hybrid
     input_shape = tuple(x_train.shape[1:])

@@ -51,7 +51,6 @@ def test_ddp_group_equals_bigger_batch_ring():
 
 
 def test_eventgrad_ddp_converges_with_consensus_eval(capsys):
-    recs = None
     args = ["--algo", "eventgrad", "--mesh", "dp:2,ddp:2",
             "--dataset", "synthetic", "--model", "mlp", "--epochs", "2",
             "--batch-size", "8", "--n-synth", "128", "--warmup-passes", "2"]
@@ -59,3 +58,8 @@ def test_eventgrad_ddp_converges_with_consensus_eval(capsys):
     recs = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert recs[-1]["final"] and "accuracy" in recs[-1]  # consensus eval ran
     assert recs[-2]["msgs_saved_pct"] >= 0
+
+
+def test_gossipless_mesh_rejected_for_gossip_algos():
+    with pytest.raises(SystemExit, match="gossip axis"):
+        main(["--algo", "eventgrad", "--mesh", "ddp:8"])
