@@ -61,8 +61,10 @@ def main() -> None:
     if _TINY:
         global_batch, n_train, n_test, epochs = 256, 1024, 256, 2
     elif _CPU_TIER:
-        # ~256 passes past a 30-pass warmup at ~5s/pass on one core
-        global_batch, n_train, n_test, epochs = 64, 2048, 512, 8
+        # ~768 passes at ~2.3s/pass on one core (~30 min): enough for the
+        # adaptive threshold to mature well past the 30-pass warmup, with
+        # deadline margin for probe + compile + the MNIST leg
+        global_batch, n_train, n_test, epochs = 64, 2048, 512, 24
     else:
         global_batch, n_train, n_test, epochs = 256, 16384, 2048, 61
         # 61 x 64 steps = 3904 passes ~= ref op-point
@@ -113,7 +115,7 @@ def main() -> None:
     if _TINY:
         mnist_n, mnist_epochs, mnist_batch = 1024, 2, 16
     elif _CPU_TIER:
-        mnist_n, mnist_epochs, mnist_batch = 4096, 25, 64  # ~200 passes
+        mnist_n, mnist_epochs, mnist_batch = 4096, 75, 64  # ~600 passes
     else:
         mnist_n, mnist_epochs, mnist_batch = 8192, 73, 64
     xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)

@@ -181,11 +181,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(deterministic model of the reference's one-sided "
                         "RMA asynchrony; lets XLA overlap the exchange with "
                         "compute; event algorithms only)")
+    p.add_argument("--wire", choices=["bf16", "int8"], default=None,
+                   help="compress gossip payloads on the wire: bf16 = half "
+                        "the reference's f32 MPI wire bytes, int8 = a "
+                        "quarter (per-leaf absmax quantization); local "
+                        "params and event state stay full precision "
+                        "(gossip algos only)")
     p.add_argument("--wire-bf16", action="store_true",
-                   help="ship gossip payloads as bfloat16 on the wire — half "
-                        "the ICI/DCN bytes of the reference's float32 MPI "
-                        "wire; local params and event state stay full "
-                        "precision (gossip algos only)")
+                   help="shorthand for --wire bf16")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
                         "(gossip algorithms; plain/momentum SGD only)")
@@ -255,9 +258,15 @@ def main(argv=None) -> int:
             f"--algo {args.algo} needs a gossip axis (dp) in --mesh; "
             f"{tuple(topo.axes)} has none (did you mean dp instead of ddp?)"
         )
-    if args.wire_bf16 and args.algo == "allreduce":
+    if args.wire_bf16:
+        if args.wire and args.wire != "bf16":
+            raise SystemExit(
+                f"--wire-bf16 conflicts with --wire {args.wire}"
+            )
+        args.wire = "bf16"
+    if args.wire and args.algo == "allreduce":
         raise SystemExit(
-            "--wire-bf16 applies to gossip exchanges; allreduce gradients "
+            "--wire applies to gossip exchanges; allreduce gradients "
             "keep full precision"
         )
     if args.staleness:
@@ -351,7 +360,7 @@ def main(argv=None) -> int:
             sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
             checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
             resume=args.resume, trace_file=args.trace_file,
-            wire_bf16=args.wire_bf16, staleness=args.staleness,
+            wire=args.wire, staleness=args.staleness,
             fused_update=args.fused, fault_inject=args.fault_inject,
             on_epoch=logger.log,  # records stream as epochs finish: live
             # metrics for the user, a liveness signal for supervise.py

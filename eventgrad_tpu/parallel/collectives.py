@@ -58,12 +58,20 @@ def _packable(tree: Any) -> bool:
     return len(leaves) > 1 and all(l.dtype == leaves[0].dtype for l in leaves)
 
 
-def _wire_out(x: Any, wire_dtype) -> Any:
-    """Downcast a wire payload (array or pytree of floats) for transfer."""
+#: wire modes: None = native dtype; "bf16" = bfloat16 transfer (2 B/elem);
+#: "int8" = per-leaf absmax-scaled int8 transfer (1 B/elem + one f32
+#: scale per leaf). Local state always stays full precision.
+WIRE_MODES = (None, "bf16", "int8")
+
+
+def _wire_out(x: Any, wire) -> Any:
+    """Downcast a wire payload (array or pytree of floats) for transfer
+    (bf16 mode; int8 has its own quantize/dequantize pair below)."""
+    dt = jnp.bfloat16 if wire == "bf16" else None
     cast = lambda a: (
-        a.astype(wire_dtype)
-        if wire_dtype is not None and jnp.issubdtype(a.dtype, jnp.floating)
-        and a.dtype != wire_dtype
+        a.astype(dt)
+        if dt is not None and jnp.issubdtype(a.dtype, jnp.floating)
+        and a.dtype != dt
         else a
     )
     return jax.tree.map(cast, x)
@@ -74,27 +82,70 @@ def _wire_in(x: Any, like: Any) -> Any:
     return jax.tree.map(lambda a, ref: a.astype(ref.dtype), x, like)
 
 
-def _recv_packed(
-    tree: Any, topo: Topology, nb: NeighborSpec, wire_dtype=None
-) -> Any:
+def _int8_scales(tree: Any) -> Any:
+    """Per-leaf absmax/127 quantization scales (zero-safe)."""
+    return jax.tree.map(
+        lambda a: jnp.maximum(jnp.max(jnp.abs(a)), 1e-30) / 127.0, tree
+    )
+
+
+def _int8_quant(tree: Any, scales: Any) -> Any:
+    return jax.tree.map(
+        lambda a, s: jnp.clip(jnp.round(a / s), -127, 127).astype(jnp.int8),
+        tree, scales,
+    )
+
+
+def _int8_dequant(q: Any, scales: Any, like: Any) -> Any:
+    return jax.tree.map(
+        lambda v, s, ref: (v.astype(ref.dtype) * s.astype(ref.dtype)),
+        q, scales, like,
+    )
+
+
+def _int8_encode(tree: Any):
+    """Quantize a float pytree for the wire: (int8 tree, stacked per-leaf
+    scale vector, the scales' treedef for decode). One codec shared by the
+    dense, masked, and sparse exchange paths."""
+    scales = _int8_scales(tree)
+    q = _int8_quant(tree, scales)
+    return q, jnp.stack(jax.tree.leaves(scales)), jax.tree.structure(scales)
+
+
+def _int8_decode(got_q: Any, got_s: Any, scale_def, like: Any) -> Any:
+    got_scales = jax.tree.unflatten(
+        scale_def, [got_s[i] for i in range(got_s.shape[0])]
+    )
+    return _int8_dequant(got_q, got_scales, like)
+
+
+def _recv_packed(tree: Any, topo: Topology, nb: NeighborSpec, wire=None) -> Any:
     """recv_from through one contiguous buffer: a model is one ICI transfer
     per neighbor, not one per parameter tensor. The reference pays the
     per-tensor cost (86 x 2 MPI_Puts per step on its ResNet,
     dcifar10/event/event.cpp:282,320-332); packing amortizes every
     per-message overhead and gives the ICI DMA one large contiguous op.
-    `wire_dtype` (e.g. bfloat16) downcasts the buffer for the transfer and
-    upcasts on receipt — half the ICI/DCN bytes for float32 models."""
+    `wire` ("bf16"/"int8") compresses the buffer for the transfer and
+    restores full precision on receipt — 2x/4x fewer ICI/DCN bytes for
+    float32 models."""
+    if wire == "int8":
+        q, scale_vec, scale_def = _int8_encode(tree)
+        if _packable(q):
+            flatq, unravel_q = ravel_pytree(q)
+            got_q, got_s = recv_from((flatq, scale_vec), topo, nb)
+            got_tree = unravel_q(got_q)
+        else:
+            got_tree, got_s = recv_from((q, scale_vec), topo, nb)
+        return _int8_decode(got_tree, got_s, scale_def, tree)
     if not _packable(tree):
-        got = recv_from(_wire_out(tree, wire_dtype), topo, nb)
+        got = recv_from(_wire_out(tree, wire), topo, nb)
         return _wire_in(got, tree)
     flat, unravel = ravel_pytree(tree)
-    got = recv_from(_wire_out(flat, wire_dtype), topo, nb)
+    got = recv_from(_wire_out(flat, wire), topo, nb)
     return unravel(got.astype(flat.dtype))
 
 
-def neighbor_vals(
-    tree: Any, topo: Topology, wire_dtype=None
-) -> Tuple[Any, ...]:
+def neighbor_vals(tree: Any, topo: Topology, wire=None) -> Tuple[Any, ...]:
     """D-PSGD exchange: the full pytree from every gossip neighbor.
 
     Ring: returns (from_left, from_right) — the payloads of
@@ -103,7 +154,7 @@ def neighbor_vals(
     neighbor regardless of how many parameter tensors the model has.
     """
     return tuple(
-        _recv_packed(tree, topo, nb, wire_dtype) for nb in topo.neighbors
+        _recv_packed(tree, topo, nb, wire) for nb in topo.neighbors
     )
 
 
@@ -112,7 +163,7 @@ def masked_neighbor_vals(
     fire: Any,
     last_bufs: Tuple[Any, ...],
     topo: Topology,
-    wire_dtype=None,
+    wire=None,
 ) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
     """Event-triggered exchange (EventGraD's RMA window, deterministic form).
 
@@ -131,24 +182,47 @@ def masked_neighbor_vals(
     masked = jax.tree.map(
         lambda p, f: jnp.where(f, p, jnp.zeros_like(p)), payload, fire
     )
-    if _packable(masked):
-        # one wire buffer (+ one fire-bit vector) per neighbor: the whole
-        # model rides a single ICI transfer instead of one per tensor
-        fire_leaves, fire_def = jax.tree.flatten(fire)
-        packed, unravel = ravel_pytree(masked)
-        wire = _wire_out(packed, wire_dtype)
-        fire_vec = jnp.stack(fire_leaves)
+    fire_leaves, fire_def = jax.tree.flatten(fire)
+    fire_vec = jnp.stack(fire_leaves)
+
+    def _unflat_fire(got_vec):
+        return jax.tree.unflatten(
+            fire_def, [got_vec[i] for i in range(len(fire_leaves))]
+        )
+
+    if wire == "int8":
+        # quantized wire: int8 payload + one f32 scale per leaf (non-fired
+        # leaves are all-zero, so their scale bottoms out and decodes to 0)
+        q, scale_vec, scale_def = _int8_encode(masked)
+        flatq, unravel_q = ravel_pytree(q) if _packable(q) else (None, None)
 
         def receive(nb):
-            got_flat, got_vec = recv_from((wire, fire_vec), topo, nb)
-            return unravel(got_flat.astype(packed.dtype)), jax.tree.unflatten(
-                fire_def, [got_vec[i] for i in range(len(fire_leaves))]
+            if flatq is not None:
+                got_q, got_s, got_vec = recv_from(
+                    (flatq, scale_vec, fire_vec), topo, nb
+                )
+                got_tree = unravel_q(got_q)
+            else:
+                got_tree, got_s, got_vec = recv_from(
+                    (q, scale_vec, fire_vec), topo, nb
+                )
+            return _int8_decode(got_tree, got_s, scale_def, masked), (
+                _unflat_fire(got_vec)
             )
+    elif _packable(masked):
+        # one wire buffer (+ one fire-bit vector) per neighbor: the whole
+        # model rides a single ICI transfer instead of one per tensor
+        packed, unravel = ravel_pytree(masked)
+        wire_buf = _wire_out(packed, wire)
+
+        def receive(nb):
+            got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
+            return unravel(got_flat.astype(packed.dtype)), _unflat_fire(got_vec)
     else:
 
         def receive(nb):
             got_p, got_f = recv_from(
-                (_wire_out(masked, wire_dtype), fire), topo, nb
+                (_wire_out(masked, wire), fire), topo, nb
             )
             return _wire_in(got_p, masked), got_f
 

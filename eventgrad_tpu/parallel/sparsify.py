@@ -103,25 +103,32 @@ def sparse_exchange(
     sp: SparseState,
     topo: Topology,
     cfg: SparseConfig,
-    wire_dtype=None,
+    wire=None,
 ) -> SparseState:
     """One step of sparsified gossip: build top-k payloads, ship them to every
     neighbor (masked — receivers apply only when the sender fired), update the
     sender shadow and the neighbor replicas. Returns the new SparseState; the
     caller then mixes `params` with `sp.replicas` (spevent.cpp:539-542).
-    `wire_dtype` (e.g. bfloat16) downcasts the top-k *values* for the
-    transfer; indices stay int32. The sender shadow keeps full precision."""
+    `wire` ("bf16"/"int8") compresses the top-k *values* for the transfer;
+    indices stay int32. The sender shadow keeps full precision."""
     vals, idxs = topk_payload(params, sp.prev_sent, cfg)
 
     new_prev = scatter_into(sp.prev_sent, vals, idxs, fire)
 
-    wire_vals = collectives._wire_out(vals, wire_dtype)
+    if wire == "int8":
+        q, scale_vec, scale_def = collectives._int8_encode(vals)
+        wire_vals = (q, scale_vec)
+    else:
+        wire_vals = (collectives._wire_out(vals, wire), None)
     new_replicas = []
     for nb, replica in zip(topo.neighbors, sp.replicas):
-        got_vals, got_idxs, got_fire = collectives.recv_from(
-            (wire_vals, idxs, fire), topo, nb
+        got_vals, got_s, got_idxs, got_fire = collectives.recv_from(
+            wire_vals + (idxs, fire), topo, nb
         )
-        got_vals = collectives._wire_in(got_vals, vals)
+        if wire == "int8":
+            got_vals = collectives._int8_decode(got_vals, got_s, scale_def, vals)
+        else:
+            got_vals = collectives._wire_in(got_vals, vals)
         new_replicas.append(scatter_into(replica, got_vals, got_idxs, got_fire))
 
     return sp.replace(prev_sent=new_prev, replicas=tuple(new_replicas))

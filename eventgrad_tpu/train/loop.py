@@ -171,6 +171,7 @@ def train(
     trace_file: Optional[str] = None,
     fused_update: bool = False,
     wire_bf16: bool = False,
+    wire: "Optional[str]" = None,
     staleness: int = 0,
     fault_inject: Optional[str] = None,
     on_epoch: Optional[Any] = None,
@@ -279,7 +280,7 @@ def train(
         event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
         sync_bn=sync_bn, trace=trace_file is not None,
         fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
-        wire_bf16=wire_bf16, staleness=staleness,
+        wire_bf16=wire_bf16, wire=wire, staleness=staleness,
     )
     lifted = spmd(step, topo, mesh=mesh)
 

@@ -67,6 +67,7 @@ def make_train_step(
     fused_sgd: Optional[Tuple[float, float]] = None,
     trace: bool = False,
     wire_bf16: bool = False,
+    wire: "Optional[str]" = None,
     staleness: int = 0,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
@@ -77,11 +78,13 @@ def make_train_step(
     `tx` the state was initialized with (plain SGD, optional trace
     momentum); interpret mode is selected automatically off-TPU.
 
-    wire_bf16=True downcasts gossip payloads to bfloat16 for the transfer
-    (half the ICI/DCN bytes of the reference's float32 MPI wire); local
-    parameters, event norms, and thresholds stay full precision — only the
-    received neighbor values round. Gossip algorithms only (allreduce
-    gradients keep full precision).
+    wire ("bf16" | "int8"; wire_bf16=True is shorthand for "bf16")
+    compresses gossip payloads for the transfer — bf16 halves the
+    reference's float32 MPI wire bytes, int8 quarters them via per-leaf
+    absmax-scaled quantization (one f32 scale per parameter tensor rides
+    along). Local parameters, event norms, and thresholds stay full
+    precision — only the received neighbor values round. Gossip
+    algorithms only (allreduce gradients keep full precision).
 
     staleness=1 (event algorithms only) mixes with the PREVIOUS step's
     received buffers and lets this step's exchange land for the next one —
@@ -115,7 +118,10 @@ def make_train_step(
     sparse_cfg = sparse_cfg or SparseConfig()
     n_nb = topo.n_neighbors
     fused_interpret = jax.default_backend() != "tpu"
-    wire_dtype = jnp.bfloat16 if wire_bf16 else None
+    if wire_bf16:
+        wire = wire or "bf16"
+    if wire not in collectives.WIRE_MODES:
+        raise ValueError(f"wire must be one of {collectives.WIRE_MODES}")
 
     def step(state, batch):
         x, y = batch
@@ -182,7 +188,7 @@ def make_train_step(
         event_state = state.event
         sparse_state = state.sparse
         # wire accounting: bytes per payload element on the exchange
-        val_bytes = 2.0 if wire_bf16 else 4.0
+        val_bytes = {None: 4.0, "bf16": 2.0, "int8": 1.0}[wire]
         total_bytes = jnp.float32(
             val_bytes * trees.tree_count_params(params)
         )
@@ -198,14 +204,14 @@ def make_train_step(
             sent_bytes = jnp.float32(4.0 * trees.tree_count_params(params))
 
         elif algo == "dpsgd":
-            bufs = collectives.neighbor_vals(params, topo, wire_dtype)
+            bufs = collectives.neighbor_vals(params, topo, wire)
 
         elif algo == "eventgrad":
             fire, event_state = decide_and_update(
                 params, event_state, pass_num, event_cfg, n_nb
             )
             new_bufs, _ = collectives.masked_neighbor_vals(
-                params, fire, event_state.bufs, topo, wire_dtype
+                params, fire, event_state.bufs, topo, wire
             )
             # staleness=1: mix with what had arrived as of the PREVIOUS
             # step; this step's exchange lands for the next one
@@ -226,7 +232,7 @@ def make_train_step(
             )
             stale_replicas = sparse_state.replicas
             sparse_state = sparse_exchange(
-                params, fire, sparse_state, topo, sparse_cfg, wire_dtype
+                params, fire, sparse_state, topo, sparse_cfg, wire
             )
             bufs = stale_replicas if staleness else sparse_state.replicas
             fired = [
