@@ -135,3 +135,25 @@ def test_cli_wire_flag_conflict_rejected():
 
     with _pytest.raises(SystemExit, match="conflicts"):
         main(["--wire-bf16", "--wire", "int8"])
+
+
+def test_int8_codec_roundtrip_error_bound():
+    """Quantize/dequantize error is bounded by half a grain (scale/2 =
+    absmax/254) per element, across magnitudes and signs, and zero maps
+    to exactly zero (the masked path's non-fired leaves)."""
+    from eventgrad_tpu.parallel.collectives import _int8_decode, _int8_encode
+
+    rng = np.random.default_rng(11)
+    for mag in (1e-6, 1.0, 1e4):
+        tree = {
+            "a": jnp.asarray(mag * rng.standard_normal((17, 5)), jnp.float32),
+            "b": jnp.asarray(-mag * rng.random(33), jnp.float32),
+            "z": jnp.zeros(9, jnp.float32),
+        }
+        q, scale_vec, scale_def = _int8_encode(tree)
+        back = _int8_decode(q, scale_vec, scale_def, tree)
+        for k in ("a", "b"):
+            grain = float(np.abs(np.asarray(tree[k])).max()) / 127.0
+            err = np.abs(np.asarray(back[k]) - np.asarray(tree[k])).max()
+            assert err <= grain / 2 + 1e-12, (k, mag, err, grain)
+        np.testing.assert_array_equal(np.asarray(back["z"]), 0.0)
