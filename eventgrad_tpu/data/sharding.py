@@ -104,6 +104,13 @@ def expand_to_mesh(
     n_sp = shape[sp_pos] if sp_pos is not None else 1
     if sp_pos is not None:
         t_global = xb.shape[-1]
+        if n_sp > 1 and not np.issubdtype(xb.dtype, np.integer):
+            raise ValueError(
+                f"{sp_axis} axis chunks the TRAILING batch dimension (size "
+                f"{t_global}) as a token sequence, but batches are "
+                f"{xb.dtype} — image channels must not be sliced; "
+                f"sequence parallelism requires integer token data"
+            )
         if t_global % n_sp:
             raise ValueError(
                 f"sequence length {t_global} not divisible by {sp_axis} size {n_sp}"

@@ -215,6 +215,15 @@ def train(
     )
     if "sp" in topo.axes and topo.axis_size("sp") > 1:
         n_sp = topo.axis_size("sp")
+        if input_dtype != jnp.int32:
+            raise ValueError(
+                "sequence parallelism (sp axis) chunks the TRAILING input "
+                f"dimension (here size {input_shape[-1]} of shape "
+                f"{input_shape}) as a token sequence, but the inputs are "
+                f"{np.asarray(x_train).dtype} — for image data that "
+                "dimension is channels and must not be sliced; use an "
+                "integer token dataset with sp"
+            )
         if input_shape[-1] % n_sp:
             raise ValueError(
                 f"sequence length {input_shape[-1]} not divisible by sp={n_sp}"

@@ -187,20 +187,32 @@ def make_train_step(
         params = state.params
         event_state = state.event
         sparse_state = state.sparse
-        # wire accounting: bytes per payload element on the exchange
+        # wire accounting: bytes per payload element on the exchange; int8
+        # additionally ships one f32 scale per parameter leaf
+        # (collectives._int8_encode). The accounting models the reference's
+        # MPI wire: a non-fired parameter sends nothing — no payload, no
+        # scale — so the event algorithms count scales per FIRED leaf only;
+        # the always-shipped fire-bit/scale vectors of the SPMD ppermute
+        # are artifacts with no reference-wire counterpart.
         val_bytes = {None: 4.0, "bf16": 2.0, "int8": 1.0}[wire]
+        scale_bytes_per_leaf = 4.0 if wire == "int8" else 0.0
         total_bytes = jnp.float32(
             val_bytes * trees.tree_count_params(params)
+            + scale_bytes_per_leaf * trees.tree_num_leaves(params)
         )
         fired_frac = jnp.float32(1.0)
         sent_bytes = jnp.float32(n_nb) * total_bytes
 
         bufs = ()
         if algo == "allreduce":
-            # E1: average gradients across all ranks, params stay replicated;
-            # gradients keep full precision (4 bytes/elem) regardless of the
-            # gossip wire dtype
-            grads = collectives.allreduce_mean(grads, topo)
+            # E1: average gradients over the data-parallel (gossip) axes
+            # only — aux axes were pmean'd above and sharded (tp/ep) leaves
+            # got their per-axis fix; a blanket all-axes pmean would
+            # elementwise-average gradients of distinct parameter shards.
+            # Gradients keep full precision (4 bytes/elem) regardless of
+            # the gossip wire dtype.
+            for ax in topo.gossip_axes:
+                grads = lax.pmean(grads, ax)
             sent_bytes = jnp.float32(4.0 * trees.tree_count_params(params))
 
         elif algo == "dpsgd":
@@ -221,8 +233,9 @@ def make_train_step(
                 (f.astype(jnp.float32), p.size)
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
-            sent_bytes = (
-                jnp.float32(n_nb) * val_bytes * sum(f * n for f, n in fired)
+            sent_bytes = jnp.float32(n_nb) * (
+                val_bytes * sum(f * n for f, n in fired)
+                + scale_bytes_per_leaf * sum(f for f, _ in fired)
             )
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
@@ -240,10 +253,9 @@ def make_train_step(
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
             # values + int32 indices per selected element per neighbor
-            sent_bytes = (
-                jnp.float32(n_nb)
-                * (val_bytes + 4.0)
-                * sum(f * k for f, k in fired)
+            sent_bytes = jnp.float32(n_nb) * (
+                (val_bytes + 4.0) * sum(f * k for f, k in fired)
+                + scale_bytes_per_leaf * sum(f for f, _ in fired)
             )
             fired_frac = sum(f for f, _ in fired) / len(fired)
 

@@ -47,3 +47,30 @@ def test_cnn2_with_dropout_trains():
         CNN2(), topo, x, y, algo="dpsgd", epochs=2, batch_size=8, learning_rate=0.05
     )
     assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_sp_axis_rejects_image_data():
+    """Regression for the advisor's round-1 finding: an sp axis chunks the
+    TRAILING input dimension as a token sequence; for image data that
+    dimension is channels, which must never be silently sliced."""
+    import pytest
+
+    from eventgrad_tpu.parallel.topology import Topology
+
+    topo = Topology(axes=("dp", "sp"), shape=(2, 2), gossip_axes=("dp",))
+    x, y = synthetic_dataset(128, (8, 8, 2), seed=3)  # float images, C=2=sp
+    with pytest.raises(ValueError, match="channels"):
+        train(MLP(hidden=16), topo, x, y, algo="dpsgd", epochs=1, batch_size=8)
+
+
+def test_expand_to_mesh_rejects_float_batches_on_sp():
+    import pytest
+
+    from eventgrad_tpu.data.sharding import expand_to_mesh
+    from eventgrad_tpu.parallel.topology import Topology
+
+    topo = Topology(axes=("dp", "sp"), shape=(2, 2), gossip_axes=("dp",))
+    xb = np.zeros((2, 3, 4, 8, 8, 2), np.float32)  # [n_data, steps, B, H, W, C]
+    yb = np.zeros((2, 3, 4), np.int64)
+    with pytest.raises(ValueError, match="channels"):
+        expand_to_mesh(xb, yb, topo)

@@ -143,6 +143,76 @@ def test_tp_forward_and_step_match_unsharded():
             )
 
 
+def test_allreduce_times_tp_matches_unsharded_ddp():
+    """algo=allreduce on a dp x tp mesh: gradients average over dp ONLY.
+
+    Regression for the advisor's round-1 finding: a blanket all-axes pmean
+    would elementwise-average the tp-sharded kernels' gradients (distinct
+    parameter shards), silently corrupting training. The twin is the
+    unsharded model taking one DDP step on the mean of the two dp batches;
+    every tp shard of every dp rank must equal the twin's slice."""
+    topo = Topology(
+        axes=("dp", "tp"), shape=(2, TP), gossip_axes=("dp",), sharded_axes=("tp",)
+    )
+    full_model, tp_model = _models()
+    tx = optax.sgd(0.1)
+    state = init_train_state_spmd(
+        tp_model, (T,), tx, topo, "allreduce", input_dtype=jnp.int32
+    )
+
+    def merge(path, *leaves):
+        name = "/".join(str(p.key) for p in path)
+        if "ColParallelDense_0" in name and name.endswith("tp_kernel"):
+            thirds = [jnp.split(l, 3, axis=1) for l in leaves]
+            return jnp.concatenate(
+                [jnp.concatenate([t[i] for t in thirds], axis=1) for i in range(3)],
+                axis=1,
+            )
+        if "ColParallelDense" in name and name.endswith("tp_kernel"):
+            return jnp.concatenate(leaves, axis=1)
+        if "RowParallelDense" in name and name.endswith("tp_kernel"):
+            return jnp.concatenate(leaves, axis=0)
+        return leaves[0]
+
+    shards = [jax.tree.map(lambda p: p[r], state.params) for r in range(TP)]
+    full_params = jax.tree_util.tree_map_with_path(merge, *shards)
+
+    key = jax.random.PRNGKey(11)
+    toks = jax.random.randint(key, (2, 2, T), 0, VOCAB)  # one batch per dp rank
+    tgts = jnp.roll(toks, -1, axis=-1)
+    # mesh layout [dp, tp] row-major: replicate each dp batch over tp
+    xb = jnp.repeat(toks, TP, axis=0).reshape(4, 2, T)
+    yb = jnp.repeat(tgts, TP, axis=0).reshape(4, 2, T)
+
+    step = make_train_step(tp_model, tx, topo, "allreduce")
+    new_state, _ = jax.jit(spmd(step, topo))(state, (xb, yb))
+
+    def full_loss(p, t, g):
+        out = full_model.apply({"params": p}, t)
+        logp = jax.nn.log_softmax(out)
+        return -jnp.mean(jnp.take_along_axis(logp, g[..., None], -1))
+
+    g0 = jax.grad(full_loss)(full_params, toks[0], tgts[0])
+    g1 = jax.grad(full_loss)(full_params, toks[1], tgts[1])
+    g = jax.tree.map(lambda a, b: (a + b) / 2.0, g0, g1)
+    full_new = jax.tree.map(lambda p, gg: p - 0.1 * gg, full_params, g)
+
+    for dp_r in range(2):
+        for tp_r in range(TP):
+            expect = _slice_params(full_new, tp_r)
+            got = jax.tree.map(
+                lambda p: p[dp_r * TP + tp_r], new_state.params
+            )
+            for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(expect),
+                jax.tree_util.tree_leaves_with_path(got),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=3e-5,
+                    err_msg=f"dp {dp_r} tp {tp_r}: {jax.tree_util.keystr(pa)}",
+                )
+
+
 def test_dp_gossip_times_tp():
     """EventGraD across dp while blocks are TP-sharded: 4x2 mesh."""
     from eventgrad_tpu.parallel.events import EventConfig

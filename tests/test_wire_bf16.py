@@ -79,12 +79,15 @@ def test_cli_wire_bf16_rejects_allreduce():
 
 
 def test_int8_wire_bytes_quarter_and_training_stays_close():
-    # dpsgd always sends dense, so the byte accounting ratio is exact
+    # dpsgd always sends dense, so the byte accounting is exact: quarter
+    # the values plus one f32 scale per leaf per neighbor (the advisor's
+    # round-1 finding — scales ride the wire and must be counted)
     _, d32 = _go("dpsgd", False)
     _, d8 = _go("dpsgd", False, wire="int8")
+    n_leaves, n_nb = 4, 2  # MLP tensors; ring neighbors
     np.testing.assert_allclose(
         d8[0]["sent_bytes_per_step_per_chip"],
-        d32[0]["sent_bytes_per_step_per_chip"] / 4,
+        d32[0]["sent_bytes_per_step_per_chip"] / 4 + n_nb * 4 * n_leaves,
     )
     # eventgrad dynamics stay in the same regime despite 8-bit rounding
     state32, hist32 = _go("eventgrad", False)
@@ -121,9 +124,13 @@ def test_sparse_int8_wire_runs_and_counts_5_bytes():
     _, h32 = _go("sp_eventgrad", False, **kw)
     _, h8 = _go("sp_eventgrad", False, wire="int8", **kw)
     assert h8[0]["num_events"] == h32[0]["num_events"]
+    n_leaves, n_nb = 4, 2  # MLP tensors; ring neighbors
     np.testing.assert_allclose(
-        h8[0]["sent_bytes_per_step_per_chip"] / h32[0]["sent_bytes_per_step_per_chip"],
-        5.0 / 8.0,  # int8 value + int32 index vs f32 value + int32 index
+        h8[0]["sent_bytes_per_step_per_chip"],
+        # int8 value + int32 index vs f32 value + int32 index, plus one
+        # f32 quantization scale per leaf per neighbor
+        h32[0]["sent_bytes_per_step_per_chip"] * 5.0 / 8.0
+        + n_nb * 4 * n_leaves,
     )
     assert np.isfinite(h8[-1]["loss"])
 
