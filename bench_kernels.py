@@ -1,23 +1,42 @@
-"""Kernel microbenchmarks (not part of the driver contract — run by hand).
+"""Kernel microbenchmarks + on-device numerics checks (run by hand; the
+driver contract is bench.py).
 
-Times the Pallas kernels against their XLA/jnp twins on the active device:
+Times the Pallas kernels against their XLA/jnp twins on the active device
+and asserts numerical agreement there — on TPU this is the Mosaic-compiled
+path (off-TPU the kernels auto-select interpret mode, see ops/attention.py):
 
   * flash attention fwd and fwd+bwd vs materialized-score attention, over
     a sweep of sequence lengths;
   * the fused gossip-mix + momentum-SGD update vs the unfused tree-map
     chain, at the flagship ResNet parameter count.
 
-Prints one JSON line per measurement: {"kernel", "config", "pallas_ms",
-"xla_ms", "speedup"}.
+Prints one JSON line per measurement (flushed immediately — a flaky device
+tunnel can wedge mid-run and the completed measurements must survive):
+{"kernel", "config", "pallas_ms", "xla_ms", "speedup", "max_err"}.
+
+Usage: python bench_kernels.py [attn|fused|all] [--seqs 512,1024,...]
+       [--out FILE]   (appends each line to FILE as well as stdout)
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+_OUT_PATH = None
+
+
+def _emit(rec: dict) -> None:
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if _OUT_PATH:
+        with open(_OUT_PATH, "a") as f:
+            f.write(line + "\n")
 
 
 def _time(fn, *args, iters=20):
@@ -30,11 +49,19 @@ def _time(fn, *args, iters=20):
     return 1000 * (time.perf_counter() - t0) / iters
 
 
-def bench_attention():
+def _max_err(a, b) -> float:
+    fa = np.asarray(jax.tree.leaves(a)[0] if not hasattr(a, "dtype") else a,
+                    np.float32)
+    fb = np.asarray(jax.tree.leaves(b)[0] if not hasattr(b, "dtype") else b,
+                    np.float32)
+    return float(np.max(np.abs(fa - fb)))
+
+
+def bench_attention(seqs=(512, 1024, 2048, 4096)):
     from eventgrad_tpu.ops import flash_attention, flash_attention_reference
 
     b, h, d = 4, 8, 64
-    for t in (512, 1024, 2048, 4096):
+    for t in seqs:
         key = jax.random.PRNGKey(0)
         q, k, v = (
             jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d), jnp.bfloat16)
@@ -42,21 +69,30 @@ def bench_attention():
         )
         flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
         ref = jax.jit(lambda q, k, v: flash_attention_reference(q, k, v, True))
+        # numerics first (bf16 inputs, f32 accumulation: ~1e-2 agreement)
+        err = _max_err(flash(q, k, v).astype(jnp.float32),
+                       ref(q, k, v).astype(jnp.float32))
+        assert err < 5e-2, f"flash fwd T={t} diverges from XLA twin: {err}"
         ms_f, ms_r = _time(flash, q, k, v), _time(ref, q, k, v)
-        print(json.dumps({
+        _emit({
             "kernel": "flash_attention_fwd", "config": f"B{b}xT{t}xH{h}xD{d}",
             "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
-            "speedup": round(ms_r / ms_f, 2),
-        }))
+            "speedup": round(ms_r / ms_f, 2), "max_err": err,
+        })
 
-        lossf = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32) ** 2)))
-        lossr = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention_reference(q, k, v, True).astype(jnp.float32) ** 2)))
+        lossf = jax.jit(jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, True).astype(jnp.float32) ** 2)))
+        lossr = jax.jit(jax.grad(lambda q: jnp.sum(
+            flash_attention_reference(q, k, v, True).astype(jnp.float32) ** 2)))
+        err = _max_err(lossf(q).astype(jnp.float32),
+                       lossr(q).astype(jnp.float32))
+        assert err < 5e-1, f"flash bwd T={t} diverges from XLA twin: {err}"
         ms_f, ms_r = _time(lossf, q), _time(lossr, q)
-        print(json.dumps({
+        _emit({
             "kernel": "flash_attention_fwd_bwd", "config": f"B{b}xT{t}xH{h}xD{d}",
             "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
-            "speedup": round(ms_r / ms_f, 2),
-        }))
+            "speedup": round(ms_r / ms_f, 2), "max_err": err,
+        })
 
 
 def bench_fused_update():
@@ -69,15 +105,34 @@ def bench_fused_update():
     )
     fused = jax.jit(lambda p, b, g, t: fused_mix_sgd(p, b, g, t, 0.01, 0.9, 1 / 3))
     ref = jax.jit(lambda p, b, g, t: mix_sgd_reference(p, b, g, t, 0.01, 0.9, 1 / 3))
+    pf, tf = fused(p, b_, g, t)
+    pr, tr = ref(p, b_, g, t)
+    err = max(_max_err(pf["w"], pr["w"]), _max_err(tf["w"], tr["w"]))
+    assert err < 1e-5, f"fused_mix_sgd diverges from XLA twin: {err}"
     ms_f, ms_r = _time(fused, p, b_, g, t), _time(ref, p, b_, g, t)
-    print(json.dumps({
+    _emit({
         "kernel": "fused_mix_sgd", "config": f"{n/1e6:.1f}M params",
         "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
-        "speedup": round(ms_r / ms_f, 2),
-    }))
+        "speedup": round(ms_r / ms_f, 2), "max_err": err,
+    })
 
 
 if __name__ == "__main__":
-    print(json.dumps({"platform": jax.devices()[0].platform}))
-    bench_attention()
-    bench_fused_update()
+    args = sys.argv[1:]
+    which = args[0] if args and not args[0].startswith("--") else "all"
+    if which not in ("attn", "fused", "all"):
+        raise SystemExit(f"unknown selector {which!r}: attn | fused | all")
+    seqs = (512, 1024, 2048, 4096)
+    for i, a in enumerate(args):
+        if a in ("--seqs", "--out") and i + 1 >= len(args):
+            raise SystemExit(f"{a} needs a value (see module docstring)")
+        if a == "--seqs":
+            seqs = tuple(int(s) for s in args[i + 1].split(","))
+        if a == "--out":
+            _OUT_PATH = args[i + 1]
+    _emit({"platform": jax.devices()[0].platform,
+           "device_kind": jax.devices()[0].device_kind})
+    if which in ("attn", "all"):
+        bench_attention(seqs)
+    if which in ("fused", "all"):
+        bench_fused_update()

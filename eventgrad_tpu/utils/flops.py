@@ -1,0 +1,93 @@
+"""FLOPs accounting and MFU — the perf yardstick the reference never had.
+
+The reference reports only whole-run wall-clock (`MPI_Wtime`,
+/root/reference/dmnist/cent/cent.cpp:98,158-161). A TPU framework is judged
+on model-FLOPs utilization: analytic FLOPs of the compiled step program
+(XLA's own cost model, so convs/matmuls/fusions are counted as compiled,
+not hand-estimated) divided by measured step time and the chip's peak.
+
+`compiled_flops` works on any backend (the CPU test mesh included);
+`chip_peak_flops` knows the public bf16 peaks of recent TPU generations and
+returns 0.0 for unknown/non-TPU devices, making `mfu()` return None there —
+an MFU against an unknown peak would be noise, not a metric.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+#: public peak dense-matmul throughput (bf16 FLOP/s) by device-kind
+#: substring, most-specific first.
+PEAK_FLOPS_BY_KIND = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),  # Trillium / v6e
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def chip_peak_flops(device: Optional[Any] = None) -> float:
+    """Peak bf16 FLOP/s of one chip; 0.0 when unknown (non-TPU backends)."""
+    device = device or jax.devices()[0]
+    if device.platform != "tpu":
+        return 0.0
+    kind = device.device_kind.lower()
+    for sub, peak in PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return 0.0
+
+
+def compiled_flops(fn, *args, **kwargs) -> float:
+    """Analytic FLOPs of one call of jit-able `fn` at these args, from the
+    compiled executable's cost analysis. 0.0 if the backend reports none."""
+    try:
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # old jax returns [dict]
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def train_step_flops(model, tx, topo, algo, event_cfg, x, y,
+                     per_rank: int, state) -> float:
+    """Analytic FLOPs of one full train step (all vmap-ranks) of the given
+    algo/model at per-rank batch size — the bench/flagship MFU numerator.
+    One definition shared by bench.py and tools/tpu_flagship.py so the two
+    MFU figures can never diverge."""
+    import jax.numpy as jnp
+
+    from eventgrad_tpu.parallel.spmd import spmd
+    from eventgrad_tpu.train.steps import make_train_step
+
+    step = make_train_step(model, tx, topo, algo, event_cfg=event_cfg)
+    xb = jnp.asarray(x[: topo.n_ranks * per_rank]).reshape(
+        (topo.n_ranks, per_rank) + x.shape[1:]
+    )
+    yb = jnp.asarray(y[: topo.n_ranks * per_rank]).reshape(
+        (topo.n_ranks, per_rank)
+    )
+    return compiled_flops(spmd(step, topo), state, (xb, yb))
+
+
+def mfu(flops_per_step: float, step_seconds: float,
+        device: Optional[Any] = None) -> Optional[float]:
+    """Model-FLOPs utilization of ONE device running `flops_per_step` every
+    `step_seconds`. None when either input or the chip peak is unknown.
+
+    For the single-chip rank simulator (vmap over 8 ranks on one chip) pass
+    the TOTAL step FLOPs: all ranks' work runs on the one chip, so the
+    quotient is that chip's true utilization."""
+    peak = chip_peak_flops(device)
+    if not (peak and flops_per_step and step_seconds):
+        return None
+    return flops_per_step / (step_seconds * peak)

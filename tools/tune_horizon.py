@@ -1,0 +1,84 @@
+"""Operating-point sweep for the adaptive threshold (VERDICT round-1 item 4).
+
+Sweeps the horizon (and optionally warmup) at reduced CPU op-points of the
+two headline configs and prints one JSON line per point:
+msgs-saved-%, final loss, consensus test accuracy, and the D-PSGD accuracy
+at the same op-point for the gap. Targets: >=60% CIFAR, >=70% MNIST
+(/root/reference/README.md:4) with a small accuracy gap.
+
+Usage: python tools/tune_horizon.py [cifar|mnist|both] [h1 h2 ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from eventgrad_tpu.utils import compile_cache
+
+compile_cache.honor_cpu_pin()  # JAX_PLATFORMS=cpu must beat the axon plugin
+
+
+def run_point(dataset: str, horizon: float, warmup: int = 30):
+    import jax.numpy as jnp
+
+    from eventgrad_tpu.data.datasets import load_or_synthesize
+    from eventgrad_tpu.models import CNN2, ResNet
+    from eventgrad_tpu.models.resnet import BasicBlock
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.parallel.topology import Ring
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+
+    topo = Ring(8)
+    cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=warmup)
+    if dataset == "cifar":
+        x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+        xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=256)
+        model = ResNet(stage_sizes=(1, 1, 1, 1), block_cls=BasicBlock, num_filters=8)
+        kw = dict(epochs=16, batch_size=8, learning_rate=1e-2, momentum=0.9,
+                  random_sampler=True, log_every_epoch=False)
+    else:
+        x, y = load_or_synthesize("mnist", None, "train", n_synth=2048)
+        xt, yt = load_or_synthesize("mnist", None, "test", n_synth=256)
+        model = CNN2()
+        kw = dict(epochs=60, batch_size=64, learning_rate=0.05,
+                  random_sampler=False, log_every_epoch=False)
+
+    t0 = time.perf_counter()
+    state, hist = train(model, topo, x, y, algo="eventgrad", event_cfg=cfg, **kw)
+    cons = consensus_params(state.params)
+    stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+    acc = evaluate(model, cons, stats0, xt, yt)["accuracy"]
+
+    sd, hd = train(model, topo, x, y, algo="dpsgd", **kw)
+    cons_d = consensus_params(sd.params)
+    stats_d = jax.tree.map(lambda s: s[0], sd.batch_stats)
+    acc_d = evaluate(model, cons_d, stats_d, xt, yt)["accuracy"]
+
+    rec = {
+        "dataset": dataset,
+        "horizon": horizon,
+        "warmup": warmup,
+        "passes": sum(h["steps"] for h in hist),
+        "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
+        "test_acc": round(acc, 2),
+        "test_acc_dpsgd": round(acc_d, 2),
+        "acc_gap": round(acc - acc_d, 2),
+        "loss": round(hist[-1]["loss"], 4),
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    horizons = [float(h) for h in sys.argv[2:]] or [0.95, 0.99, 1.0, 1.05]
+    datasets = ["cifar", "mnist"] if which == "both" else [which]
+    for ds in datasets:
+        for h in horizons:
+            run_point(ds, h)
