@@ -276,12 +276,14 @@ def _run_deadlined(cmd: list, env: dict, timeout_s: float):
         return None, False
 
 
-def _probe_device(env: dict, timeout_s: float) -> str:
-    """'ok' iff the backend the child would use completes a trivial jit
-    in time; 'stalled' on deadline; 'crashed' on fast failure. A wedged
-    accelerator tunnel can hang at ANY stage — device enumeration, first
-    execution, or (observed round 2) backend client init — so the whole
-    probe rides a subprocess deadline and tests an *executed* jit."""
+def _probe_device(env: dict, timeout_s: float):
+    """(verdict, platform): verdict is 'ok' iff the backend the child
+    would use completes a trivial jit in time, 'stalled' on deadline,
+    'crashed' on fast failure; platform is the probed jax platform
+    ('cpu'/'tpu'/...) or None. A wedged accelerator tunnel can hang at
+    ANY stage — device enumeration, first execution, or (observed
+    round 2) backend client init — so the whole probe rides a subprocess
+    deadline and tests an *executed* jit."""
     import sys
 
     code = (
@@ -295,8 +297,12 @@ def _probe_device(env: dict, timeout_s: float) -> str:
         [sys.executable, "-c", code], env, timeout_s
     )
     if timed_out:
-        return "stalled"
-    return "ok" if out and "EG_PROBE_OK" in out else "crashed"
+        return "stalled", None
+    for line in (out or "").splitlines():
+        if line.startswith("EG_PROBE_OK"):
+            parts = line.split()
+            return "ok", parts[1] if len(parts) > 1 else None
+    return "crashed", None
 
 
 def _supervised() -> None:
@@ -355,8 +361,9 @@ def _supervised() -> None:
         remaining = total_s - (time.monotonic() - t_start)
         if remaining < 90:  # not enough budget for a meaningful attempt
             break
+        plat = "cpu"
         if env.get("JAX_PLATFORMS") != "cpu":
-            verdict = _probe_device(env, min(probe_s, remaining - 60))
+            verdict, plat = _probe_device(env, min(probe_s, remaining - 60))
             if verdict != "ok":
                 print(
                     f"device probe {verdict}"
@@ -368,11 +375,15 @@ def _supervised() -> None:
                     env,
                     min(deadline, total_s - (time.monotonic() - t_start)),
                 )
+                plat = "cpu"
         remaining = total_s - (time.monotonic() - t_start)
         attempt_deadline = min(deadline, remaining)
         if (
             attempt == 1
-            and env.get("JAX_PLATFORMS") != "cpu"
+            # reserve only for a real accelerator (the probed platform,
+            # not the env var — a CPU-only host whose probe resolves to
+            # cpu gets the full deadline; only a tunnel can wedge)
+            and plat not in ("cpu", None)
             and remaining - attempt_deadline < _FALLBACK_S
         ):
             # an accelerator attempt can wedge; keep the CPU fallback

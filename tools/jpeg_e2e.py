@@ -64,6 +64,23 @@ def write_fixture(out_dir: str, n_train: int, n_test: int, seed: int = 0) -> Non
                 os.path.join(d, f"{counts[y[i]]:04d}.jpg"), u8, quality=92
             )
             counts[y[i]] += 1
+    # manifest written LAST: its presence marks a complete fixture of this
+    # exact size (an interrupted or differently-sized one regenerates)
+    import json
+
+    with open(os.path.join(out_dir, "fixture.json"), "w") as f:
+        json.dump({"n_train": n_train, "n_test": n_test, "seed": seed}, f)
+
+
+def _fixture_matches(out_dir: str, n_train: int, n_test: int) -> bool:
+    import json
+
+    try:
+        with open(os.path.join(out_dir, "fixture.json")) as f:
+            m = json.load(f)
+        return m.get("n_train") == n_train and m.get("n_test") == n_test
+    except (OSError, ValueError):
+        return False
 
 
 def main() -> None:
@@ -74,9 +91,13 @@ def main() -> None:
     art = os.path.join(repo, "artifacts")
     os.makedirs(art, exist_ok=True)
 
-    if not os.path.isdir(os.path.join(out_dir, "train")):
+    n_test = max(256, n_train // 8)
+    if not _fixture_matches(out_dir, n_train, n_test):
+        import shutil
+
+        shutil.rmtree(out_dir, ignore_errors=True)
         print(f"writing JPEG fixture to {out_dir} ...", flush=True)
-        write_fixture(out_dir, n_train, max(256, n_train // 8))
+        write_fixture(out_dir, n_train, n_test)
 
     for algo in ("eventgrad", "dpsgd"):
         log = os.path.join(art, f"jpeg_e2e_{algo}.jsonl")

@@ -47,11 +47,15 @@ def main() -> None:
     art = os.path.join(repo, "artifacts")
     os.makedirs(art, exist_ok=True)
 
+    # op-point mirrors bench.py's FULL tier (the canonical definition —
+    # keep in sync if that tier changes) and honors the same
+    # EG_BENCH_HORIZON knob so the two artifacts measure one config
     topo = Ring(8)
     global_batch, n_train, n_test = 256, 16384, 2048
     per_rank = global_batch // topo.n_ranks
     model = ResNet18(dtype=jnp.bfloat16)
-    cfg = EventConfig(adaptive=True, horizon=1.0, warmup_passes=30)
+    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.0"))
+    cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=30)
     x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
     xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=n_test)
     common = dict(
@@ -62,7 +66,8 @@ def main() -> None:
     out = {"platform": jax.devices()[0].platform,
            "device_kind": jax.devices()[0].device_kind,
            "epochs": epochs, "passes": epochs * (n_train // global_batch),
-           "global_batch": global_batch, "n_ranks": topo.n_ranks}
+           "global_batch": global_batch, "n_ranks": topo.n_ranks,
+           "horizon": horizon, "warmup_passes": 30}
 
     t0 = time.perf_counter()
     state, hist = train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,

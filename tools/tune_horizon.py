@@ -7,6 +7,7 @@ at the same op-point for the gap. Targets: >=60% CIFAR, >=70% MNIST
 (/root/reference/README.md:4) with a small accuracy gap.
 
 Usage: python tools/tune_horizon.py [cifar|mnist|both] [h1 h2 ...]
+       [--warmup N]   (default 30, the reference's initial_comm_passes)
 """
 
 from __future__ import annotations
@@ -16,7 +17,6 @@ import sys
 import time
 
 import jax
-import numpy as np
 
 from eventgrad_tpu.utils import compile_cache
 
@@ -76,9 +76,19 @@ def run_point(dataset: str, horizon: float, warmup: int = 30):
 
 
 if __name__ == "__main__":
-    which = sys.argv[1] if len(sys.argv) > 1 else "both"
-    horizons = [float(h) for h in sys.argv[2:]] or [0.95, 0.99, 1.0, 1.05]
+    args = sys.argv[1:]
+    warmup = 30
+    if "--warmup" in args:
+        i = args.index("--warmup")
+        if i + 1 >= len(args):
+            raise SystemExit("--warmup needs a value")
+        warmup = int(args[i + 1])
+        del args[i : i + 2]
+    which = args[0] if args else "both"
+    if which not in ("cifar", "mnist", "both"):
+        raise SystemExit(f"unknown dataset {which!r}: cifar | mnist | both")
+    horizons = [float(h) for h in args[1:]] or [0.95, 0.99, 1.0, 1.05]
     datasets = ["cifar", "mnist"] if which == "both" else [which]
     for ds in datasets:
         for h in horizons:
-            run_point(ds, h)
+            run_point(ds, h, warmup)
