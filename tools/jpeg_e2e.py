@@ -93,6 +93,16 @@ def main() -> None:
 
     n_test = max(256, n_train // 8)
     if not _fixture_matches(out_dir, n_train, n_test):
+        if os.path.isdir(os.path.join(out_dir, "train")) and not os.path.exists(
+            os.path.join(out_dir, "fixture.json")
+        ):
+            # a class-folder tree WITHOUT our manifest is not ours to
+            # delete — it may be a real CIFAR-10-images dataset
+            raise SystemExit(
+                f"{out_dir} holds a dataset this script did not generate "
+                "(no fixture.json); refusing to overwrite it — point "
+                "out_dir somewhere else or delete it yourself"
+            )
         import shutil
 
         shutil.rmtree(out_dir, ignore_errors=True)

@@ -9,51 +9,29 @@ final msgs-saved-% and its trajectory (`trail`) — savings climb as training
 converges because parameter-norm drift shrinks, so they must be judged at
 the reference pass counts, not short smoke tiers.
 
+The op-points are tools/tune_horizon.py's `run_point` — one definition, so
+the sweep artifacts and these curves measure the same config (this script
+just runs longer, single-leg, with a trajectory).
+
 Round-2 CPU result committed as artifacts/savings_curve_r2_cpu.jsonl:
-MNIST 66.2% (rising; ~70% claim within reach of the full-scale run),
+MNIST 66.2% (rising; ~70% claim within reach of the full-scale run — and
+artifacts/mnist_parity_r2_cpu.json adds the D-PSGD legs: acc gap −0.58pp),
 CIFAR 47.4% @256 passes rising ~1.5pp/32 passes toward the ~60% target
 at the 3904-pass flagship scale.
 
 Usage: JAX_PLATFORMS=cpu python tools/savings_curve.py"""
-import json
-import time
 
-import jax
-from eventgrad_tpu.utils import compile_cache
+import os
+import sys
 
-compile_cache.honor_cpu_pin()
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from eventgrad_tpu.data.datasets import load_or_synthesize
-from eventgrad_tpu.models import CNN2, ResNet
-from eventgrad_tpu.models.resnet import BasicBlock
-from eventgrad_tpu.parallel.events import EventConfig
-from eventgrad_tpu.parallel.topology import Ring
-from eventgrad_tpu.train.loop import train
+from tune_horizon import run_point  # noqa: E402  (shares the op-points)
 
-topo = Ring(8)
-cfg = EventConfig(adaptive=True, horizon=1.0, warmup_passes=30)
-
-# MNIST CNN-2 at the reference op-point scale: 1168 passes, warmup 30
-xm, ym = load_or_synthesize("mnist", None, "train", n_synth=2048)
-t0 = time.time()
-_, h = train(CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=cfg,
-             epochs=292, batch_size=64, learning_rate=0.05,
-             random_sampler=False, log_every_epoch=False)
-trail = [round(r["msgs_saved_pct"], 1) for r in h[::40]]
-print(json.dumps({"mnist_passes": sum(r["steps"] for r in h),
-                  "mnist_saved": round(h[-1]["msgs_saved_pct"], 2),
-                  "trail": trail, "loss": round(h[-1]["loss"], 4),
-                  "wall": round(time.time() - t0, 1)}), flush=True)
-
-# CIFAR tiny ResNet, 256 passes
-x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
-t0 = time.time()
-_, h = train(ResNet(stage_sizes=(1, 1, 1, 1), block_cls=BasicBlock, num_filters=8),
-             topo, x, y, algo="eventgrad", event_cfg=cfg,
-             epochs=16, batch_size=8, learning_rate=1e-2, momentum=0.9,
-             random_sampler=True, log_every_epoch=False)
-trail = [round(r["msgs_saved_pct"], 1) for r in h[::2]]
-print(json.dumps({"cifar_passes": sum(r["steps"] for r in h),
-                  "cifar_saved": round(h[-1]["msgs_saved_pct"], 2),
-                  "trail": trail, "loss": round(h[-1]["loss"], 4),
-                  "wall": round(time.time() - t0, 1)}), flush=True)
+if __name__ == "__main__":
+    # MNIST at the reference op-point scale: 292 epochs x 4 steps = 1168
+    run_point("mnist", 1.0, warmup=30, epochs=292, dpsgd_leg=False,
+              trail_every=40)
+    # CIFAR reduced op-point, 16 epochs x 16 steps = 256 passes
+    run_point("cifar", 1.0, warmup=30, epochs=16, dpsgd_leg=False,
+              trail_every=2)

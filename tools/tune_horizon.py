@@ -23,9 +23,14 @@ from eventgrad_tpu.utils import compile_cache
 compile_cache.honor_cpu_pin()  # JAX_PLATFORMS=cpu must beat the axon plugin
 
 
-def run_point(dataset: str, horizon: float, warmup: int = 30):
-    import jax.numpy as jnp
-
+def run_point(dataset: str, horizon: float, warmup: int = 30,
+              epochs: int | None = None, dpsgd_leg: bool = True,
+              trail_every: int = 0):
+    """One sweep point. `epochs=None` uses the default reduced op-point;
+    `dpsgd_leg=False` skips the accuracy-comparison leg; `trail_every=N`
+    adds every Nth epoch's msgs-saved-% as a `trail` list. The single
+    definition of the headline reduced op-points — tools/savings_curve.py
+    calls this too, so the two artifact families measure one config."""
     from eventgrad_tpu.data.datasets import load_or_synthesize
     from eventgrad_tpu.models import CNN2, ResNet
     from eventgrad_tpu.models.resnet import BasicBlock
@@ -39,13 +44,13 @@ def run_point(dataset: str, horizon: float, warmup: int = 30):
         x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
         xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=256)
         model = ResNet(stage_sizes=(1, 1, 1, 1), block_cls=BasicBlock, num_filters=8)
-        kw = dict(epochs=16, batch_size=8, learning_rate=1e-2, momentum=0.9,
-                  random_sampler=True, log_every_epoch=False)
+        kw = dict(epochs=epochs or 16, batch_size=8, learning_rate=1e-2,
+                  momentum=0.9, random_sampler=True, log_every_epoch=False)
     else:
         x, y = load_or_synthesize("mnist", None, "train", n_synth=2048)
         xt, yt = load_or_synthesize("mnist", None, "test", n_synth=256)
         model = CNN2()
-        kw = dict(epochs=60, batch_size=64, learning_rate=0.05,
+        kw = dict(epochs=epochs or 60, batch_size=64, learning_rate=0.05,
                   random_sampler=False, log_every_epoch=False)
 
     t0 = time.perf_counter()
@@ -54,11 +59,6 @@ def run_point(dataset: str, horizon: float, warmup: int = 30):
     stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
     acc = evaluate(model, cons, stats0, xt, yt)["accuracy"]
 
-    sd, hd = train(model, topo, x, y, algo="dpsgd", **kw)
-    cons_d = consensus_params(sd.params)
-    stats_d = jax.tree.map(lambda s: s[0], sd.batch_stats)
-    acc_d = evaluate(model, cons_d, stats_d, xt, yt)["accuracy"]
-
     rec = {
         "dataset": dataset,
         "horizon": horizon,
@@ -66,11 +66,20 @@ def run_point(dataset: str, horizon: float, warmup: int = 30):
         "passes": sum(h["steps"] for h in hist),
         "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
         "test_acc": round(acc, 2),
-        "test_acc_dpsgd": round(acc_d, 2),
-        "acc_gap": round(acc - acc_d, 2),
         "loss": round(hist[-1]["loss"], 4),
-        "wall_s": round(time.perf_counter() - t0, 1),
     }
+    if trail_every:
+        rec["trail"] = [
+            round(h["msgs_saved_pct"], 1) for h in hist[::trail_every]
+        ]
+    if dpsgd_leg:
+        sd, hd = train(model, topo, x, y, algo="dpsgd", **kw)
+        cons_d = consensus_params(sd.params)
+        stats_d = jax.tree.map(lambda s: s[0], sd.batch_stats)
+        acc_d = evaluate(model, cons_d, stats_d, xt, yt)["accuracy"]
+        rec["test_acc_dpsgd"] = round(acc_d, 2)
+        rec["acc_gap"] = round(acc - acc_d, 2)
+    rec["wall_s"] = round(time.perf_counter() - t0, 1)
     print(json.dumps(rec), flush=True)
     return rec
 
