@@ -14,10 +14,10 @@ the sweep artifacts and these curves measure the same config (this script
 just runs longer, single-leg, with a trajectory).
 
 Round-2 CPU result committed as artifacts/savings_curve_r2_cpu.jsonl:
-MNIST 66.2% (rising; ~70% claim within reach of the full-scale run — and
+MNIST 66.2% @1168 passes (rising; ~70% claim within reach — and
 artifacts/mnist_parity_r2_cpu.json adds the D-PSGD legs: acc gap −0.58pp),
-CIFAR 47.4% @256 passes rising ~1.5pp/32 passes toward the ~60% target
-at the 3904-pass flagship scale.
+CIFAR 59.3% @1024 passes rising ~0.4pp/128 passes, crossing the ~60%
+target within the 3904-pass flagship scale.
 
 Usage: JAX_PLATFORMS=cpu python tools/savings_curve.py"""
 
@@ -32,6 +32,6 @@ if __name__ == "__main__":
     # MNIST at the reference op-point scale: 292 epochs x 4 steps = 1168
     run_point("mnist", 1.0, warmup=30, epochs=292, dpsgd_leg=False,
               trail_every=40)
-    # CIFAR reduced op-point, 16 epochs x 16 steps = 256 passes
-    run_point("cifar", 1.0, warmup=30, epochs=16, dpsgd_leg=False,
-              trail_every=2)
+    # CIFAR, 64 epochs x 16 steps = 1024 passes
+    run_point("cifar", 1.0, warmup=30, epochs=64, dpsgd_leg=False,
+              trail_every=4)

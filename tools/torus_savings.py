@@ -1,0 +1,46 @@
+"""EventGraD on the 2D torus — the BASELINE stress topology, measured.
+
+The reference only ever runs a 1-D ring (left/right neighbors,
+dmnist/decent/decent.cpp:56-64); the rebuild's topology layer generalizes
+to a 4-exchange torus with uniform 1/5 mixing (parallel/topology.py). On
+the 8-device Torus(4,2) the y-axis has size 2, so both y-shifts reach the
+SAME peer (counted twice, 2/5 weight) — faithfully matching the
+reference's own size-2 ring behavior (both messages still sent,
+decent.cpp:56-64) but meaning each rank has 3 DISTINCT peers, not 4; a
+real v4-256 torus has 4. The op-point is tools/tune_horizon.py's
+`run_point` (one definition across all artifact families) with the
+topology swapped.
+
+Output: one JSON line; committed as artifacts/torus_savings_r2_cpu.json.
+Usage: JAX_PLATFORMS=cpu python tools/torus_savings.py [epochs]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tune_horizon import run_point  # noqa: E402
+
+from eventgrad_tpu.parallel.topology import Torus  # noqa: E402
+
+
+def main() -> None:
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 32  # 32x16 = 512
+    topo = Torus(4, 2)
+    assert topo.n_neighbors == 4 and abs(topo.mix_weight - 0.2) < 1e-9
+    rec = run_point("cifar", 1.0, warmup=30, epochs=epochs,
+                    dpsgd_leg=True, trail_every=4, topo=topo)
+    rec = {
+        "topology": "torus:4x2", "n_neighbor_exchanges": 4,
+        "n_distinct_peers": 3, "mix_weight": 0.2, **rec,
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.makedirs(os.path.join(repo, "artifacts"), exist_ok=True)
+    with open(os.path.join(repo, "artifacts", "torus_savings_r2_cpu.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

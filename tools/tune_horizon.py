@@ -25,12 +25,14 @@ compile_cache.honor_cpu_pin()  # JAX_PLATFORMS=cpu must beat the axon plugin
 
 def run_point(dataset: str, horizon: float, warmup: int = 30,
               epochs: int | None = None, dpsgd_leg: bool = True,
-              trail_every: int = 0):
+              trail_every: int = 0, topo=None):
     """One sweep point. `epochs=None` uses the default reduced op-point;
     `dpsgd_leg=False` skips the accuracy-comparison leg; `trail_every=N`
-    adds every Nth epoch's msgs-saved-% as a `trail` list. The single
-    definition of the headline reduced op-points — tools/savings_curve.py
-    calls this too, so the two artifact families measure one config."""
+    adds every Nth epoch's msgs-saved-% as a `trail` list; `topo` swaps
+    the 8-rank ring for another topology (tools/torus_savings.py). The
+    single definition of the headline reduced op-points —
+    tools/savings_curve.py and torus_savings.py call this too, so every
+    artifact family measures one config."""
     from eventgrad_tpu.data.datasets import load_or_synthesize
     from eventgrad_tpu.models import CNN2, ResNet
     from eventgrad_tpu.models.resnet import BasicBlock
@@ -38,7 +40,7 @@ def run_point(dataset: str, horizon: float, warmup: int = 30,
     from eventgrad_tpu.parallel.topology import Ring
     from eventgrad_tpu.train.loop import consensus_params, evaluate, train
 
-    topo = Ring(8)
+    topo = topo or Ring(8)
     cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=warmup)
     if dataset == "cifar":
         x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
