@@ -1,0 +1,43 @@
+"""Sparsified EventGraD: measured wire bytes vs the dense variants (E5's
+story — real on-the-wire savings, not just skipped messages).
+
+Three legs at the reduced CIFAR op-point (tools/tune_horizon.py's
+`run_point` — one definition across artifact families), 512 passes,
+horizon 1.0, warmup 30: dense eventgrad, sp_eventgrad at top-k 10%, and
+sp_eventgrad at top-k 1%. Reports per-step per-chip sent bytes (the
+BASELINE "grad-sync bytes/step/chip" metric; spevent.cpp:342-381
+semantics: (value,index) pairs only for fired parameters) and consensus
+accuracy.
+
+Output: JSON lines appended per leg (a cut run keeps its finished legs);
+a fresh invocation truncates the file first. Committed as
+artifacts/sparse_bytes_r2_cpu.jsonl.
+Usage: JAX_PLATFORMS=cpu python tools/sparse_bytes.py [epochs]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tune_horizon import run_point  # noqa: E402
+
+
+def main() -> None:
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 32  # 512 passes
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.makedirs(os.path.join(repo, "artifacts"), exist_ok=True)
+    path = os.path.join(repo, "artifacts", "sparse_bytes_r2_cpu.jsonl")
+    if os.path.exists(path):  # fresh run replaces stale rows
+        os.unlink(path)
+    for algo, topk in (("eventgrad", None), ("sp_eventgrad", 10.0),
+                       ("sp_eventgrad", 1.0)):
+        r = run_point("cifar", 1.0, warmup=30, epochs=epochs,
+                      dpsgd_leg=False, algo=algo, topk_percent=topk)
+        with open(path, "a") as f:  # per leg: survives a cut run
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

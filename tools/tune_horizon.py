@@ -25,20 +25,25 @@ compile_cache.honor_cpu_pin()  # JAX_PLATFORMS=cpu must beat the axon plugin
 
 def run_point(dataset: str, horizon: float, warmup: int = 30,
               epochs: int | None = None, dpsgd_leg: bool = True,
-              trail_every: int = 0, topo=None):
+              trail_every: int = 0, topo=None,
+              algo: str = "eventgrad", topk_percent: float | None = None):
     """One sweep point. `epochs=None` uses the default reduced op-point;
     `dpsgd_leg=False` skips the accuracy-comparison leg; `trail_every=N`
     adds every Nth epoch's msgs-saved-% as a `trail` list; `topo` swaps
-    the 8-rank ring for another topology (tools/torus_savings.py). The
-    single definition of the headline reduced op-points —
-    tools/savings_curve.py and torus_savings.py call this too, so every
-    artifact family measures one config."""
+    the 8-rank ring for another topology (tools/torus_savings.py);
+    `algo`/`topk_percent` select the sparsified variant
+    (tools/sparse_bytes.py). The single definition of the headline
+    reduced op-points — savings_curve.py, torus_savings.py, and
+    sparse_bytes.py all call this, so every artifact family measures
+    one config."""
     from eventgrad_tpu.data.datasets import load_or_synthesize
     from eventgrad_tpu.models import CNN2, ResNet
     from eventgrad_tpu.models.resnet import BasicBlock
     from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.parallel.sparsify import SparseConfig
     from eventgrad_tpu.parallel.topology import Ring
     from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.utils import trees
 
     topo = topo or Ring(8)
     cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=warmup)
@@ -56,17 +61,28 @@ def run_point(dataset: str, horizon: float, warmup: int = 30,
                   random_sampler=False, log_every_epoch=False)
 
     t0 = time.perf_counter()
-    state, hist = train(model, topo, x, y, algo="eventgrad", event_cfg=cfg, **kw)
+    state, hist = train(
+        model, topo, x, y, algo=algo, event_cfg=cfg,
+        sparse_cfg=SparseConfig(topk_percent) if topk_percent else None,
+        **kw,
+    )
     cons = consensus_params(state.params)
     stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
     acc = evaluate(model, cons, stats0, xt, yt)["accuracy"]
+    n_params = trees.tree_count_params(jax.tree.map(lambda p: p[0], state.params))
 
     rec = {
         "dataset": dataset,
+        "algo": algo,
+        "topk_percent": topk_percent,
         "horizon": horizon,
         "warmup": warmup,
         "passes": sum(h["steps"] for h in hist),
         "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
+        "sent_bytes_per_step_per_chip": round(
+            hist[-1]["sent_bytes_per_step_per_chip"], 1
+        ),
+        "dense_bytes_per_step_per_chip": float(topo.n_neighbors * 4 * n_params),
         "test_acc": round(acc, 2),
         "loss": round(hist[-1]["loss"], 4),
     }
