@@ -14,10 +14,14 @@ tiled through VMEM. Used opt-in from `train.steps.make_train_step(
 fused_update=True)`; `mix_sgd_reference` is the jnp twin used for
 correctness tests and as the non-TPU fallback.
 
-Layout: each parameter leaf is flattened, zero-padded to a multiple of
-(8, 128) tiles, processed on a 1-D grid of row-blocks, and unpadded —
-shapes stay static, the padding work is negligible, and every leaf reuses
-the same compiled kernel per padded size.
+Layout: each parameter leaf is flattened and viewed as (rows, 128) — a
+free reshape when the leaf size divides the 128-lane tile, which covers
+every conv/fc weight of the flagship ResNet except the 1,728-element
+stem conv — and processed on a 1-D grid
+of row-blocks whose trailing block may be partial (Mosaic masks the
+out-of-bounds stores, so no pad/unpad copies ride the HBM critical path).
+Ragged leaves (biases, BN scales: a few KB) fall back to a zero-padded
+copy of the same kernel; their traffic is negligible.
 """
 
 from __future__ import annotations
@@ -54,16 +58,19 @@ def _kernel(p_ref, b_ref, g_ref, t_ref, po_ref, to_ref, *, lr, momentum, w):
 def _fused_leaf(p, b, g, t, *, lr, momentum, w, interpret):
     orig_shape, orig_dtype = p.shape, p.dtype
     n = p.size
-    per_block = _BLOCK_ROWS * _LANES
-    padded = max(per_block, ((n + per_block - 1) // per_block) * per_block)
-
-    def prep(x):
-        flat = x.reshape(-1).astype(jnp.float32)
-        return jnp.pad(flat, (0, padded - n)).reshape(-1, _LANES)
+    ragged = n % _LANES != 0
+    if ragged:  # small leaves only: pad to one lane-tile multiple (copies)
+        padded = -(-n // _LANES) * _LANES
+        prep = lambda x: jnp.pad(
+            x.reshape(-1).astype(jnp.float32), (0, padded - n)
+        ).reshape(-1, _LANES)
+    else:  # free reshape: no data movement outside the kernel
+        prep = lambda x: x.reshape(-1, _LANES).astype(jnp.float32)
 
     p2, b2, g2, t2 = prep(p), prep(b), prep(g), prep(t)
     rows = p2.shape[0]
-    grid = (rows // _BLOCK_ROWS,)
+    # trailing block may be partial: Mosaic masks out-of-bounds stores
+    grid = (pl.cdiv(rows, _BLOCK_ROWS),)
     spec = pl.BlockSpec(
         (_BLOCK_ROWS, _LANES),
         lambda i: (i, 0),
@@ -81,7 +88,10 @@ def _fused_leaf(p, b, g, t, *, lr, momentum, w, interpret):
         interpret=interpret,
     )(p2, b2, g2, t2)
 
-    unpad = lambda x: x.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+    if ragged:
+        unpad = lambda x: x.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+    else:
+        unpad = lambda x: x.reshape(orig_shape).astype(orig_dtype)
     return unpad(po), unpad(to)
 
 
