@@ -174,6 +174,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--constant", type=float, default=0.0)
     p.add_argument("--warmup-passes", type=int, default=30)   # event.cpp:262
     p.add_argument("--history", type=int, default=2)          # event.cpp:103
+    p.add_argument("--max-silence", type=int, default=0,
+                   help="bounded staleness (beyond reference): force a "
+                   "parameter to fire after N silent passes; 0 = off, "
+                   "1 = exact D-PSGD. Stabilizes aggressive horizons")
     p.add_argument("--topk-percent", type=float, default=10.0)
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
     p.add_argument("--staleness", type=int, default=0, choices=[0, 1],
@@ -341,6 +345,7 @@ def main(argv=None) -> int:
         constant=args.constant,
         warmup_passes=args.warmup_passes,
         history=args.history,
+        max_silence=args.max_silence,
     )
     import contextlib
 

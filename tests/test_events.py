@@ -81,3 +81,51 @@ def test_multi_param_independent_state():
     fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
     assert bool(fire["a"]) and not bool(fire["b"])
     assert int(st.num_events) == 2
+
+
+def test_max_silence_bounds_gap_between_fires():
+    """Beyond-reference: with max_silence=K a parameter never stays silent
+    K passes in a row, even under an impossibly high constant threshold."""
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=False, constant=1e9, warmup_passes=0,
+                      max_silence=3)
+    params = {"w": jnp.array([3.0, 4.0])}
+    st = _state(params, topo, cfg)
+    fires = []
+    for p in range(1, 10):
+        fire, st = decide_and_update(params, st, jnp.int32(p), cfg,
+                                     topo.n_neighbors)
+        fires.append(bool(fire["w"]))
+    # last_sent_iter starts at 0: fires exactly when (pass - last) >= 3
+    assert fires == [False, False, True, False, False, True, False, False,
+                     True]
+
+
+def test_max_silence_one_is_dpsgd():
+    """max_silence=1 fires every pass — the D-PSGD equivalence knob."""
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=False, constant=1e9, warmup_passes=0,
+                      max_silence=1)
+    params = {"w": jnp.array([1.0])}
+    st = _state(params, topo, cfg)
+    for p in range(1, 5):
+        fire, st = decide_and_update(params, st, jnp.int32(p), cfg,
+                                     topo.n_neighbors)
+        assert bool(fire["w"])
+
+
+def test_max_silence_zero_is_reference_behavior():
+    """max_silence=0 (default) leaves the reference trigger untouched."""
+    topo = Ring(4)
+    cfg0 = EventConfig(adaptive=True, horizon=0.5, warmup_passes=0)
+    cfgs = EventConfig(adaptive=True, horizon=0.5, warmup_passes=0,
+                       max_silence=0)
+    params = {"w": jnp.array([3.0, 4.0])}
+    s0, ss = _state(params, topo, cfg0), _state(params, topo, cfgs)
+    for p in range(1, 6):
+        f0, s0 = decide_and_update(params, s0, jnp.int32(p), cfg0,
+                                   topo.n_neighbors)
+        fs, ss = decide_and_update(params, ss, jnp.int32(p), cfgs,
+                                   topo.n_neighbors)
+        assert bool(f0["w"]) == bool(fs["w"])
+    np.testing.assert_allclose(s0.thres["w"], ss.thres["w"])

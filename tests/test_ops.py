@@ -45,6 +45,24 @@ def test_fused_handles_tiny_and_odd_sizes():
     np.testing.assert_allclose(np.asarray(fp["s"]), [1.0, 2.0, 3.0])
 
 
+def test_fused_partial_trailing_block():
+    """A lane-divisible leaf whose row count does NOT divide the block:
+    the pad-free path must mask the out-of-bounds stores of the partial
+    trailing block (fused_update.py layout contract)."""
+    n = 128 * (512 + 100)  # rows=612 -> blocks (512, partial 100)
+    key = jax.random.PRNGKey(7)
+    p, b, g, t = (
+        {"w": jax.random.normal(jax.random.fold_in(key, i), (n,))}
+        for i in range(4)
+    )
+    fp, ft = fused_mix_sgd(p, b, g, t, 0.01, 0.9, 1 / 3, interpret=True)
+    rp, rt = mix_sgd_reference(p, b, g, t, 0.01, 0.9, 1 / 3)
+    np.testing.assert_allclose(np.asarray(fp["w"]), np.asarray(rp["w"]),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ft["w"]), np.asarray(rt["w"]),
+                               rtol=0, atol=1e-6)
+
+
 def test_fused_train_loop_matches_unfused():
     """train(fused_update=True) follows the optax trajectory exactly."""
     from eventgrad_tpu.data.datasets import synthetic_dataset
