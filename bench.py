@@ -40,9 +40,13 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       tier auto-shrinks (reduced -> tiny) to fit what
                       remains.
   EG_BENCH_PROBE_S    device liveness probe deadline (default 60)
-  EG_BENCH_HORIZON    adaptive-threshold horizon override (default 1.0,
-                      the reference's sample adaptive run,
-                      dmnist/event/README.md "horizon 1")
+  EG_BENCH_HORIZON    CIFAR-leg adaptive horizon (default 1.05 — the
+                      stabilized aggressive op-point; requires the
+                      max-silence guard below)
+  EG_BENCH_HORIZON_MNIST  MNIST-leg horizon (default 1.0, the
+                      reference's sample adaptive run)
+  EG_BENCH_MAX_SILENCE    bounded-staleness guard (default 50; 0 =
+                      reference-pure trigger — see events.py)
 Legacy aliases EG_BENCH_TINY=1 / EG_BENCH_CPU=1 map to tier tiny/reduced.
 Identical behavior from `python bench.py` and the driver's invocation:
 every knob above has exactly one default, read in one place.
@@ -91,7 +95,16 @@ def main() -> None:
 
     tier = _tier()
     topo = Ring(8)
-    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.0"))
+    # CIFAR headline leg: the stabilized op-point — aggressive horizon
+    # (threshold GROWS between fires) with the bounded-staleness guard.
+    # Measured at the 320-pass LeNet op-point: 61-63% saved, |gap| <=
+    # 0.78pp across 3 seeds (events.py max_silence docstring; without the
+    # guard horizon 1.05 collapses on some seeds). MNIST keeps the
+    # reference's own neutral horizon 1.0 — its CNN2/lr-0.05 miniature is
+    # savings-happy but accuracy-fragile under aggressive horizons.
+    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.05"))
+    horizon_mnist = float(os.environ.get("EG_BENCH_HORIZON_MNIST", "1.0"))
+    max_silence = int(os.environ.get("EG_BENCH_MAX_SILENCE", "50"))
 
     # --- tier op-points -------------------------------------------------
     # full: the reference CIFAR scale (20 ep x ~195 steps ~= 3.9k passes,
@@ -126,7 +139,10 @@ def main() -> None:
 
     x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
     xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=n_test)
-    event_cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=warmup)
+    event_cfg = EventConfig(
+        adaptive=True, horizon=horizon, warmup_passes=warmup,
+        max_silence=max_silence,
+    )
 
     common = dict(
         epochs=epochs, batch_size=per_rank,
@@ -155,8 +171,13 @@ def main() -> None:
     # secondary op-point: MNIST CNN-2, batch 64/rank, lr 0.05, sequential
     # sampler (event.cpp:103,145,227,255) — reference ~70%
     xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)
+    # reference-pure trigger (max_silence=0): this leg reproduces the
+    # reference's ~70% claim, so the beyond-reference guard stays off
+    mnist_cfg = EventConfig(
+        adaptive=True, horizon=horizon_mnist, warmup_passes=warmup,
+    )
     _, hist_m = train(
-        CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=event_cfg,
+        CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=mnist_cfg,
         epochs=mnist_epochs, batch_size=mnist_batch,
         learning_rate=0.05, random_sampler=False, log_every_epoch=False,
     )
@@ -224,6 +245,8 @@ def main() -> None:
                 "mnist_msgs_saved": round(mnist_saved, 2),
                 "mnist_vs_baseline": round(mnist_saved / 70.0, 4),
                 "horizon": horizon,
+                "horizon_mnist": horizon_mnist,
+                "max_silence": max_silence,
                 "warmup_passes": warmup,
                 "step_ms": round(1000 * step_s, 2),
                 "mfu": mfu,

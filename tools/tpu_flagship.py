@@ -54,8 +54,10 @@ def main() -> None:
     global_batch, n_train, n_test = 256, 16384, 2048
     per_rank = global_batch // topo.n_ranks
     model = ResNet18(dtype=jnp.bfloat16)
-    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.0"))
-    cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=30)
+    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.05"))
+    max_silence = int(os.environ.get("EG_BENCH_MAX_SILENCE", "50"))
+    cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=30,
+                      max_silence=max_silence)
     x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
     xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=n_test)
     common = dict(
@@ -67,7 +69,8 @@ def main() -> None:
            "device_kind": jax.devices()[0].device_kind,
            "epochs": epochs, "passes": epochs * (n_train // global_batch),
            "global_batch": global_batch, "n_ranks": topo.n_ranks,
-           "horizon": horizon, "warmup_passes": 30}
+           "horizon": horizon, "max_silence": max_silence,
+           "warmup_passes": 30}
 
     t0 = time.perf_counter()
     state, hist = train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,
