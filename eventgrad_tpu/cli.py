@@ -273,6 +273,13 @@ def main(argv=None) -> int:
             "--wire applies to gossip exchanges; allreduce gradients "
             "keep full precision"
         )
+    if args.max_silence < 0:
+        raise SystemExit(
+            "--max-silence must be >= 0 (0 disables; a negative bound "
+            "would silently fire every pass)"
+        )
+    if args.max_silence and args.algo not in ("eventgrad", "sp_eventgrad"):
+        raise SystemExit("--max-silence applies to the event algorithms only")
     if args.staleness:
         if args.algo not in ("eventgrad", "sp_eventgrad"):
             raise SystemExit("--staleness applies to the event algorithms only")

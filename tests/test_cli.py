@@ -100,3 +100,16 @@ def test_cli_synthetic_imagenet_stress_config(capsys):
 def test_cli_model_knob_guard():
     with pytest.raises(SystemExit):  # width/classes knobs are resnet-only
         main(["--model", "cnn2", "--num-classes", "100"])
+
+
+def test_max_silence_validation():
+    from eventgrad_tpu.cli import main
+
+    with pytest.raises(SystemExit):  # negative bound would fire every pass
+        main(["--algo", "eventgrad", "--mesh", "ring:4",
+              "--dataset", "synthetic", "--model", "cnn2",
+              "--max-silence", "-1"])
+    with pytest.raises(SystemExit):  # event-algorithm knob only
+        main(["--algo", "dpsgd", "--mesh", "ring:4",
+              "--dataset", "synthetic", "--model", "cnn2",
+              "--max-silence", "10"])
