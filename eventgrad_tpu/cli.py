@@ -275,8 +275,8 @@ def main(argv=None) -> int:
         )
     if args.max_silence < 0:
         raise SystemExit(
-            "--max-silence must be >= 0 (0 disables; a negative bound "
-            "would silently fire every pass)"
+            "--max-silence must be >= 0 (0 disables the bound; a "
+            "negative value would be silently inert)"
         )
     if args.max_silence and args.algo not in ("eventgrad", "sp_eventgrad"):
         raise SystemExit("--max-silence applies to the event algorithms only")

@@ -103,9 +103,7 @@ def test_cli_model_knob_guard():
 
 
 def test_max_silence_validation():
-    from eventgrad_tpu.cli import main
-
-    with pytest.raises(SystemExit):  # negative bound would fire every pass
+    with pytest.raises(SystemExit):  # negative values are rejected
         main(["--algo", "eventgrad", "--mesh", "ring:4",
               "--dataset", "synthetic", "--model", "cnn2",
               "--max-silence", "-1"])
