@@ -49,6 +49,11 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       CPU tiers, whose MNIST miniature is fragile)
   EG_BENCH_MAX_SILENCE    bounded-staleness guard (default 50; 0 =
                       reference-pure trigger — see events.py)
+  EG_BENCH_ATTEMPT_S  (internal: supervisor -> child) the wall budget
+                      this attempt actually got; the full tier drops
+                      from 61 to 30 epochs below 420 s. Manual
+                      full-scale run: EG_BENCH_CHILD=1
+                      EG_BENCH_ATTEMPT_S=3600 EG_BENCH_TIER=full
 Legacy aliases EG_BENCH_TINY=1 / EG_BENCH_CPU=1 map to tier tiny/reduced.
 Identical behavior from `python bench.py` and the driver's invocation:
 every knob above has exactly one default, read in one place.
@@ -120,6 +125,22 @@ def main() -> None:
         model = ResNet18(dtype=jnp.bfloat16)
         warmup = 30
         mnist_n, mnist_epochs, mnist_batch = 8192, 73, 64
+        # the supervisor exports the wall budget this child actually got
+        # (EG_BENCH_ATTEMPT_S). The 61-epoch reference scale (3904
+        # passes x 2 CIFAR legs + 1168 MNIST passes + up to 4 TPU
+        # compiles) has never been timed through the flaky tunnel; under
+        # a tight driver budget run the 30-epoch variant (1920 passes —
+        # past the savings knee, ~70% on the measured trail) rather than
+        # risk the deadline. An UNSET var means no deadline (direct
+        # child run): full scale.
+        att = os.environ.get("EG_BENCH_ATTEMPT_S")
+        if att is not None and float(att) < 420:
+            epochs, mnist_epochs = 30, 37
+            import sys as _sys
+            print(
+                f"full tier: budget {float(att):.0f}s < 420s, running the "
+                "30-epoch variant (1920 passes)", file=_sys.stderr,
+            )
         # at full scale the stabilized MNIST op-point is proven: 75.5%
         # saved at -1.17pp over 1168 passes (artifacts/
         # mnist_stabilized_fullscale_r2_cpu.jsonl). The aggressive
@@ -438,6 +459,7 @@ def _supervised() -> None:
             # path: probe failure, healthy CPU-only host, or an env pin
             _pick_cpu_tier(env, _attempt_deadline(attempt, plat))
         attempt_deadline = _attempt_deadline(attempt, plat)
+        env["EG_BENCH_ATTEMPT_S"] = str(attempt_deadline)
         out, timed_out = _run_deadlined(
             [sys.executable, os.path.abspath(__file__)], env,
             attempt_deadline,
