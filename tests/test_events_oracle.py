@@ -40,6 +40,8 @@ class _Oracle:
             else:  # constant mode re-assigns every pass (:332-334)
                 self.thres[i] = self.cfg.constant
             fire = value_diff >= self.thres[i] or pass_num < self.cfg.warmup_passes
+            if self.cfg.max_silence > 0:  # bounded staleness (beyond ref)
+                fire = fire or (pass_num - self.last_sent_iter[i]) >= self.cfg.max_silence
             if fire:
                 iter_diff = pass_num - self.last_sent_iter[i]
                 self.slopes[i] = np.append(self.slopes[i][1:], value_diff / iter_diff)
@@ -115,3 +117,20 @@ def test_zero_constant_always_fires():
     state, oracle = _run_pair(cfg, n_passes=40)
     # every pass, every param, both neighbors
     assert int(state.num_events) == 40 * 6 * 2 == oracle.num_events
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_max_silence_matches_oracle(seed):
+    """The bounded-staleness bound composes with the adaptive threshold
+    identically in the fused pytree version and the scalar-loop twin —
+    including an aggressive horizon > 1 where the bound actually binds."""
+    cfg = EventConfig(adaptive=True, horizon=1.05, warmup_passes=5,
+                      history=2, max_silence=12)
+    state, oracle = _run_pair(cfg, seed=seed)
+    assert int(state.num_events) == oracle.num_events
+    for i in range(6):
+        k = f"p{i}"
+        np.testing.assert_allclose(float(state.thres[k]), oracle.thres[i], rtol=1e-5)
+        np.testing.assert_allclose(
+            float(state.last_sent_iter[k]), oracle.last_sent_iter[i]
+        )
