@@ -13,7 +13,11 @@ prototypes are low-frequency (so ±4px crops keep them recognizable) and
 horizontally symmetric (so flips are label-preserving) — unlike the bench's
 white-noise prototypes, which augmentation would destroy.
 
-Usage: python tools/jpeg_e2e.py [out_dir] [n_train] [epochs]
+Usage: python tools/jpeg_e2e.py [out_dir] [n_train] [epochs] [horizon]
+       [max_silence]
+Defaults reproduce the committed stabilized artifacts (horizon 1.05,
+max-silence 50 — 67.8% saved at gap 0.0). For the reference-pure trigger
+(55.95% saved): python tools/jpeg_e2e.py /tmp/eg_jpeg_fixture 2048 12 1.0 0
 Artifacts (committed): artifacts/jpeg_e2e_{eventgrad,dpsgd}.jsonl
 """
 
@@ -24,6 +28,9 @@ import subprocess
 import sys
 
 import numpy as np
+
+# script invocation puts tools/ (not the repo root) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def smooth_symmetric_protos(num_classes: int, size: int, seed: int) -> np.ndarray:
@@ -87,6 +94,8 @@ def main() -> None:
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/eg_jpeg_fixture"
     n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
     epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+    horizon = float(sys.argv[4]) if len(sys.argv) > 4 else 1.05
+    max_silence = int(sys.argv[5]) if len(sys.argv) > 5 else 50
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     art = os.path.join(repo, "artifacts")
     os.makedirs(art, exist_ok=True)
@@ -120,12 +129,12 @@ def main() -> None:
             "--model", "resnet18", "--num-filters", "8", "--augment",
             "--epochs", str(epochs), "--global-batch", "64",
             "--lr", "1e-2", "--momentum", "0.9", "--random-sampler",
-            "--thres-mode", "adaptive", "--horizon", "1.0",
             "--log-file", log,
         ]
-        if algo == "dpsgd":
-            cmd = [c for c in cmd if c not in ("--thres-mode", "adaptive",
-                                               "--horizon", "1.0")]
+        if algo == "eventgrad":
+            cmd += ["--thres-mode", "adaptive", "--horizon", str(horizon)]
+            if max_silence:
+                cmd += ["--max-silence", str(max_silence)]
         print("::", " ".join(cmd), flush=True)
         subprocess.run(cmd, cwd=repo, check=True)
     print(f"done; metrics in {art}/jpeg_e2e_*.jsonl", flush=True)
