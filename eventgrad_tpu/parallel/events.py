@@ -63,6 +63,25 @@ class EventConfig:
     max_silence: int = 0
 
 
+def resolve_bench_trigger(environ) -> tuple:
+    """(horizon, max_silence) for the benchmark op-point, resolved from the
+    EG_BENCH_HORIZON / EG_BENCH_MAX_SILENCE env knobs — the ONE definition
+    shared by bench.py and tools/tpu_flagship.py so the two artifacts
+    always measure the same trigger config.
+
+    Default is the stabilized aggressive op-point (horizon 1.05 + silence
+    guard 50). A reference-pure request (guard off) drops the horizon to
+    the neutral 1.0 unless one was explicitly pinned: 1.05 UNGUARDED is
+    the seed-collapsing combination documented above (up to −76pp,
+    artifacts/horizon_stability_r2_cpu.jsonl).
+    """
+    horizon = float(environ.get("EG_BENCH_HORIZON", "1.05"))
+    max_silence = int(environ.get("EG_BENCH_MAX_SILENCE", "50"))
+    if max_silence == 0 and "EG_BENCH_HORIZON" not in environ:
+        horizon = 1.0
+    return horizon, max_silence
+
+
 class EventState(struct.PyTreeNode):
     """Sender-side per-parameter state + per-neighbor receive buffers.
 

@@ -1,0 +1,81 @@
+"""Deadlined subprocesses + accelerator liveness probing.
+
+The TPU tunnel can wedge a blocked device op forever — no Python-level
+interrupt works, and a child stuck in an uninterruptible device op can
+even survive SIGKILL-then-reap. A supervising parent with a hard wall
+deadline is the only reliable watchdog. This is the single home for that
+logic: bench.py's supervisor and tools/tpu_watch.py both ride these two
+helpers, so "tunnel alive" means exactly one thing repo-wide (an
+*executed* jit — a wedged tunnel enumerates devices fine but blocks on
+first use).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def run_deadlined(cmd, env, timeout_s, cwd=None, capture_stderr=False):
+    """subprocess with a hard wall deadline that cannot hang the parent.
+
+    subprocess.run(timeout=...)'s TimeoutExpired path waits forever on a
+    child stuck in an uninterruptible device op: kill, give it a short
+    grace to be reaped (salvaging anything already printed — a child that
+    completed its measurement and then wedged in device teardown is a
+    result), then abandon it unreaped.
+
+    Returns (stdout_or_None, timed_out, returncode_or_None).
+    """
+    try:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, text=True,
+            stderr=subprocess.STDOUT if capture_stderr else None,
+            cwd=cwd or os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+    except OSError:
+        return None, False, None
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return out, False, proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, _ = proc.communicate(timeout=10)
+            return out, True, None
+        except (subprocess.TimeoutExpired, OSError):
+            pass  # unkillable child; abandon without reaping
+        return None, True, None
+    except OSError:
+        # pipe read failed (e.g. EIO from a dying child) — callers'
+        # contract is a result tuple, never an exception
+        return None, False, None
+
+
+def probe_device(env, timeout_s, require_tpu=False):
+    """(verdict, platform): verdict is 'ok' iff the backend the child
+    would use completes an *executed* jit in time, 'stalled' on deadline,
+    'crashed' on fast failure; platform is the probed jax platform
+    ('cpu'/'tpu'/...) or None. With require_tpu, a healthy non-TPU
+    backend counts as 'crashed' (the watcher's notion of liveness)."""
+    code = (
+        "import os, jax, jax.numpy as jnp\n"
+        "from eventgrad_tpu.utils import compile_cache\n"
+        "compile_cache.honor_cpu_pin()\n"
+        "jax.block_until_ready(jax.jit(lambda a: a @ a)(jnp.ones((256, 256))))\n"
+        "d = jax.devices()[0]\n"
+        + ("assert d.platform == 'tpu', d.platform\n" if require_tpu else "")
+        + "print('EG_PROBE_OK', d.platform, d.device_kind)\n"
+    )
+    out, timed_out, _ = run_deadlined(
+        [sys.executable, "-c", code], env, timeout_s
+    )
+    if timed_out:
+        return "stalled", None
+    for line in (out or "").splitlines():
+        if line.startswith("EG_PROBE_OK"):
+            parts = line.split()
+            return "ok", parts[1] if len(parts) > 1 else None
+    return "crashed", None

@@ -11,7 +11,8 @@ ring, bf16 compute, the reference CIFAR op-point scale (~3.9k passes,
 Artifacts (committed): artifacts/tpu_flagship.json (summary),
 artifacts/tpu_trace/ (profiler trace).
 
-Usage: python tools/tpu_flagship.py [epochs] (default 61 = full scale)
+Usage: python tools/tpu_flagship.py [epochs] [out_name]
+       (defaults: 61 = full scale, tpu_flagship.json)
 """
 
 from __future__ import annotations
@@ -54,8 +55,10 @@ def main() -> None:
     global_batch, n_train, n_test = 256, 16384, 2048
     per_rank = global_batch // topo.n_ranks
     model = ResNet18(dtype=jnp.bfloat16)
-    horizon = float(os.environ.get("EG_BENCH_HORIZON", "1.05"))
-    max_silence = int(os.environ.get("EG_BENCH_MAX_SILENCE", "50"))
+    from eventgrad_tpu.parallel.events import resolve_bench_trigger
+
+    # same trigger resolution as bench.py — one definition, zero drift
+    horizon, max_silence = resolve_bench_trigger(os.environ)
     cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=30,
                       max_silence=max_silence)
     x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
@@ -65,7 +68,10 @@ def main() -> None:
         random_sampler=True, log_every_epoch=False,
     )
 
-    out = {"platform": jax.devices()[0].platform,
+    # capture time stamped INSIDE the json — file mtime is reset by git
+    # checkout, so it cannot serve as the capture timestamp
+    out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "platform": jax.devices()[0].platform,
            "device_kind": jax.devices()[0].device_kind,
            "epochs": epochs, "passes": epochs * (n_train // global_batch),
            "global_batch": global_batch, "n_ranks": topo.n_ranks,
@@ -96,15 +102,19 @@ def main() -> None:
     got = mfu(flops, step_s)
     out["mfu_eventgrad"] = round(got, 4) if got else None
 
-    # profiler trace over a couple of steady-state epochs
-    trace_dir = os.path.join(art, "tpu_trace")
-    try:
-        with profiling.trace(trace_dir):
-            train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,
-                  **dict(common, epochs=2))
-        out["trace_dir"] = os.path.relpath(trace_dir, repo)
-    except Exception as e:  # tracing over the tunnel may be unsupported
-        out["trace_error"] = repr(e)
+    # profiler trace over a couple of steady-state epochs. Skippable
+    # (EG_FLAGSHIP_TRACE=0): the watcher's quick rung wants the cheapest
+    # possible artifact and must not mix a small-scale trace into the
+    # committed full-scale trace dir.
+    if os.environ.get("EG_FLAGSHIP_TRACE", "1") != "0":
+        trace_dir = os.path.join(art, "tpu_trace")
+        try:
+            with profiling.trace(trace_dir):
+                train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,
+                      **dict(common, epochs=2))
+            out["trace_dir"] = os.path.relpath(trace_dir, repo)
+        except Exception as e:  # tracing over the tunnel may be unsupported
+            out["trace_error"] = repr(e)
 
     t0 = time.perf_counter()
     state_d, hist_d = train(model, topo, x, y, algo="dpsgd", **common)
@@ -122,9 +132,14 @@ def main() -> None:
         out["test_acc_eventgrad"] - out["test_acc_dpsgd"], 2
     )
 
-    path = os.path.join(art, "tpu_flagship.json")
-    with open(path, "w") as f:
+    out_name = sys.argv[2] if len(sys.argv) > 2 else "tpu_flagship.json"
+    path = os.path.join(art, out_name)
+    # atomic publish: bench.py may read this file concurrently (it embeds
+    # the artifact as tpu_flagship_cached); never let it see a half-write
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(out, f, indent=1)
+    os.replace(tmp, path)
     print(json.dumps(out))
 
 

@@ -1,0 +1,167 @@
+"""Opportunistic TPU capture watchdog (VERDICT round-2 item 1).
+
+Rounds 1-2 both lost their live-tunnel window by waiting for the driver's
+bench run to coincide with a healthy tunnel. This watcher inverts that:
+it polls the device tunnel continuously and, the moment an *executed* jit
+succeeds, runs the capture ladder — cheapest artifact first so a tunnel
+that dies mid-window still leaves evidence:
+
+  1. quick flagship  (tools/tpu_flagship.py 8)   -> artifacts/tpu_flagship_quick.json
+  2. full flagship   (tools/tpu_flagship.py 61)  -> artifacts/tpu_flagship.json
+  3. kernel grid     (bench_kernels.py)          -> KERNELS_TPU.json re-capture
+
+Every probe attempt is appended to artifacts/tpu_probe_log.jsonl so a
+never-live tunnel is itself documented evidence (VERDICT item 1's "if the
+tunnel never answers all round, commit the probe log").
+
+Each ladder step runs in a deadlined subprocess (a wedged tunnel blocks
+device ops uninterruptibly; only a supervising parent can recover).
+
+Usage: python tools/tpu_watch.py [max_hours]   (default 11)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eventgrad_tpu.utils.procwatch import probe_device, run_deadlined
+
+ART = os.path.join(REPO, "artifacts")
+LOG = os.path.join(ART, "tpu_probe_log.jsonl")
+
+
+def _log(rec: dict) -> None:
+    rec["t"] = round(time.time(), 1)
+    rec["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def _run(cmd: list, timeout_s: float, tag: str, artifact=None) -> bool:
+    """Deadlined child. Success = clean exit 0 OR — when `artifact` is
+    given — the artifact file was (re)published after the rung started:
+    a child that completes its measurement, atomically publishes, and
+    then wedges in device teardown has still EARNED the rung (the same
+    salvage rule bench.py's supervisor applies to its metric line)."""
+    t0_wall = time.time()
+    t0 = time.monotonic()
+    out, timed_out, rc = run_deadlined(
+        cmd, dict(os.environ), timeout_s, cwd=REPO, capture_stderr=True
+    )
+    ok = rc == 0 and not timed_out
+    if not ok and artifact is not None:
+        try:
+            ok = os.path.getmtime(artifact) >= t0_wall - 1.0
+        except OSError:
+            ok = False
+    rec = {"event": tag, "ok": ok, "rc": rc,
+           "wall_s": round(time.monotonic() - t0, 1),
+           "tail": (out or "")[-2000:]}
+    if timed_out:
+        rec["timeout_s"] = timeout_s
+        rec["salvaged_artifact"] = bool(ok)
+    _log(rec)
+    return ok
+
+
+def _probe(timeout_s: float = 75.0) -> bool:
+    verdict, plat = probe_device(
+        dict(os.environ), timeout_s, require_tpu=True
+    )
+    _log({"event": "probe", "ok": verdict == "ok", "verdict": verdict,
+          "platform": plat})
+    return verdict == "ok"
+
+
+def _is_tpu_grid(path: str) -> bool:
+    """Only a grid whose header line says platform 'tpu' may replace the
+    committed TPU artifact — bench_kernels.py has no TPU assert and its
+    kernels silently run in CPU interpret mode if the plugin falls back
+    between the probe and the child's init."""
+    try:
+        with open(path) as f:
+            head = json.loads(f.readline())
+        return isinstance(head, dict) and head.get("platform") == "tpu"
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def main() -> None:
+    os.makedirs(ART, exist_ok=True)
+    max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
+    deadline = time.monotonic() + max_hours * 3600
+    # a committed full artifact supersedes the quick rung entirely — never
+    # spend a live window (or risk any overwrite) re-earning a lesser one
+    have_full = os.path.exists(os.path.join(ART, "tpu_flagship.json"))
+    have_quick = have_full or os.path.exists(
+        os.path.join(ART, "tpu_flagship_quick.json")
+    )
+    have_kernels = False  # always re-capture once: round-2 grid had <1x configs
+    flagship = os.path.join(REPO, "tools", "tpu_flagship.py")
+    _log({"event": "start", "max_hours": max_hours})
+
+    full_fails = 0
+    while time.monotonic() < deadline:
+        if have_quick and have_full and have_kernels:
+            _log({"event": "done"})
+            return
+        if not _probe():
+            time.sleep(120)
+            continue
+        # tunnel is live — climb the ladder, cheapest first. The full
+        # rung gets 2 tries before the kernels rung takes the window (a
+        # full run that can't finish must not starve the re-capture);
+        # once kernels are in, leftover windows go back to the full rung.
+        if not have_quick:
+            os.environ["EG_FLAGSHIP_TRACE"] = "0"  # cheapest artifact first
+            have_quick = _run(
+                [sys.executable, flagship, "8", "tpu_flagship_quick.json"],
+                900, "flagship_quick",
+                artifact=os.path.join(ART, "tpu_flagship_quick.json"),
+            )
+            os.environ.pop("EG_FLAGSHIP_TRACE", None)
+            continue  # re-probe before committing to a longer run
+        if not have_full and (full_fails < 2 or have_kernels):
+            have_full = _run(
+                [sys.executable, flagship, "61"], 3600, "flagship_full",
+                artifact=os.path.join(ART, "tpu_flagship.json"),
+            )
+            if not have_full:
+                full_fails += 1
+            continue
+        if not have_kernels:
+            # bench_kernels --out APPENDS: stage to a fresh temp, publish
+            # over KERNELS_TPU.json only on success
+            staged = os.path.join(ART, "kernels_tpu_staged.jsonl")
+            try:
+                os.remove(staged)
+            except FileNotFoundError:
+                pass
+            if _run(
+                [sys.executable, os.path.join(REPO, "bench_kernels.py"),
+                 "--out", staged],
+                1800, "kernels",
+            ):
+                if _is_tpu_grid(staged):
+                    os.replace(staged, os.path.join(REPO, "KERNELS_TPU.json"))
+                    have_kernels = True
+                else:
+                    # a non-TPU grid must not linger in the committed
+                    # artifacts dir under a TPU-implying name
+                    try:
+                        os.remove(staged)
+                    except FileNotFoundError:
+                        pass
+    _log({"event": "deadline", "have_quick": have_quick,
+          "have_full": have_full, "have_kernels": have_kernels})
+
+
+if __name__ == "__main__":
+    main()
