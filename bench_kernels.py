@@ -14,8 +14,14 @@ Prints one JSON line per measurement (flushed immediately — a flaky device
 tunnel can wedge mid-run and the completed measurements must survive):
 {"kernel", "config", "pallas_ms", "xla_ms", "speedup", "max_err"}.
 
-Usage: python bench_kernels.py [attn|fused|all] [--seqs 512,1024,...]
+Usage: python bench_kernels.py [attn|fused|all|tune] [--seqs 512,1024,...]
        [--out FILE]   (appends each line to FILE as well as stdout)
+
+`tune` sweeps flash block sizes (128/256/512) per sequence length and mode
+against the XLA twin, emits the whole grid, and writes the per-shape
+winners to eventgrad_tpu/ops/flash_tuning.json — the dispatch table
+flash_attention consults (ops/flash_tuning.py). Run on the real chip;
+the table is only written when the active platform is TPU.
 """
 
 from __future__ import annotations
@@ -131,6 +137,17 @@ def bench_fused_update():
     )
     _fused_case(f"{n/1e6:.1f}M single leaf", p, b_, g, t)
 
+    # lane-aligned but rows % block != 0: the partial trailing block whose
+    # masked out-of-bounds stores the kernel layout depends on — numerics
+    # must hold compiled on the real chip, not just in interpret mode
+    # (round-2 advisor finding)
+    n2 = 17_400_064 + 128 * 3
+    p2, b2, g2, t2 = (
+        {"w": jax.random.normal(jax.random.fold_in(key, 10 + i), (n2,))}
+        for i in range(4)
+    )
+    _fused_case(f"{n2/1e6:.1f}M partial trailing block", p2, b2, g2, t2)
+
     # the flagship ResNet's real 86-leaf tree: what the train step applies
     # per step (launch overhead + ragged bias/BN leaves included)
     from eventgrad_tpu.models import ResNet18
@@ -150,11 +167,76 @@ def bench_fused_update():
     _fused_case("ResNet18-as-coded tree (86 leaves)", p, like(1), like(2), like(3))
 
 
+def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
+    """Per-shape block sweep -> eventgrad_tpu/ops/flash_tuning.json."""
+    import os
+
+    from eventgrad_tpu.ops import flash_attention, flash_attention_reference
+
+    b, h, d = 4, 8, 64
+    entries = []
+    for t in seqs:
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d), jnp.bfloat16)
+            for i in range(3)
+        )
+        ref_f = jax.jit(lambda q, k, v: flash_attention_reference(q, k, v, True))
+        ref_g = jax.jit(jax.grad(lambda q: jnp.sum(
+            flash_attention_reference(q, k, v, True).astype(jnp.float32) ** 2)))
+        xla_f, xla_g = _time(ref_f, q, k, v), _time(ref_g, q)
+        for mode, xla_ms in (("fwd", xla_f), ("fwd_bwd", xla_g)):
+            best = {"t": t, "mode": mode, "pallas": False, "block": blocks[0],
+                    "pallas_ms": None, "xla_ms": round(xla_ms, 3)}
+            for blk in blocks:
+                if blk > t:
+                    continue
+                try:
+                    if mode == "fwd":
+                        fn = jax.jit(lambda q, k, v, _b=blk: flash_attention(
+                            q, k, v, True, block=_b))
+                        ms = _time(fn, q, k, v)
+                    else:
+                        fn = jax.jit(jax.grad(lambda q, _b=blk: jnp.sum(
+                            flash_attention(q, k, v, True, block=_b)
+                            .astype(jnp.float32) ** 2)))
+                        ms = _time(fn, q)
+                except Exception as e:  # a block config may not compile
+                    _emit({"kernel": f"flash_{mode}", "config": f"T{t}b{blk}",
+                           "error": repr(e)[:200]})
+                    continue
+                _emit({"kernel": f"flash_{mode}", "config": f"T{t}b{blk}",
+                       "pallas_ms": round(ms, 3), "xla_ms": round(xla_ms, 3),
+                       "speedup": round(xla_ms / ms, 2)})
+                if best["pallas_ms"] is None or ms < best["pallas_ms"]:
+                    best.update(pallas_ms=round(ms, 3), block=blk)
+            # the kernel must measurably beat XLA to stay on this shape
+            best["pallas"] = bool(
+                best["pallas_ms"] is not None and best["pallas_ms"] < xla_ms
+            )
+            entries.append(best)
+            _emit({"kernel": f"flash_{mode}", "config": f"T{t}:winner",
+                   **{k_: best[k_] for k_ in ("pallas", "block", "pallas_ms",
+                                              "xla_ms")}})
+    if jax.devices()[0].platform == "tpu":
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "eventgrad_tpu", "ops", "flash_tuning.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": jax.devices()[0].device_kind,
+                       "entries": entries}, f, indent=1)
+        os.replace(tmp, path)
+        _emit({"tuned": path, "n_entries": len(entries)})
+    else:
+        _emit({"tuned": None,
+               "note": "non-TPU platform: table not written"})
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = args[0] if args and not args[0].startswith("--") else "all"
-    if which not in ("attn", "fused", "all"):
-        raise SystemExit(f"unknown selector {which!r}: attn | fused | all")
+    if which not in ("attn", "fused", "all", "tune"):
+        raise SystemExit(f"unknown selector {which!r}: attn | fused | all | tune")
     seqs = (512, 1024, 2048, 4096)
     for i, a in enumerate(args):
         if a in ("--seqs", "--out") and i + 1 >= len(args):
@@ -165,6 +247,8 @@ if __name__ == "__main__":
             _OUT_PATH = args[i + 1]
     _emit({"platform": jax.devices()[0].platform,
            "device_kind": jax.devices()[0].device_kind})
+    if which == "tune":
+        tune_flash(seqs)
     if which in ("attn", "all"):
         bench_attention(seqs)
     if which in ("fused", "all"):

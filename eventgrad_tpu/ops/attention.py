@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
+from eventgrad_tpu.ops import flash_tuning
 from eventgrad_tpu.parallel.ring_attention import full_attention
 
 try:  # TPU memory spaces only exist on TPU builds; interpret mode elsewhere
@@ -51,7 +52,10 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 _LANES = 128
-_BLOCK = 128  # Q and KV block rows; (128, 128) tiles feed the MXU directly
+#: default Q/KV block rows; per-shape winners come from flash_tuning.plan
+#: (Q and KV share one block size: the causal revisit/skip index maps
+#: assume a square block diagonal)
+_BLOCK = 128
 _NEG_INF = -1e30  # finite mask value; exact zeros guaranteed by masking p
 
 flash_attention_reference = full_attention
@@ -95,12 +99,12 @@ def _causal_q_index(causal):
     return lambda b, h, j, i: (b, h, i, 0)
 
 
-def _block_mask(qi, kj, t_real_k, causal, q_off=0, k_off=0):
+def _block_mask(qi, kj, t_real_k, causal, q_off=0, k_off=0, block=_BLOCK):
     """Validity of score block (qi, kj). The padding mask is in local
     coordinates; the causal comparison adds the global offsets (ring hops
     pass the rank origins of the resident Q and K shards)."""
-    qpos = qi * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 0)
-    kpos = kj * _BLOCK + lax.broadcasted_iota(jnp.int32, (_BLOCK, _BLOCK), 1)
+    qpos = qi * block + lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    kpos = kj * block + lax.broadcasted_iota(jnp.int32, (block, block), 1)
     valid = kpos < t_real_k
     if causal:
         valid &= (q_off + qpos) >= (k_off + kpos)
@@ -121,7 +125,7 @@ def _dot(a, b, trans=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
+def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets, block):
     offs_ref, (q_ref, k_ref, v_ref, o_ref, lse_ref), (m_s, l_s, a_s) = _unpack(
         args, 3, has_offsets
     )
@@ -140,7 +144,7 @@ def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = _dot(q, k, trans=True)  # [bq, bk]
-        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off, block)
         s = jnp.where(valid, s, _NEG_INF)
         m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -151,7 +155,7 @@ def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
         a_s[...] = a_s[...] * corr + _dot(p, v)
 
     if causal and not has_offsets:  # skip KV blocks above the diagonal
-        pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
+        pl.when(kj <= qi)(_compute)  # square blocks: index compare suffices
     else:  # offset diagonals are dynamic: mask handles everything
         _compute()
 
@@ -162,7 +166,7 @@ def _fwd_kernel(*args, scale, causal, t_real, nk, has_offsets):
         lse_ref[0, 0] = m_s[...] + jnp.log(l_safe)
 
 
-def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
+def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets, block):
     offs_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref), (dq_s,) = (
         _unpack(args, 1, has_offsets)
     )
@@ -181,7 +185,7 @@ def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
         do = do_ref[0, 0].astype(jnp.float32)
         lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
         s = _dot(q, k, trans=True)
-        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off, block)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse) * valid
         dp = _dot(do, v, trans=True)
@@ -189,7 +193,7 @@ def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
         dq_s[...] += _dot(ds, k)
 
     if causal and not has_offsets:
-        pl.when(kj * _BLOCK < (qi + 1) * _BLOCK)(_compute)
+        pl.when(kj <= qi)(_compute)
     else:
         _compute()
 
@@ -198,7 +202,7 @@ def _dq_kernel(*args, scale, causal, t_real, nk, has_offsets):
         dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets):
+def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets, block):
     (
         offs_ref,
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref),
@@ -220,7 +224,7 @@ def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets):
         do = do_ref[0, 0].astype(jnp.float32)
         lse, delta = lse_ref[0, 0], delta_ref[0, 0]  # [bq, 1]
         s = scale * _dot(q, k, trans=True)  # [bq, bk]
-        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off)
+        valid = _block_mask(qi, kj, t_real, causal, q_off, k_off, block)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse) * valid
         dv_s[...] += jax.lax.dot_general(
@@ -234,7 +238,7 @@ def _dkv_kernel(*args, scale, causal, t_real, nq, has_offsets):
 
     if causal and not has_offsets:
         # Q blocks strictly before this KV block contribute nothing
-        pl.when((qi + 1) * _BLOCK > kj * _BLOCK)(_compute)
+        pl.when(qi >= kj)(_compute)
     else:
         _compute()
 
@@ -249,10 +253,10 @@ def _pad_to(x, t_pad, d_pad):
     return jnp.pad(x, ((0, 0), (0, 0), (0, t_pad - t), (0, d_pad - d)))
 
 
-def _dims(t, d):
-    t_pad = max(_BLOCK, -(-t // _BLOCK) * _BLOCK)
+def _dims(t, d, block):
+    t_pad = max(block, -(-t // block) * block)
     d_pad = max(_LANES, -(-d // _LANES) * _LANES)
-    return t_pad, d_pad, t_pad // _BLOCK
+    return t_pad, d_pad, t_pad // block
 
 
 def _offs_spec(interpret):
@@ -263,22 +267,22 @@ def _offs_spec(interpret):
     return pl.BlockSpec((1, 2), lambda b_, h_, i, j: (0, 0), **kw)
 
 
-def _run_fwd(q, k, v, causal, interpret, offsets=None):
+def _run_fwd(q, k, v, causal, interpret, offsets=None, block=_BLOCK):
     """q/k/v: [B, H, T, D] (already transposed). Returns (out, lse [B,H,T,1]).
 
     offsets: traced (1, 2) int32 [q_offset, k_offset] shifting the causal
     mask to global positions (ring attention hops), or None."""
     b, h, t, d = q.shape
-    t_pad, d_pad, n = _dims(t, d)
+    t_pad, d_pad, n = _dims(t, d, block)
     qp, kp, vp = (_pad_to(x, t_pad, d_pad) for x in (q, k, v))
     scale = 1.0 / float(d) ** 0.5
     has_offs = offsets is not None
 
-    q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    q_blk = _spec((1, 1, block, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
     kv_blk = _spec(
-        (1, 1, _BLOCK, d_pad), _causal_kv_index(causal and not has_offs), interpret
+        (1, 1, block, d_pad), _causal_kv_index(causal and not has_offs), interpret
     )
-    row_blk = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    row_blk = _spec((1, 1, block, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
     in_specs = [q_blk, kv_blk, kv_blk]
     operands = [qp, kp, vp]
     if has_offs:
@@ -287,7 +291,7 @@ def _run_fwd(q, k, v, causal, interpret, offsets=None):
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, t_real=t, nk=n,
-            has_offsets=has_offs,
+            has_offsets=has_offs, block=block,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
@@ -297,9 +301,9 @@ def _run_fwd(q, k, v, causal, interpret, offsets=None):
         in_specs=in_specs,
         out_specs=(q_blk, row_blk),
         scratch_shapes=[
-            _any_scratch((_BLOCK, 1)),
-            _any_scratch((_BLOCK, 1)),
-            _any_scratch((_BLOCK, d_pad)),
+            _any_scratch((block, 1)),
+            _any_scratch((block, 1)),
+            _any_scratch((block, d_pad)),
         ],
         interpret=interpret,
         **_compiler_params(interpret),
@@ -307,11 +311,12 @@ def _run_fwd(q, k, v, causal, interpret, offsets=None):
     return out[:, :, :t, :d], lse[:, :, :t, :]
 
 
-def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
+def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None,
+             block=_BLOCK):
     """FA2 backward. dlse (cotangent of the logsumexp output, [B,H,T,1])
     folds into the delta term: ds = p * (dp - (delta - dlse))."""
     b, h, t, d = q.shape
-    t_pad, d_pad, n = _dims(t, d)
+    t_pad, d_pad, n = _dims(t, d, block)
     qp, kp, vp, op, dop = (_pad_to(x, t_pad, d_pad) for x in (q, k, v, out, do))
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
     scale = 1.0 / float(d) ** 0.5
@@ -323,9 +328,9 @@ def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
         )
     skip = causal and not has_offs
 
-    q_blk = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
-    kv_blk = _spec((1, 1, _BLOCK, d_pad), _causal_kv_index(skip), interpret)
-    row_q = _spec((1, 1, _BLOCK, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    q_blk = _spec((1, 1, block, d_pad), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
+    kv_blk = _spec((1, 1, block, d_pad), _causal_kv_index(skip), interpret)
+    row_q = _spec((1, 1, block, 1), lambda b_, h_, i, j: (b_, h_, i, 0), interpret)
     dq_specs = [q_blk, kv_blk, kv_blk, q_blk, row_q, row_q]
     dq_ops = [qp, kp, vp, dop, lsep, delta]
     if has_offs:
@@ -334,21 +339,21 @@ def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, t_real=t, nk=n,
-            has_offsets=has_offs,
+            has_offsets=has_offs, block=block,
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, t_pad, d_pad), q.dtype),
         grid=(b, h, n, n),
         in_specs=dq_specs,
         out_specs=q_blk,
-        scratch_shapes=[_any_scratch((_BLOCK, d_pad))],
+        scratch_shapes=[_any_scratch((block, d_pad))],
         interpret=interpret,
         **_compiler_params(interpret),
     )(*dq_ops)
 
     # grid order (..., kv-block, q-block): the Q sweep is innermost
-    kv_outer = _spec((1, 1, _BLOCK, d_pad), lambda b_, h_, j, i: (b_, h_, j, 0), interpret)
-    q_inner = _spec((1, 1, _BLOCK, d_pad), _causal_q_index(skip), interpret)
-    row_inner = _spec((1, 1, _BLOCK, 1), _causal_q_index(skip), interpret)
+    kv_outer = _spec((1, 1, block, d_pad), lambda b_, h_, j, i: (b_, h_, j, 0), interpret)
+    q_inner = _spec((1, 1, block, d_pad), _causal_q_index(skip), interpret)
+    row_inner = _spec((1, 1, block, 1), _causal_q_index(skip), interpret)
     dkv_specs = [q_inner, kv_outer, kv_outer, q_inner, row_inner, row_inner]
     dkv_ops = [qp, kp, vp, dop, lsep, delta]
     if has_offs:
@@ -357,7 +362,7 @@ def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, t_real=t, nq=n,
-            has_offsets=has_offs,
+            has_offsets=has_offs, block=block,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, t_pad, d_pad), k.dtype),
@@ -366,7 +371,7 @@ def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
         grid=(b, h, n, n),
         in_specs=dkv_specs,
         out_specs=(kv_outer, kv_outer),
-        scratch_shapes=[_any_scratch((_BLOCK, d_pad)), _any_scratch((_BLOCK, d_pad))],
+        scratch_shapes=[_any_scratch((block, d_pad)), _any_scratch((block, d_pad))],
         interpret=interpret,
         **_compiler_params(interpret),
     )(*dkv_ops)
@@ -374,49 +379,62 @@ def _run_bwd(q, k, v, out, lse, do, causal, interpret, offsets=None, dlse=None):
     return cut(dq), cut(dk), cut(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhtd(q, k, v, causal, interpret):
-    out, _ = _run_fwd(q, k, v, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhtd(q, k, v, causal, interpret, block):
+    out, _ = _run_fwd(q, k, v, causal, interpret, block=block)
     return out
 
 
-def _flash_fwd(q, k, v, causal, interpret):
-    out, lse = _run_fwd(q, k, v, causal, interpret)
+def _flash_fwd(q, k, v, causal, interpret, block):
+    out, lse = _run_fwd(q, k, v, causal, interpret, block=block)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, interpret, res, do):
+def _flash_bwd(causal, interpret, block, res, do):
     q, k, v, out, lse = res
     # do stays in its incoming (usually f32) dtype: kernels upcast anyway,
     # and truncating the cotangent to a bf16 q.dtype would lose precision
-    dq, dk, dv = _run_bwd(q, k, v, out, lse, do, causal, interpret)
+    dq, dk, dv = _run_bwd(q, k, v, out, lse, do, causal, interpret, block=block)
     return dq, dk, dv
 
 
 _flash_bhtd.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_lse_bhtd(q, k, v, offs, causal, interpret):
-    return _run_fwd(q, k, v, causal, interpret, offsets=offs)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_lse_bhtd(q, k, v, offs, causal, interpret, block):
+    return _run_fwd(q, k, v, causal, interpret, offsets=offs, block=block)
 
 
-def _flash_lse_fwd(q, k, v, offs, causal, interpret):
-    out, lse = _run_fwd(q, k, v, causal, interpret, offsets=offs)
+def _flash_lse_fwd(q, k, v, offs, causal, interpret, block):
+    out, lse = _run_fwd(q, k, v, causal, interpret, offsets=offs, block=block)
     return (out, lse), (q, k, v, offs, out, lse)
 
 
-def _flash_lse_bwd(causal, interpret, res, cts):
+def _flash_lse_bwd(causal, interpret, block, res, cts):
     q, k, v, offs, out, lse = res
     do, dlse = cts
     dq, dk, dv = _run_bwd(
-        q, k, v, out, lse, do, causal, interpret, offsets=offs, dlse=dlse
+        q, k, v, out, lse, do, causal, interpret, offsets=offs, dlse=dlse,
+        block=block,
     )
     d_offs = np.zeros(offs.shape, jax.dtypes.float0)  # int operand: no tangent
     return dq, dk, dv, d_offs
 
 
 _flash_lse_bhtd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _resolve_block(t: int, block) -> int:
+    """Static block-rows choice for sequence length t: explicit argument >
+    EG_FLASH_BLOCK env override > flash_tuning table > default."""
+    if block is not None:
+        return int(block)
+    env = flash_tuning.override()
+    if env is not None:
+        return env
+    _, blk = flash_tuning.plan(t, "fwd_bwd")
+    return blk
 
 
 def flash_attention_lse(
@@ -427,6 +445,7 @@ def flash_attention_lse(
     q_offset=0,
     k_offset=0,
     interpret: Optional[bool] = None,
+    block: Optional[int] = None,
 ):
     """Fused attention returning (out [B,T,H,D], logsumexp [B,T,H]).
 
@@ -450,7 +469,8 @@ def flash_attention_lse(
     )[None, :]
     to_bhtd = lambda x: jnp.swapaxes(x, 1, 2)
     out, lse = _flash_lse_bhtd(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v), offs, causal, bool(interpret)
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), offs, causal, bool(interpret),
+        _resolve_block(q.shape[1], block),
     )
     return to_bhtd(out), jnp.swapaxes(lse[..., 0], 1, 2)  # lse -> [B,T,H]
 
@@ -461,12 +481,17 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = False,
     interpret: Optional[bool] = None,
+    block: Optional[int] = None,
 ) -> jnp.ndarray:
     """Fused self-attention on [B, T, H, D] tensors (model layout).
 
     Differentiable (custom FA2 backward). q, k, v must share one sequence
     length. interpret=None auto-selects the Pallas interpreter off-TPU so
     tests run on the CPU mesh; on TPU the kernels compile to Mosaic.
+
+    block=None consults ops/flash_tuning.py: measured per-shape winners
+    (block size, and whether Pallas beats XLA at all for this T — if not,
+    the materialized-score XLA path runs instead, VERDICT r2 item 4).
     """
     if not (q.shape == k.shape == v.shape):
         raise ValueError(
@@ -477,6 +502,14 @@ def flash_attention(
         return full_attention(q, k, v, causal=causal)  # scratch) can't build
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block is None and flash_tuning.override() is None:
+        use_pallas, _ = flash_tuning.plan(q.shape[1], "fwd_bwd")
+        if not use_pallas and not interpret:
+            # measured loss for this shape on this chip: demote to XLA
+            return full_attention(q, k, v, causal=causal)
     to_bhtd = lambda x: jnp.swapaxes(x, 1, 2)
-    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, bool(interpret))
+    out = _flash_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, bool(interpret),
+        _resolve_block(q.shape[1], block),
+    )
     return to_bhtd(out)

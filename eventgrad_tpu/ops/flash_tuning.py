@@ -1,0 +1,79 @@
+"""Per-shape dispatch plan for the flash-attention kernels.
+
+The round-2 TPU capture (KERNELS_TPU.json) showed the fixed 128-row block
+losing to XLA's materialized-score attention at some sequence lengths
+(0.67x at T=512 fwd) while winning at others (1.35x at T=1024) — kernel
+win/loss is a per-shape property. VERDICT r2 item 4's contract: every
+*used* config must beat XLA or demote itself per shape, with the decision
+recorded.
+
+`plan(t, mode)` returns (use_pallas, block_rows) for a sequence length:
+
+  * measured entries come from `flash_tuning.json` next to this module —
+    written from an on-chip `bench_kernels.py --tune` sweep (block sizes x
+    sequence lengths, pallas vs XLA), committed with the capture;
+  * unmeasured shapes default to the Pallas kernel at DEFAULT_BLOCK
+    (Pallas keeps VMEM residency O(block) where XLA materializes the
+    O(T^2) score tensor — at unmeasured long T that asymptotic advantage,
+    not a stale table, should decide).
+
+Table format (flash_tuning.json):
+  {"platform": "...", "entries": [
+     {"t": 512, "mode": "fwd", "pallas": false, "block": 128,
+      "pallas_ms": ..., "xla_ms": ...}, ...]}
+
+Lookup keys on the padded sequence length bucket (exact t match first,
+else nearest measured t on the same mode, preferring the larger).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Optional, Tuple
+
+DEFAULT_BLOCK = 128
+MODES = ("fwd", "fwd_bwd")
+
+_TABLE_PATH = os.path.join(os.path.dirname(__file__), "flash_tuning.json")
+
+
+@functools.lru_cache(maxsize=1)
+def _table():
+    try:
+        with open(_TABLE_PATH) as f:
+            data = json.load(f)
+        entries = data.get("entries", [])
+        return [e for e in entries if e.get("mode") in MODES]
+    except (OSError, json.JSONDecodeError):
+        return []
+
+
+def plan(t: int, mode: str = "fwd_bwd") -> Tuple[bool, int]:
+    """(use_pallas, block_rows) for sequence length `t`.
+
+    `mode`: "fwd" for inference-only attention, "fwd_bwd" for training
+    (the backward kernels' measurement governs, since that is where the
+    step time goes).
+    """
+    assert mode in MODES, mode
+    entries = [e for e in _table() if e["mode"] == mode]
+    if not entries:
+        return True, DEFAULT_BLOCK
+    exact = [e for e in entries if e["t"] == t]
+    if exact:
+        e = exact[0]
+        return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
+    # nearest measured t, preferring the larger (attention cost grows with
+    # t^2: the larger neighbor's trade-off is the safer extrapolation)
+    larger = sorted((e for e in entries if e["t"] > t), key=lambda e: e["t"])
+    smaller = sorted((e for e in entries if e["t"] < t), key=lambda e: -e["t"])
+    e = (larger or smaller)[0]
+    return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
+
+
+def override(t: Optional[int] = None) -> Optional[int]:
+    """EG_FLASH_BLOCK env override (manual experiments); None if unset."""
+    v = os.environ.get("EG_FLASH_BLOCK")
+    return int(v) if v else None

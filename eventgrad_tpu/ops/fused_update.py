@@ -48,6 +48,11 @@ _BLOCK_ROWS = 512  # 512x128 f32 = 256 KiB per ref; 6 refs well under VMEM
 
 
 def _kernel(p_ref, b_ref, g_ref, t_ref, po_ref, to_ref, *, lr, momentum, w):
+    # INVARIANT: strictly elementwise. The partial trailing block relies on
+    # Mosaic masking out-of-bounds stores and tolerating garbage in
+    # out-of-bounds *reads* — safe only because no element's output depends
+    # on any other element. Any future cross-element op (a reduction, a
+    # shift) would silently consume the OOB rows; pad instead.
     mixed = (p_ref[:] + b_ref[:]) * w
     trace = momentum * t_ref[:] + g_ref[:]
     po_ref[:] = mixed - lr * trace
@@ -76,6 +81,14 @@ def _fused_leaf(p, b, g, t, *, lr, momentum, w, interpret):
         lambda i: (i, 0),
         **({"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}),
     )
+    # row blocks are independent: marking the grid parallel lets Mosaic
+    # split it across both megacore TensorCores — without this the sweep
+    # runs on one core while the XLA twin uses both (round-2 grid: 0.79x)
+    extra = {}
+    if not interpret and pltpu is not None:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
     po, to = pl.pallas_call(
         functools.partial(_kernel, lr=lr, momentum=momentum, w=w),
         out_shape=(
@@ -86,6 +99,7 @@ def _fused_leaf(p, b, g, t, *, lr, momentum, w, interpret):
         in_specs=[spec, spec, spec, spec],
         out_specs=(spec, spec),
         interpret=interpret,
+        **extra,
     )(p2, b2, g2, t2)
 
     if ragged:
@@ -93,6 +107,14 @@ def _fused_leaf(p, b, g, t, *, lr, momentum, w, interpret):
     else:
         unpad = lambda x: x.reshape(orig_shape).astype(orig_dtype)
     return unpad(po), unpad(to)
+
+
+#: leaves below this ride the XLA tree-map path instead of a Pallas launch:
+#: biases/BN scales are a few KB — launch overhead and ragged pad/unpad
+#: copies swamp any single-pass benefit there, while the large conv/fc
+#: leaves (99%+ of the traffic) keep the guaranteed-one-pass kernel.
+#: This is the per-shape auto-fallback of VERDICT r2 item 4.
+_MIN_PALLAS_ELEMS = 1 << 16
 
 
 def fused_mix_sgd(
@@ -110,6 +132,10 @@ def fused_mix_sgd(
     `buf_sum` is the elementwise sum of neighbor buffers (zeros for a
     neighborless rank: mix_weight must then be 1.0). Returns
     (new_params, new_trace) with optax-sgd-trace semantics.
+
+    Hybrid dispatch: leaves >= _MIN_PALLAS_ELEMS run the Pallas kernel;
+    smaller leaves take the jnp twin (XLA fuses them into one loop with
+    no launch or padding cost).
     """
     flat_p, treedef = jax.tree.flatten(params)
     flat_b = treedef.flatten_up_to(buf_sum)
@@ -117,10 +143,15 @@ def fused_mix_sgd(
     flat_t = treedef.flatten_up_to(trace)
     out_p, out_t = [], []
     for p, b, g, t in zip(flat_p, flat_b, flat_g, flat_t):
-        np_, nt_ = _fused_leaf(
-            p, b, g, t, lr=float(lr), momentum=float(momentum),
-            w=float(mix_weight), interpret=interpret,
-        )
+        if p.size >= _MIN_PALLAS_ELEMS:
+            np_, nt_ = _fused_leaf(
+                p, b, g, t, lr=float(lr), momentum=float(momentum),
+                w=float(mix_weight), interpret=interpret,
+            )
+        else:  # XLA path: one fused elementwise chain, no launch/pad cost
+            nt_ = momentum * t + g
+            np_ = ((p + b) * mix_weight - lr * nt_).astype(p.dtype)
+            nt_ = nt_.astype(t.dtype)
         out_p.append(np_)
         out_t.append(nt_)
     return treedef.unflatten(out_p), treedef.unflatten(out_t)

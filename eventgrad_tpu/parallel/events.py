@@ -85,8 +85,16 @@ def resolve_bench_trigger(environ) -> tuple:
 class EventState(struct.PyTreeNode):
     """Sender-side per-parameter state + per-neighbor receive buffers.
 
-    thres / last_sent_norm / last_sent_iter: pytree of f32 scalars per param.
-    slopes: pytree of f32[history] per param (sent_slopes_norm, :187).
+    The reference keeps one C scalar array per quantity indexed by
+    parameter id (event.cpp:181-225); the TPU-native form is the same
+    thing as VECTORS over the leaf axis — one fused state-machine update
+    of shape [L] per pass instead of ~L pytree ops on 0-d scalars (which
+    bloat the HLO graph and dominate step overhead for small models).
+    Leaf order is the params pytree's canonical flatten order.
+
+    thres / last_sent_norm / last_sent_iter: f32[L] (L = number of
+        parameter leaves).
+    slopes: f32[L, history] (sent_slopes_norm, :187).
     bufs:   one pytree-like-params per topology neighbor — the RMA window
             halves (:169-179), zero-initialized exactly like the reference
             (:177-179; the /3 mixing still divides by 3 before any message
@@ -94,21 +102,22 @@ class EventState(struct.PyTreeNode):
     num_events: local int32 event counter (:264).
     """
 
-    thres: Any
-    last_sent_norm: Any
-    last_sent_iter: Any
-    slopes: Any
+    thres: jnp.ndarray
+    last_sent_norm: jnp.ndarray
+    last_sent_iter: jnp.ndarray
+    slopes: jnp.ndarray
     bufs: Tuple[Any, ...]
     num_events: jnp.ndarray
 
     @classmethod
     def init(cls, params: Any, topo: Topology, cfg: EventConfig) -> "EventState":
-        zeros = trees.tree_scalar_zeros(params)
+        n = trees.tree_num_leaves(params)
+        zeros = jnp.zeros((n,), jnp.float32)
         return cls(
             thres=zeros,
             last_sent_norm=zeros,
             last_sent_iter=zeros,
-            slopes=jax.tree.map(lambda _: jnp.zeros((cfg.history,), jnp.float32), params),
+            slopes=jnp.zeros((n, cfg.history), jnp.float32),
             bufs=tuple(trees.tree_zeros_like(params) for _ in topo.neighbors),
             num_events=jnp.zeros((), jnp.int32),
         )
@@ -129,33 +138,31 @@ def decide_and_update(
     """
     pass_f = pass_num.astype(jnp.float32)
 
-    curr_norm = trees.tree_norm(params)
-    value_diff = jax.tree.map(
-        lambda c, l: jnp.abs(c - l), curr_norm, state.last_sent_norm
-    )
-    iter_diff = jax.tree.map(lambda l: pass_f - l, state.last_sent_iter)
+    # per-leaf L2 norms stacked into the [L] state-vector order; every
+    # subsequent state-machine op is one fused vector op, not L scalar ops
+    leaves, treedef = jax.tree.flatten(params)
+    curr_norm = jnp.stack(
+        [jnp.linalg.norm(l.reshape(-1)) for l in leaves]
+    ).astype(jnp.float32)
+    value_diff = jnp.abs(curr_norm - state.last_sent_norm)
+    iter_diff = pass_f - state.last_sent_iter
 
     # threshold decay/assignment happens before the check (:330-334)
     if cfg.adaptive:
-        thres = jax.tree.map(lambda t: t * cfg.horizon, state.thres)
+        thres = state.thres * cfg.horizon
     else:
-        thres = jax.tree.map(lambda t: jnp.full_like(t, cfg.constant), state.thres)
+        thres = jnp.full_like(state.thres, cfg.constant)
 
     warm = pass_num < cfg.warmup_passes
-    fire = jax.tree.map(lambda vd, t: (vd >= t) | warm, value_diff, thres)
+    fire_vec = (value_diff >= thres) | warm
     if cfg.max_silence > 0:  # bounded staleness (beyond-reference)
-        fire = jax.tree.map(
-            lambda f, idf: f | (idf >= cfg.max_silence), fire, iter_diff
-        )
+        fire_vec = fire_vec | (iter_diff >= cfg.max_silence)
 
     # slope ring buffer: drop oldest, append value_diff/iter_diff (:363-373)
-    new_slopes = jax.tree.map(
-        lambda s, vd, idf: jnp.concatenate([s[1:], (vd / idf)[None]]),
-        state.slopes,
-        value_diff,
-        iter_diff,
+    new_slopes = jnp.concatenate(
+        [state.slopes[:, 1:], (value_diff / iter_diff)[:, None]], axis=1
     )
-    slope_avg = jax.tree.map(lambda s: jnp.mean(s), new_slopes)
+    slope_avg = jnp.mean(new_slopes, axis=1)
 
     if cfg.adaptive:
         thres_on_fire = slope_avg  # (:376-378)
@@ -163,14 +170,12 @@ def decide_and_update(
         thres_on_fire = thres
 
     new_state = state.replace(
-        thres=trees.tree_where(fire, thres_on_fire, thres),
-        last_sent_norm=trees.tree_where(fire, curr_norm, state.last_sent_norm),
-        last_sent_iter=trees.tree_where(
-            fire, jax.tree.map(lambda _: pass_f, curr_norm), state.last_sent_iter
-        ),
-        slopes=trees.tree_where(fire, new_slopes, state.slopes),
+        thres=jnp.where(fire_vec, thres_on_fire, thres),
+        last_sent_norm=jnp.where(fire_vec, curr_norm, state.last_sent_norm),
+        last_sent_iter=jnp.where(fire_vec, pass_f, state.last_sent_iter),
+        slopes=jnp.where(fire_vec[:, None], new_slopes, state.slopes),
         num_events=state.num_events
-        + n_neighbors
-        * sum(f.astype(jnp.int32) for f in jax.tree.leaves(fire)),
+        + n_neighbors * jnp.sum(fire_vec.astype(jnp.int32)),
     )
+    fire = jax.tree.unflatten(treedef, [fire_vec[i] for i in range(len(leaves))])
     return fire, new_state

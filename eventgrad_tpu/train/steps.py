@@ -313,7 +313,7 @@ def make_train_step(
             metrics["trace_norm"] = jnp.stack(
                 jax.tree.leaves(trees.tree_norm(state.params))
             )
-            metrics["trace_thres"] = jnp.stack(jax.tree.leaves(event_state.thres))
+            metrics["trace_thres"] = event_state.thres  # already [L]-vector
             metrics["trace_fired"] = jnp.stack(
                 [f.astype(jnp.float32) for f in jax.tree.leaves(fire)]
             )

@@ -15,19 +15,8 @@ def tree_norm(tree: Any) -> Any:
     return jax.tree.map(lambda x: jnp.linalg.norm(x.reshape(-1)), tree)
 
 
-def tree_scalar_zeros(tree: Any, dtype=jnp.float32) -> Any:
-    """A pytree of scalar zeros matching `tree`'s structure — the per-parameter
-    C arrays of the reference (event.cpp:181-225) as explicit state."""
-    return jax.tree.map(lambda _: jnp.zeros((), dtype), tree)
-
-
 def tree_zeros_like(tree: Any) -> Any:
     return jax.tree.map(jnp.zeros_like, tree)
-
-
-def tree_where(cond_tree: Any, a: Any, b: Any) -> Any:
-    """Per-leaf select; `cond_tree` holds scalars broadcast against leaves."""
-    return jax.tree.map(lambda c, x, y: jnp.where(c, x, y), cond_tree, a, b)
 
 
 def tree_count_params(tree: Any) -> int:

@@ -21,27 +21,27 @@ def test_adaptive_trace_single_param():
     # pass 1: vd = |5-0| = 5 >= thres 0*0.5 -> fire
     fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
     assert bool(fire["w"])
-    np.testing.assert_allclose(st.slopes["w"], [0.0, 5.0])  # slope = 5/1
-    np.testing.assert_allclose(st.thres["w"], 2.5)  # mean of history
-    np.testing.assert_allclose(st.last_sent_norm["w"], 5.0)
-    np.testing.assert_allclose(st.last_sent_iter["w"], 1.0)
+    np.testing.assert_allclose(st.slopes[0], [0.0, 5.0])  # slope = 5/1
+    np.testing.assert_allclose(st.thres[0], 2.5)  # mean of history
+    np.testing.assert_allclose(st.last_sent_norm[0], 5.0)
+    np.testing.assert_allclose(st.last_sent_iter[0], 1.0)
     assert int(st.num_events) == 2  # ring: counts both neighbors (event.cpp:344)
 
     # pass 2: norm 5.5 -> vd 0.5 < thres 2.5*0.5=1.25 -> no fire, decay only
     params2 = {"w": jnp.array([3.3, 4.4])}  # norm 5.5
     fire, st = decide_and_update(params2, st, jnp.int32(2), cfg, topo.n_neighbors)
     assert not bool(fire["w"])
-    np.testing.assert_allclose(st.thres["w"], 1.25)
-    np.testing.assert_allclose(st.last_sent_norm["w"], 5.0)
+    np.testing.assert_allclose(st.thres[0], 1.25)
+    np.testing.assert_allclose(st.last_sent_norm[0], 5.0)
     assert int(st.num_events) == 2
 
     # pass 3: norm 7 -> vd 2 >= thres 0.625 -> fire; slope = 2/(3-1) = 1
     params3 = {"w": jnp.array([jnp.sqrt(49.0), 0.0])}
     fire, st = decide_and_update(params3, st, jnp.int32(3), cfg, topo.n_neighbors)
     assert bool(fire["w"])
-    np.testing.assert_allclose(st.slopes["w"], [5.0, 1.0])
-    np.testing.assert_allclose(st.thres["w"], 3.0)
-    np.testing.assert_allclose(st.last_sent_iter["w"], 3.0)
+    np.testing.assert_allclose(st.slopes[0], [5.0, 1.0])
+    np.testing.assert_allclose(st.thres[0], 3.0)
+    np.testing.assert_allclose(st.last_sent_iter[0], 3.0)
     assert int(st.num_events) == 4
 
 
@@ -53,7 +53,7 @@ def test_constant_threshold_mode():
 
     fire, st = decide_and_update(params, st, jnp.int32(1), cfg, topo.n_neighbors)
     assert not bool(fire["w"])  # vd 5 < 10
-    np.testing.assert_allclose(st.thres["w"], 10.0)
+    np.testing.assert_allclose(st.thres[0], 10.0)
 
     cfg0 = EventConfig(adaptive=False, constant=0.0, warmup_passes=0)
     st0 = _state(params, topo, cfg0)
@@ -128,4 +128,4 @@ def test_max_silence_zero_is_reference_behavior():
         fs, ss = decide_and_update(params, ss, jnp.int32(p), cfgs,
                                    topo.n_neighbors)
         assert bool(f0["w"]) == bool(fs["w"])
-    np.testing.assert_allclose(s0.thres["w"], ss.thres["w"])
+    np.testing.assert_allclose(s0.thres[0], ss.thres[0])

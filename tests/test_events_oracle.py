@@ -86,16 +86,15 @@ def test_adaptive_matches_oracle(seed):
     cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=8, history=2)
     state, oracle = _run_pair(cfg, seed=seed)
     for i in range(6):
-        k = f"p{i}"
-        np.testing.assert_allclose(float(state.thres[k]), oracle.thres[i], rtol=1e-5)
+        np.testing.assert_allclose(float(state.thres[i]), oracle.thres[i], rtol=1e-5)
         np.testing.assert_allclose(
-            float(state.last_sent_norm[k]), oracle.last_sent_norm[i], rtol=1e-6
+            float(state.last_sent_norm[i]), oracle.last_sent_norm[i], rtol=1e-6
         )
         np.testing.assert_allclose(
-            float(state.last_sent_iter[k]), oracle.last_sent_iter[i]
+            float(state.last_sent_iter[i]), oracle.last_sent_iter[i]
         )
         np.testing.assert_allclose(
-            np.asarray(state.slopes[k]), oracle.slopes[i], rtol=1e-5
+            np.asarray(state.slopes[i]), oracle.slopes[i], rtol=1e-5
         )
     assert int(state.num_events) == oracle.num_events
 
@@ -107,7 +106,7 @@ def test_constant_mode_matches_oracle(seed):
     assert int(state.num_events) == oracle.num_events
     for i in range(6):
         np.testing.assert_allclose(
-            float(state.last_sent_norm[f"p{i}"]), oracle.last_sent_norm[i], rtol=1e-6
+            float(state.last_sent_norm[i]), oracle.last_sent_norm[i], rtol=1e-6
         )
 
 
@@ -129,8 +128,7 @@ def test_max_silence_matches_oracle(seed):
     state, oracle = _run_pair(cfg, seed=seed)
     assert int(state.num_events) == oracle.num_events
     for i in range(6):
-        k = f"p{i}"
-        np.testing.assert_allclose(float(state.thres[k]), oracle.thres[i], rtol=1e-5)
+        np.testing.assert_allclose(float(state.thres[i]), oracle.thres[i], rtol=1e-5)
         np.testing.assert_allclose(
-            float(state.last_sent_iter[k]), oracle.last_sent_iter[i]
+            float(state.last_sent_iter[i]), oracle.last_sent_iter[i]
         )

@@ -1,0 +1,93 @@
+"""Measure the MNIST savings knee vs pass count (VERDICT round-2 item 3).
+
+The driver-captured reduced tier reported 61.6% saved at 180 passes
+(reference-pure trigger) — below the reference's ~70% headline
+(/root/reference/README.md:4) — while the stabilized full-scale op-point
+measures 75.5% at 1168 passes. This sweep maps msgs-saved-% (and test
+accuracy, so savings at collapsed accuracy can't masquerade as wins)
+against pass count for the candidate reduced-tier MNIST op-points, with
+per-leg wall cost, to pick the cheapest config whose savings cross ~70%
+inside the reduced tier's budget.
+
+Writes artifacts/mnist_knee_r3_cpu.jsonl (one JSON line per config).
+
+Usage: python tools/mnist_knee.py [quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> None:
+    jax.config.update("jax_platforms", "cpu")
+
+    from eventgrad_tpu.data.datasets import load_or_synthesize
+    from eventgrad_tpu.models import CNN2
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.parallel.topology import Ring
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = os.path.join(repo, "artifacts", "mnist_knee_r3_cpu.jsonl")
+    topo = Ring(8)
+    quick = len(sys.argv) > 1 and sys.argv[1] == "quick"
+
+    # (n_train, epochs, horizon, max_silence) candidates. batch 64/rank,
+    # lr 0.05, sequential sampler = the reference MNIST op-point
+    # (dmnist/event/event.cpp:103,145,227,255). Reference-pure rows map
+    # the pure knee; stabilized rows test whether the guard keeps the
+    # accuracy-fragile miniature honest at higher pass counts.
+    # round-3 findings so far (artifacts/mnist_knee_r3_cpu.jsonl):
+    # reference-pure plateaus (61.6@180, 62.3@360, 64.2@540, 66.1@544x2data)
+    # and stabilized 1.05+guard50 collapses at miniature scale (81.7% saved
+    # but 36.5% acc at 360 passes). Phase 2: intermediate horizons.
+    grid = [
+        (2048, 45, 1.0, 0),      # wall-calibration rerun (vectorized events)
+        (2048, 90, 1.01, 50),    # gentle growth + guard, 360 passes
+        (2048, 90, 1.02, 50),
+        (2048, 90, 1.03, 50),
+        (2048, 90, 1.02, 25),    # tighter guard
+        (4096, 68, 1.02, 50),    # 544 passes, 2x data
+    ]
+    if quick:
+        grid = grid[:2]
+
+    xt, yt = load_or_synthesize("mnist", None, "test", n_synth=1024)
+    for n_train, epochs, horizon, silence in grid:
+        x, y = load_or_synthesize("mnist", None, "train", n_synth=n_train)
+        cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=10,
+                          max_silence=silence)
+        t0 = time.perf_counter()
+        state, hist = train(
+            CNN2(), topo, x, y, algo="eventgrad", event_cfg=cfg,
+            epochs=epochs, batch_size=64, learning_rate=0.05,
+            random_sampler=False, log_every_epoch=False,
+        )
+        wall = time.perf_counter() - t0
+        cons = consensus_params(state.params)
+        stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+        acc = evaluate(CNN2(), cons, stats0, xt, yt)["accuracy"]
+        rec = {
+            "n_train": n_train, "epochs": epochs,
+            "passes": epochs * (n_train // (64 * topo.n_ranks)),
+            "horizon": horizon, "max_silence": silence,
+            "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
+            "test_acc": round(acc, 2),
+            "wall_s": round(wall, 1),
+        }
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,9 @@ that dies mid-window still leaves evidence:
 
   1. quick flagship  (tools/tpu_flagship.py 8)   -> artifacts/tpu_flagship_quick.json
   2. full flagship   (tools/tpu_flagship.py 61)  -> artifacts/tpu_flagship.json
-  3. kernel grid     (bench_kernels.py)          -> KERNELS_TPU.json re-capture
+  3. flash tuning    (bench_kernels.py tune)     -> eventgrad_tpu/ops/flash_tuning.json
+  4. kernel grid     (bench_kernels.py)          -> KERNELS_TPU.json re-capture
+                                                    (rows reflect the tuned dispatch)
 
 Every probe attempt is appended to artifacts/tpu_probe_log.jsonl so a
 never-live tunnel is itself documented evidence (VERDICT item 1's "if the
@@ -45,22 +47,28 @@ def _log(rec: dict) -> None:
 
 
 def _run(cmd: list, timeout_s: float, tag: str, artifact=None) -> bool:
-    """Deadlined child. Success = clean exit 0 OR — when `artifact` is
-    given — the artifact file was (re)published after the rung started:
-    a child that completes its measurement, atomically publishes, and
-    then wedges in device teardown has still EARNED the rung (the same
-    salvage rule bench.py's supervisor applies to its metric line)."""
+    """Deadlined child. With `artifact`, success means exactly one thing:
+    the artifact file was (re)published after the rung started. That both
+    salvages a child that published and then wedged in device teardown
+    (bench.py's supervisor applies the same rule to its metric line) and
+    rejects a clean exit that silently skipped the write (e.g. a CPU
+    fallback between probe and child init). Without `artifact`, success =
+    clean exit 0 within the deadline."""
     t0_wall = time.time()
     t0 = time.monotonic()
     out, timed_out, rc = run_deadlined(
         cmd, dict(os.environ), timeout_s, cwd=REPO, capture_stderr=True
     )
-    ok = rc == 0 and not timed_out
-    if not ok and artifact is not None:
+    if artifact is not None:
+        # the artifact IS the deliverable: a clean exit that didn't
+        # (re)publish it — e.g. a child that silently fell back to CPU
+        # and skipped the write — has not earned the rung
         try:
             ok = os.path.getmtime(artifact) >= t0_wall - 1.0
         except OSError:
             ok = False
+    else:
+        ok = rc == 0 and not timed_out
     rec = {"event": tag, "ok": ok, "rc": rc,
            "wall_s": round(time.monotonic() - t0, 1),
            "tail": (out or "")[-2000:]}
@@ -104,12 +112,15 @@ def main() -> None:
         os.path.join(ART, "tpu_flagship_quick.json")
     )
     have_kernels = False  # always re-capture once: round-2 grid had <1x configs
+    have_tune = os.path.exists(
+        os.path.join(REPO, "eventgrad_tpu", "ops", "flash_tuning.json")
+    )
     flagship = os.path.join(REPO, "tools", "tpu_flagship.py")
     _log({"event": "start", "max_hours": max_hours})
 
     full_fails = 0
     while time.monotonic() < deadline:
-        if have_quick and have_full and have_kernels:
+        if have_quick and have_full and have_tune and have_kernels:
             _log({"event": "done"})
             return
         if not _probe():
@@ -128,13 +139,24 @@ def main() -> None:
             )
             os.environ.pop("EG_FLAGSHIP_TRACE", None)
             continue  # re-probe before committing to a longer run
-        if not have_full and (full_fails < 2 or have_kernels):
+        if not have_full and (full_fails < 2 or (have_tune and have_kernels)):
             have_full = _run(
                 [sys.executable, flagship, "61"], 3600, "flagship_full",
                 artifact=os.path.join(ART, "tpu_flagship.json"),
             )
             if not have_full:
                 full_fails += 1
+            continue
+        if not have_tune:
+            # per-shape flash block sweep; writes the dispatch table the
+            # kernels grid (and all flash users) then consult
+            have_tune = _run(
+                [sys.executable, os.path.join(REPO, "bench_kernels.py"),
+                 "tune", "--out", os.path.join(ART, "flash_tune_grid.jsonl")],
+                1800, "flash_tune",
+                artifact=os.path.join(REPO, "eventgrad_tpu", "ops",
+                                      "flash_tuning.json"),
+            )
             continue
         if not have_kernels:
             # bench_kernels --out APPENDS: stage to a fresh temp, publish
@@ -160,7 +182,8 @@ def main() -> None:
                     except FileNotFoundError:
                         pass
     _log({"event": "deadline", "have_quick": have_quick,
-          "have_full": have_full, "have_kernels": have_kernels})
+          "have_full": have_full, "have_tune": have_tune,
+          "have_kernels": have_kernels})
 
 
 if __name__ == "__main__":
