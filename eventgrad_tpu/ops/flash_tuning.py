@@ -65,12 +65,18 @@ def plan(t: int, mode: str = "fwd_bwd") -> Tuple[bool, int]:
     if exact:
         e = exact[0]
         return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
-    # nearest measured t, preferring the larger (attention cost grows with
-    # t^2: the larger neighbor's trade-off is the safer extrapolation)
+    # within the measured range: interpolate from the nearest larger
+    # neighbor (attention cost grows with t^2 — its trade-off is the
+    # safer read). BEYOND the measured range the kernel always runs:
+    # Pallas keeps VMEM residency O(block) while XLA materializes the
+    # O(t^2) score tensor, so at unmeasured long context the asymptotics
+    # — not an extrapolated demote verdict — decide.
     larger = sorted((e for e in entries if e["t"] > t), key=lambda e: e["t"])
-    smaller = sorted((e for e in entries if e["t"] < t), key=lambda e: -e["t"])
-    e = (larger or smaller)[0]
-    return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
+    if larger:
+        e = larger[0]
+        return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
+    e = max(entries, key=lambda e: e["t"])
+    return True, int(e.get("block", DEFAULT_BLOCK))
 
 
 def override(t: Optional[int] = None) -> Optional[int]:
