@@ -142,12 +142,16 @@ def main() -> None:
         # child run): full scale.
         att = os.environ.get("EG_BENCH_ATTEMPT_S")
         if att is not None and float(att) < 420:
-            epochs, mnist_epochs = 30, 37
+            # downshift the ResNet legs only: the MNIST CNN-2 leg is
+            # seconds on-chip and 1168 passes IS the ~70% claim's
+            # op-point (mnist_vs_baseline >= 1.0 rides on it)
+            epochs = 30
             downshifted = True
             import sys as _sys
             print(
                 f"full tier: budget {float(att):.0f}s < 420s, running the "
-                "30-epoch variant (1920 passes)", file=_sys.stderr,
+                "30-epoch CIFAR variant (1920 passes; MNIST leg stays at "
+                "full scale)", file=_sys.stderr,
             )
         # at full scale the stabilized MNIST op-point is proven: 75.5%
         # saved at -1.17pp over 1168 passes (artifacts/

@@ -12,18 +12,22 @@ recorded.
   * measured entries come from `flash_tuning.json` next to this module —
     written from an on-chip `bench_kernels.py --tune` sweep (block sizes x
     sequence lengths, pallas vs XLA), committed with the capture;
-  * unmeasured shapes default to the Pallas kernel at DEFAULT_BLOCK
-    (Pallas keeps VMEM residency O(block) where XLA materializes the
-    O(T^2) score tensor — at unmeasured long T that asymptotic advantage,
-    not a stale table, should decide).
+  * with no table at all, every shape defaults to the Pallas kernel at
+    DEFAULT_BLOCK.
 
 Table format (flash_tuning.json):
   {"platform": "...", "entries": [
      {"t": 512, "mode": "fwd", "pallas": false, "block": 128,
       "pallas_ms": ..., "xla_ms": ...}, ...]}
 
-Lookup keys on the padded sequence length bucket (exact t match first,
-else nearest measured t on the same mode, preferring the larger).
+Lookup: exact t match first; within the measured range, the nearest
+LARGER measured t's verdict applies (attention cost grows with t^2 — the
+larger neighbor's trade-off is the safer read). Beyond the measured range
+the kernel runs regardless of the largest entry's win/loss verdict —
+Pallas keeps VMEM residency O(block) where XLA materializes the O(t^2)
+score tensor, so at unmeasured long t the asymptotics, not an
+extrapolated demote, decide — UNLESS no Pallas config even compiled at
+the largest measured t (a hard failure extrapolates as a failure).
 """
 
 from __future__ import annotations
@@ -76,6 +80,11 @@ def plan(t: int, mode: str = "fwd_bwd") -> Tuple[bool, int]:
         e = larger[0]
         return bool(e["pallas"]), int(e.get("block", DEFAULT_BLOCK))
     e = max(entries, key=lambda e: e["t"])
+    if e.get("pallas_ms") is None:
+        # at the largest measured t NO Pallas block even compiled/ran on
+        # this chip — a hard failure, not a speed loss; never extrapolate
+        # the kernel into longer context it was observed broken at
+        return False, DEFAULT_BLOCK
     return True, int(e.get("block", DEFAULT_BLOCK))
 
 
