@@ -181,10 +181,15 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
             jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d), jnp.bfloat16)
             for i in range(3)
         )
+        # lighter timing than the headline grid (iters 10 x 3 bursts):
+        # the sweep is 24+ compile-and-measure configs and must fit the
+        # watcher's 1800 s rung deadline; winners get re-measured at full
+        # depth by the kernels grid that runs after tuning
+        tmr = dict(iters=10, repeats=3)
         ref_f = jax.jit(lambda q, k, v: flash_attention_reference(q, k, v, True))
         ref_g = jax.jit(jax.grad(lambda q: jnp.sum(
             flash_attention_reference(q, k, v, True).astype(jnp.float32) ** 2)))
-        xla_f, xla_g = _time(ref_f, q, k, v), _time(ref_g, q)
+        xla_f, xla_g = _time(ref_f, q, k, v, **tmr), _time(ref_g, q, **tmr)
         for mode, xla_ms in (("fwd", xla_f), ("fwd_bwd", xla_g)):
             best = {"t": t, "mode": mode, "pallas": False, "block": blocks[0],
                     "pallas_ms": None, "xla_ms": round(xla_ms, 3)}
@@ -195,12 +200,12 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
                     if mode == "fwd":
                         fn = jax.jit(lambda q, k, v, _b=blk: flash_attention(
                             q, k, v, True, block=_b))
-                        ms = _time(fn, q, k, v)
+                        ms = _time(fn, q, k, v, **tmr)
                     else:
                         fn = jax.jit(jax.grad(lambda q, _b=blk: jnp.sum(
                             flash_attention(q, k, v, True, block=_b)
                             .astype(jnp.float32) ** 2)))
-                        ms = _time(fn, q)
+                        ms = _time(fn, q, **tmr)
                 except Exception as e:  # a block config may not compile
                     _emit({"kernel": f"flash_{mode}", "config": f"T{t}b{blk}",
                            "error": repr(e)[:200]})

@@ -10,7 +10,7 @@ each op-point carries its honest accuracy gap.
 
 Writes artifacts/cifar_knee_r3_cpu.jsonl (one JSON line per config).
 
-Usage: python tools/cifar_knee.py [quick]
+Usage: python tools/cifar_knee.py [quick|seeds]
 """
 
 from __future__ import annotations
@@ -44,24 +44,34 @@ def main() -> None:
     # lr 1e-2 momentum 0.9, random sampler (bench.py reduced tier)
     n_train, n_test, batch = 1024, 256, 8
     grid = [
-        ("eventgrad", 20, 1.0, 0),    # 320 passes: r2's captured op-point
-        ("eventgrad", 40, 1.0, 0),    # 640 passes
-        ("eventgrad", 60, 1.0, 0),    # 960 passes
-        ("eventgrad", 80, 1.0, 0),    # 1280 passes
-        ("eventgrad", 40, 1.05, 50),  # stabilized at the larger budgets
-        ("eventgrad", 60, 1.05, 50),
-        ("dpsgd", 40, None, None),    # accuracy twins
-        ("dpsgd", 60, None, None),
+        ("eventgrad", 20, 1.0, 0, 0),    # 320 passes: r2's captured op-point
+        ("eventgrad", 40, 1.0, 0, 0),    # 640 passes
+        ("eventgrad", 60, 1.0, 0, 0),    # 960 passes
+        ("eventgrad", 80, 1.0, 0, 0),    # 1280 passes
+        ("eventgrad", 40, 1.05, 50, 0),  # stabilized at the larger budgets
+        ("eventgrad", 60, 1.05, 50, 0),
+        ("dpsgd", 40, None, None, 0),    # accuracy twins
+        ("dpsgd", 60, None, None, 0),
     ]
     if quick:
         grid = grid[:1]
+    if len(sys.argv) > 1 and sys.argv[1] == "seeds":
+        # seed-robustness of the reduced-tier headline op-point (640-pass
+        # stabilized) with per-seed D-PSGD twins
+        grid = [
+            ("eventgrad", 40, 1.05, 50, 1),
+            ("eventgrad", 40, 1.05, 50, 2),
+            ("dpsgd", 40, None, None, 1),
+            ("dpsgd", 40, None, None, 2),
+        ]
 
     x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
     xt, yt = load_or_synthesize("cifar10", None, "test", n_synth=n_test)
-    for algo, epochs, horizon, silence in grid:
+    for algo, epochs, horizon, silence, seed in grid:
         kw = dict(
             epochs=epochs, batch_size=batch, learning_rate=1e-2,
             momentum=0.9, random_sampler=True, log_every_epoch=False,
+            seed=seed,
         )
         if algo == "eventgrad":
             kw["event_cfg"] = EventConfig(
@@ -75,7 +85,7 @@ def main() -> None:
         stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
         acc = evaluate(LeNetCifar(), cons, stats0, xt, yt)["accuracy"]
         rec = {
-            "algo": algo, "epochs": epochs,
+            "algo": algo, "epochs": epochs, "seed": seed,
             "passes": epochs * (n_train // (batch * topo.n_ranks)),
             "horizon": horizon, "max_silence": silence,
             "msgs_saved_pct": (
