@@ -228,8 +228,10 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
                             "eventgrad_tpu", "ops", "flash_tuning.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
+            # swept=true marks a real on-chip block sweep — the watcher
+            # uses it to tell this apart from a hand-seeded table
             json.dump({"platform": jax.devices()[0].device_kind,
-                       "entries": entries}, f, indent=1)
+                       "swept": True, "entries": entries}, f, indent=1)
         os.replace(tmp, path)
         _emit({"tuned": path, "n_entries": len(entries)})
     else:

@@ -88,6 +88,17 @@ def _probe(timeout_s: float = 75.0) -> bool:
     return verdict == "ok"
 
 
+def _is_swept_table(path: str) -> bool:
+    """True only for a table written by a real on-chip block sweep
+    (bench_kernels.py tune stamps swept=true) — a hand-seeded table from
+    prior single-block captures must NOT satisfy the tune rung."""
+    try:
+        with open(path) as f:
+            return bool(json.load(f).get("swept"))
+    except (OSError, json.JSONDecodeError, AttributeError):
+        return False
+
+
 def _is_tpu_grid(path: str) -> bool:
     """Only a grid whose header line says platform 'tpu' may replace the
     committed TPU artifact — bench_kernels.py has no TPU assert and its
@@ -112,7 +123,7 @@ def main() -> None:
         os.path.join(ART, "tpu_flagship_quick.json")
     )
     have_kernels = False  # always re-capture once: round-2 grid had <1x configs
-    have_tune = os.path.exists(
+    have_tune = _is_swept_table(
         os.path.join(REPO, "eventgrad_tpu", "ops", "flash_tuning.json")
     )
     flagship = os.path.join(REPO, "tools", "tpu_flagship.py")
