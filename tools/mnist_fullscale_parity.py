@@ -15,6 +15,9 @@ import json
 import os
 import time
 
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable uninstalled
+
 import jax
 
 from eventgrad_tpu.utils import compile_cache

@@ -22,13 +22,25 @@ import os
 import sys
 import time
 
+# runnable as `python tools/tpu_flagship.py` without installing the
+# package (sys.path[0] is tools/, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import numpy as np
+
+from eventgrad_tpu.utils import compile_cache
+
+# a JAX_PLATFORMS=cpu pin (the smoke-test path) must win over the axon
+# plugin the sitecustomize pre-registered — same rule as bench.py
+compile_cache.honor_cpu_pin()
 
 
 def main() -> None:
     import jax.numpy as jnp
     import optax
+
+    compile_cache.enable()
 
     from eventgrad_tpu.data.datasets import load_or_synthesize
     from eventgrad_tpu.models import ResNet18
@@ -40,9 +52,15 @@ def main() -> None:
     )
     from eventgrad_tpu.utils import profiling
 
-    assert jax.default_backend() == "tpu", (
-        f"flagship run wants the real chip; backend is {jax.default_backend()}"
-    )
+    # EG_FLAGSHIP_ALLOW_CPU=1 is for smoke-testing this script's code path
+    # only (a broken flagship would waste a live-tunnel window); artifacts
+    # it produces carry platform: "cpu" and never satisfy the watcher's
+    # TPU rungs (tpu_watch runs without the knob).
+    if os.environ.get("EG_FLAGSHIP_ALLOW_CPU") != "1":
+        assert jax.default_backend() == "tpu", (
+            f"flagship run wants the real chip; backend is "
+            f"{jax.default_backend()}"
+        )
     epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 61
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     art = os.path.join(repo, "artifacts")
@@ -53,8 +71,18 @@ def main() -> None:
     # EG_BENCH_HORIZON knob so the two artifacts measure one config
     topo = Ring(8)
     global_batch, n_train, n_test = 256, 16384, 2048
+    dtype = jnp.bfloat16
+    smoke = os.environ.get("EG_FLAGSHIP_SMOKE") == "1"
+    if smoke:
+        # full code path at toy scale — for validating this script off-chip
+        # (with EG_FLAGSHIP_ALLOW_CPU=1) so a bug never burns a live
+        # tunnel window; never set by the watcher. f32: XLA-CPU's bf16
+        # emulation is pathologically slow (measured: 8 toy passes > 10
+        # min), and the smoke validates the code path, not the numerics.
+        global_batch, n_train, n_test = 64, 512, 128
+        dtype = jnp.float32
     per_rank = global_batch // topo.n_ranks
-    model = ResNet18(dtype=jnp.bfloat16)
+    model = ResNet18(dtype=dtype)
     from eventgrad_tpu.parallel.events import resolve_bench_trigger
 
     # same trigger resolution as bench.py — one definition, zero drift
@@ -106,7 +134,7 @@ def main() -> None:
     # (EG_FLAGSHIP_TRACE=0): the watcher's quick rung wants the cheapest
     # possible artifact and must not mix a small-scale trace into the
     # committed full-scale trace dir.
-    if os.environ.get("EG_FLAGSHIP_TRACE", "1") != "0":
+    if os.environ.get("EG_FLAGSHIP_TRACE", "0" if smoke else "1") != "0":
         trace_dir = os.path.join(art, "tpu_trace")
         try:
             with profiling.trace(trace_dir):
@@ -133,6 +161,10 @@ def main() -> None:
     )
 
     out_name = sys.argv[2] if len(sys.argv) > 2 else "tpu_flagship.json"
+    if smoke and out_name == "tpu_flagship.json":
+        # a toy/CPU smoke must never clobber the committed full-scale
+        # artifact bench.py embeds as chip numbers
+        out_name = "tpu_flagship_smoke.json"
     path = os.path.join(art, out_name)
     # atomic publish: bench.py may read this file concurrently (it embeds
     # the artifact as tpu_flagship_cached); never let it see a half-write

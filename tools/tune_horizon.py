@@ -16,6 +16,9 @@ import json
 import sys
 import time
 
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable uninstalled
+
 import jax
 
 from eventgrad_tpu.utils import compile_cache
