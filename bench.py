@@ -294,8 +294,8 @@ def main() -> None:
         try:
             with open(p) as f:
                 rec = json.load(f)
-            if not isinstance(rec, dict):
-                continue  # fall through to the quick artifact
+            if not isinstance(rec, dict) or rec.get("platform") != "tpu":
+                continue  # only chip-captured artifacts may ride as cached
         except (OSError, json.JSONDecodeError):
             continue
         # the artifact stamps its own capture time; mtime is only a

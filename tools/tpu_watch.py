@@ -117,9 +117,18 @@ def main() -> None:
     max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
     deadline = time.monotonic() + max_hours * 3600
     # a committed full artifact supersedes the quick rung entirely — never
-    # spend a live window (or risk any overwrite) re-earning a lesser one
-    have_full = os.path.exists(os.path.join(ART, "tpu_flagship.json"))
-    have_quick = have_full or os.path.exists(
+    # spend a live window (or risk any overwrite) re-earning a lesser one.
+    # Only chip-captured artifacts count (platform == "tpu"): a stray
+    # CPU-written file must not gate a rung shut.
+    def _is_tpu_artifact(path):
+        try:
+            with open(path) as f:
+                return json.load(f).get("platform") == "tpu"
+        except (OSError, json.JSONDecodeError, AttributeError):
+            return False
+
+    have_full = _is_tpu_artifact(os.path.join(ART, "tpu_flagship.json"))
+    have_quick = have_full or _is_tpu_artifact(
         os.path.join(ART, "tpu_flagship_quick.json")
     )
     have_kernels = False  # always re-capture once: round-2 grid had <1x configs
