@@ -55,7 +55,17 @@ def main() -> None:
     ]
     if quick:
         grid = grid[:1]
-    if len(sys.argv) > 1 and sys.argv[1] == "seeds":
+    if len(sys.argv) > 1 and sys.argv[1] == "fullscale":
+        # the reference CIFAR pass count (244 epochs x 16 steps = 3904
+        # passes, dcifar10/event/event.cpp:31-36 scale) on the LeNet
+        # miniature: round-3 re-verification of the reference-pure and
+        # stabilized full-scale claims with the vectorized event path
+        grid = [
+            ("eventgrad", 244, 1.0, 0, 0),
+            ("eventgrad", 244, 1.05, 50, 0),
+            ("dpsgd", 244, None, None, 0),
+        ]
+    elif len(sys.argv) > 1 and sys.argv[1] == "seeds":
         # seed-robustness of the reduced-tier headline op-point (640-pass
         # stabilized) with per-seed D-PSGD twins
         grid = [
