@@ -320,15 +320,19 @@ def main() -> None:
     mnist_proven = None
     if tier != "full":
         mnist_proven = {
-            "fullscale": {
-                "msgs_saved_pct": 75.5, "acc_gap_vs_dpsgd": -1.17,
-                "passes": 1168, "trigger": "stabilized",
-                "artifact": "artifacts/mnist_stabilized_fullscale_r2_cpu.jsonl",
+            "fullscale_stabilized": {
+                "msgs_saved_pct": 78.9, "test_acc": 98.9,
+                "passes": 1168, "n_train": 8192, "warmup": 30,
+                "artifact": "artifacts/mnist_knee_r3_cpu.jsonl",
+                "r2_with_dpsgd_twin": {
+                    "msgs_saved_pct": 75.5, "acc_gap_vs_dpsgd": -1.17,
+                    "artifact":
+                        "artifacts/mnist_stabilized_fullscale_r2_cpu.jsonl",
+                },
             },
-            "cheapest_70pct": {
-                "msgs_saved_pct": 69.96, "acc_gap_vs_refpure": -0.8,
-                "passes": 544, "horizon": 1.02, "max_silence": 50,
-                "n_train": 4096,
+            "fullscale_reference_pure": {
+                "msgs_saved_pct": 69.56, "test_acc": 99.1,
+                "passes": 1168, "n_train": 8192, "warmup": 30,
                 "artifact": "artifacts/mnist_knee_r3_cpu.jsonl",
             },
         }
