@@ -211,10 +211,37 @@ def synthetic_dataset(
     return x, y
 
 
+def load_digits(split: str, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Real handwritten-digit scans bundled with scikit-learn (UCI digits:
+    1,797 genuine 8x8 grayscale images, 10 classes) — the one real image
+    dataset available without network egress. Upsampled 8x8 -> 32x32
+    (nearest) and center-cropped to the 28x28 MNIST geometry so the MNIST
+    models apply unchanged. Deterministic shuffle; 357 test / 1440 train.
+    """
+    from sklearn.datasets import load_digits as _sk_digits
+
+    d = _sk_digits()
+    imgs = d.images.astype(np.float32) / 16.0
+    big = np.kron(imgs, np.ones((4, 4), np.float32))[:, 2:30, 2:30, None]
+    labels = d.target.astype(np.int32)
+    order = np.random.default_rng(seed).permutation(len(labels))
+    big, labels = big[order], labels[order]
+    n_test = 357
+    if split == "train":
+        return big[n_test:], labels[n_test:]
+    return big[:n_test], labels[:n_test]
+
+
 def load_or_synthesize(
     dataset: str, data_dir: Optional[str], split: str, n_synth: int = 4096, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Try real data, fall back to the synthetic stand-in of matching shape."""
+    """Try real data, fall back to the synthetic stand-in of matching shape.
+
+    "digits" is always real (bundled with scikit-learn, no data_dir
+    needed); "mnist"/"cifar10" read real bytes from data_dir when present.
+    """
+    if dataset == "digits":
+        return load_digits(split, seed=seed)
     shape = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
     if data_dir:
         try:
