@@ -30,24 +30,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 
 
 def _load() -> tuple:
-    from sklearn.datasets import load_digits
+    # one loader repo-wide: the same real scans ship as the CLI's
+    # `--dataset digits` (data/datasets.py::load_digits)
+    from eventgrad_tpu.data.datasets import load_digits
 
-    d = load_digits()
-    imgs = d.images.astype(np.float32) / 16.0  # 0..16 -> 0..1
-    # 8x8 -> 32x32 nearest (kron x4), center-crop 28x28: real pixels in
-    # the MNIST geometry the models expect
-    big = np.kron(imgs, np.ones((4, 4), np.float32))
-    big = big[:, 2:30, 2:30, None]
-    labels = d.target.astype(np.int32)
-    rng = np.random.default_rng(0)
-    order = rng.permutation(len(labels))
-    big, labels = big[order], labels[order]
-    n_test = 357  # leaves 1440 train samples
-    return (big[n_test:], labels[n_test:]), (big[:n_test], labels[:n_test])
+    return load_digits("train"), load_digits("test")
 
 
 def main() -> None:
