@@ -198,7 +198,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shorthand for --wire bf16")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
-                        "(gossip algorithms; plain/momentum SGD only)")
+                        "(gossip algorithms; plain/momentum SGD only). "
+                        "Off by default per measurement: the r2 v5e grid "
+                        "timed the kernel at 0.79x the XLA fusion "
+                        "(KERNELS_TPU.json); small leaves auto-route to "
+                        "XLA either way (ops/fused_update.py). Flip the "
+                        "default if a re-captured grid shows the "
+                        "megacore-parallel kernel winning")
     p.add_argument("--random-sampler", action="store_true")
     p.add_argument("--sync-bn", action="store_true")
     p.add_argument("--seed", type=int, default=0)             # torch::manual_seed(0)
