@@ -54,28 +54,84 @@ def run_deadlined(cmd, env, timeout_s, cwd=None, capture_stderr=False):
         return None, False, None
 
 
-def probe_device(env, timeout_s, require_tpu=False):
-    """(verdict, platform): verdict is 'ok' iff the backend the child
-    would use completes an *executed* jit in time, 'stalled' on deadline,
-    'crashed' on fast failure; platform is the probed jax platform
-    ('cpu'/'tpu'/...) or None. With require_tpu, a healthy non-TPU
-    backend counts as 'crashed' (the watcher's notion of liveness)."""
-    code = (
-        "import os, jax, jax.numpy as jnp\n"
-        "from eventgrad_tpu.utils import compile_cache\n"
-        "compile_cache.honor_cpu_pin()\n"
-        "jax.block_until_ready(jax.jit(lambda a: a @ a)(jnp.ones((256, 256))))\n"
-        "d = jax.devices()[0]\n"
-        + ("assert d.platform == 'tpu', d.platform\n" if require_tpu else "")
-        + "print('EG_PROBE_OK', d.platform, d.device_kind)\n"
+# Staged probe child: every phase is bracketed by flushed EG_STAGE
+# markers so that when the parent kills a wedged child, the salvaged
+# partial stdout pinpoints WHERE the tunnel wedged (import vs device
+# enumeration vs executed jit) — round-3's probe log could only say
+# "stalled", which the round-3 verdict flagged as insufficient diagnosis.
+_PROBE_CODE = (
+    "print('EG_STAGE spawn', flush=True)\n"
+    "import os, jax, jax.numpy as jnp\n"
+    "from eventgrad_tpu.utils import compile_cache\n"
+    "compile_cache.honor_cpu_pin()\n"
+    "print('EG_STAGE import_ok', jax.__version__, flush=True)\n"
+    "print('EG_STAGE enum_start', flush=True)\n"
+    "ds = jax.devices()\n"
+    "print('EG_STAGE enum_ok', ds[0].platform, len(ds), flush=True)\n"
+    "print('EG_STAGE jit_start', flush=True)\n"
+    "jax.block_until_ready(jax.jit(lambda a: a @ a)(jnp.ones((256, 256))))\n"
+    "print('EG_STAGE jit_ok', flush=True)\n"
+    "d = ds[0]\n"
+    "{tpu_assert}"
+    "print('EG_PROBE_OK', d.platform, d.device_kind, flush=True)\n"
+)
+
+
+def probe_device_diag(env, timeout_s, require_tpu=False):
+    """Diagnostic liveness probe. Returns a dict:
+
+      verdict   'ok' | 'stalled' | 'crashed'
+      platform  jax platform string or None
+      stage     last marker the child reached ('spawn', 'import_ok',
+                'enum_start', 'enum_ok', 'jit_start', 'jit_ok', or
+                'probe_ok' on full success) — for a stalled child this
+                names the phase the tunnel wedged in; None if no marker
+                was salvaged
+      tail      last chunk of combined stdout+stderr (exception text for
+                crashes, plugin chatter for stalls)
+
+    'ok' iff the backend completes an *executed* jit AND the child exits
+    within the deadline — a child that prints its success line but then
+    wedges in device teardown is still 'stalled' (same rule as the old
+    probe: a tunnel that cannot tear down cleanly will wedge the next
+    real workload too). With require_tpu, a healthy non-TPU backend
+    counts as 'crashed'."""
+    code = _PROBE_CODE.format(
+        tpu_assert=("assert d.platform == 'tpu', d.platform\n"
+                    if require_tpu else "")
     )
-    out, timed_out, _ = run_deadlined(
-        [sys.executable, "-c", code], env, timeout_s
+    out, timed_out, rc = run_deadlined(
+        [sys.executable, "-c", code], env, timeout_s, capture_stderr=True
     )
-    if timed_out:
-        return "stalled", None
+    # Markers are matched with `in`, not startswith: the C++ plugin
+    # writes unbuffered chunks to the same merged pipe and can prepend a
+    # partial line to a marker.
+    stage, platform = None, None
     for line in (out or "").splitlines():
-        if line.startswith("EG_PROBE_OK"):
-            parts = line.split()
-            return "ok", parts[1] if len(parts) > 1 else None
-    return "crashed", None
+        if "EG_STAGE" in line:
+            parts = line[line.index("EG_STAGE"):].split()
+            stage = parts[1] if len(parts) > 1 else stage
+            if stage == "enum_ok" and len(parts) > 2:
+                platform = parts[2]
+        elif "EG_PROBE_OK" in line and not timed_out:
+            parts = line[line.index("EG_PROBE_OK"):].split()
+            return {"verdict": "ok", "stage": "probe_ok",
+                    "platform": parts[1] if len(parts) > 1 else None,
+                    "tail": None, "rc": rc}
+    verdict = "stalled" if timed_out else "crashed"
+    return {"verdict": verdict, "stage": stage, "platform": platform,
+            "tail": (out or "")[-1500:] or None, "rc": rc}
+
+
+def probe_device(env, timeout_s, require_tpu=False):
+    """(verdict, platform) compatibility wrapper over probe_device_diag
+    — bench.py's supervisor only needs the binary liveness answer. The
+    child's stderr is merged into the diag tail now, so on failure the
+    tail is re-emitted on this process's stderr to keep the probe's
+    diagnostics visible in the caller's own logs."""
+    d = probe_device_diag(env, timeout_s, require_tpu=require_tpu)
+    if d["verdict"] != "ok" and d.get("tail"):
+        print("[probe %s @%s] %s" % (d["verdict"], d.get("stage"),
+                                     d["tail"][-400:]),
+              file=sys.stderr)
+    return d["verdict"], d["platform"]
