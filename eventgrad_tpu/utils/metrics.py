@@ -40,3 +40,50 @@ def msgs_saved_pct(num_events: int, passes: int, n_tensors: int, n_neighbors: in
     (events counted per neighbor per tensor per pass, event.cpp:344,527-532)."""
     possible = n_neighbors * passes * n_tensors * n_ranks
     return 100.0 * (1.0 - num_events / possible) if possible else 0.0
+
+
+def collapse_verdict(
+    losses,
+    twin_loss: Optional[float] = None,
+    *,
+    factor: float = 2.0,
+    abs_floor: float = 0.5,
+    bounce: float = 1.25,
+    random_loss: float = 2.35,
+) -> bool:
+    """True when an event-triggered run has DIVERGED rather than trained —
+    the guard that keeps a collapsed run from ever presenting as a
+    messages-saved win (an aggressive horizon can trade accuracy for
+    silence: the measured cliff is horizon 1.05 + max-silence 50 at 360
+    passes -> 81.66% "saved" at 36.5% test accuracy,
+    artifacts/mnist_knee_r3_cpu.jsonl).
+
+    `losses` is the per-epoch train-loss history (a scalar is accepted
+    as a 1-entry history). Collapse is distinct from UNDERtraining: a
+    short smoke tier legitimately ends with high loss while still
+    descending, and must not be flagged. Three signals, any of which
+    flags:
+
+    - twin divergence: final loss > `factor`x the dense D-PSGD twin's
+      AND above `abs_floor` (the floor keeps both-converged pairs like
+      0.06-vs-0.02 from false-flagging; an undertrained pair shares its
+      high loss with its twin, so the ratio stays ~1)
+    - bounce: final loss > `bounce`x the history's minimum AND above
+      `abs_floor` — the cliff's signature (the run trains through
+      warmup, then climbs once the trigger silences the exchange); a
+      monotone still-descending run has min ~= final
+    - never trained: final loss at or above `random_loss` (10-class
+      random guessing is ln 10 ~= 2.303)."""
+    if hasattr(losses, "__iter__"):
+        hist = [float(x) for x in losses]  # list, array, or generator
+        if not hist:
+            raise ValueError("collapse_verdict: empty loss history")
+    else:
+        hist = [float(losses)]
+    final = hist[-1]
+    if final >= random_loss:
+        return True
+    if twin_loss is not None and final > max(factor * float(twin_loss),
+                                             abs_floor):
+        return True
+    return final > max(bounce * min(hist), abs_floor)

@@ -88,3 +88,35 @@ def test_digits_real_dataset_loader():
     # the load_or_synthesize dispatch ignores data_dir for digits
     x3, _ = load_or_synthesize("digits", "/nonexistent", "train")
     np.testing.assert_array_equal(x, x3)
+
+
+def test_collapse_verdict_knee_fixture():
+    """The measured stabilizer cliff must flag as collapsed (round-3
+    verdict item 7). Fixture: artifacts/mnist_knee_r3_cpu.jsonl's
+    horizon-1.05/silence-50/360-pass row finished at 36.5% test accuracy
+    — final 10-class cross-entropy ~1.8 vs a converged twin's ~0.1 —
+    while presenting 81.66% messages saved."""
+    from eventgrad_tpu.utils.metrics import collapse_verdict
+
+    # the cliff's trajectory shape: trains through warmup, then climbs
+    # once the trigger silences the exchange — with and without a twin
+    cliff = [2.3, 1.2, 0.9, 1.4, 1.8]
+    assert collapse_verdict(cliff, 0.1)
+    assert collapse_verdict(cliff)
+    # UNDERtrained is not collapsed: a short smoke tier ends high but
+    # still descending (the tiny tier's 64-pass MNIST leg measures 2.24)
+    assert not collapse_verdict([2.30, 2.29, 2.27, 2.25, 2.235])
+    assert not collapse_verdict([2.30, 2.28, 2.26], 2.25)
+    # ...but a run stuck AT random the whole way is flagged
+    assert collapse_verdict([2.38, 2.37, 2.36])
+    # two converged runs with a large RATIO are not a collapse
+    assert not collapse_verdict([1.0, 0.2, 0.06], 0.02)
+    # healthy op-points (every non-cliff knee row finishes well under 0.5)
+    assert not collapse_verdict([2.0, 0.8, 0.12])
+    assert not collapse_verdict([1.5, 0.5, 0.3], 0.2)
+    # boundary behavior: the abs floor gates both twin and bounce checks
+    assert not collapse_verdict([2.0, 0.4, 0.45], 0.01)
+    assert collapse_verdict([2.0, 0.4, 0.6], 0.01)
+    # scalar input is accepted as a 1-entry history (twin check only)
+    assert collapse_verdict(1.8, 0.1)
+    assert not collapse_verdict(0.3)

@@ -46,7 +46,8 @@ def _log(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
 
 
-def _run(cmd: list, timeout_s: float, tag: str, artifact=None) -> bool:
+def _run(cmd: list, timeout_s: float, tag: str, artifact=None,
+         env=None) -> bool:
     """Deadlined child. With `artifact`, success means exactly one thing:
     the artifact file was (re)published after the rung started. That both
     salvages a child that published and then wedged in device teardown
@@ -57,7 +58,8 @@ def _run(cmd: list, timeout_s: float, tag: str, artifact=None) -> bool:
     t0_wall = time.time()
     t0 = time.monotonic()
     out, timed_out, rc = run_deadlined(
-        cmd, dict(os.environ), timeout_s, cwd=REPO, capture_stderr=True
+        cmd, dict(env if env is not None else os.environ), timeout_s,
+        cwd=REPO, capture_stderr=True,
     )
     if artifact is not None:
         # the artifact IS the deliverable: a clean exit that didn't
@@ -82,14 +84,21 @@ def _run(cmd: list, timeout_s: float, tag: str, artifact=None) -> bool:
 _probe_fails = 0
 
 
-def _probe(timeout_s: float = 75.0) -> bool:
+def _probe(timeout_s: float = 75.0):
     """Diagnostic probe with scheduled resurrection variants (round-3
     verdict item 1): the baseline probe uses the inherited env; every
     4th consecutive failure retries with an explicit JAX_PLATFORMS=axon
     pin (rules out plugin-priority misresolution); every 12th runs a
     long-deadline probe (rules out a tunnel that is merely very slow
     rather than wedged). Each attempt logs the stage the child reached
-    and its stderr tail, so the wedge's failure mode is on record."""
+    and its stderr tail, so the wedge's failure mode is on record.
+
+    Returns the env dict the probe SUCCEEDED with (so the ladder runs
+    its rungs under the exact environment that just proved live — a
+    variant success must not launch workloads with the base env the
+    variant exists to work around), or None on failure. A long-deadline
+    success additionally marks the env EG_SLOW_TUNNEL=1 for any rung
+    that wants to stretch its own internal budgets."""
     global _probe_fails
     env, variant = dict(os.environ), "base"
     if _probe_fails and _probe_fails % 12 == 0:
@@ -106,7 +115,11 @@ def _probe(timeout_s: float = 75.0) -> bool:
         rec["tail"] = d["tail"][-600:]
     _log(rec)
     _probe_fails = 0 if ok else _probe_fails + 1
-    return ok
+    if not ok:
+        return None
+    if variant == "long_deadline":
+        env["EG_SLOW_TUNNEL"] = "1"
+    return env
 
 
 def _is_swept_table(path: str) -> bool:
@@ -164,26 +177,29 @@ def main() -> None:
         if have_quick and have_full and have_tune and have_kernels:
             _log({"event": "done"})
             return
-        if not _probe():
+        live_env = _probe()
+        if live_env is None:
             time.sleep(120)
             continue
-        # tunnel is live — climb the ladder, cheapest first. The full
-        # rung gets 2 tries before the kernels rung takes the window (a
+        # tunnel is live — climb the ladder, cheapest first, every rung
+        # under the exact env the probe succeeded with. The full rung
+        # gets 2 tries before the kernels rung takes the window (a
         # full run that can't finish must not starve the re-capture);
         # once kernels are in, leftover windows go back to the full rung.
         if not have_quick:
-            os.environ["EG_FLAGSHIP_TRACE"] = "0"  # cheapest artifact first
+            quick_env = dict(live_env, EG_FLAGSHIP_TRACE="0")  # cheapest first
             have_quick = _run(
                 [sys.executable, flagship, "8", "tpu_flagship_quick.json"],
                 900, "flagship_quick",
                 artifact=os.path.join(ART, "tpu_flagship_quick.json"),
+                env=quick_env,
             )
-            os.environ.pop("EG_FLAGSHIP_TRACE", None)
             continue  # re-probe before committing to a longer run
         if not have_full and (full_fails < 2 or (have_tune and have_kernels)):
             have_full = _run(
                 [sys.executable, flagship, "61"], 3600, "flagship_full",
                 artifact=os.path.join(ART, "tpu_flagship.json"),
+                env=live_env,
             )
             if not have_full:
                 full_fails += 1
@@ -197,6 +213,7 @@ def main() -> None:
                 1800, "flash_tune",
                 artifact=os.path.join(REPO, "eventgrad_tpu", "ops",
                                       "flash_tuning.json"),
+                env=live_env,
             )
             continue
         if not have_kernels:
@@ -210,7 +227,7 @@ def main() -> None:
             if _run(
                 [sys.executable, os.path.join(REPO, "bench_kernels.py"),
                  "--out", staged],
-                1800, "kernels",
+                1800, "kernels", env=live_env,
             ):
                 if _is_tpu_grid(staged):
                     os.replace(staged, os.path.join(REPO, "KERNELS_TPU.json"))
