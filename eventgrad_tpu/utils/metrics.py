@@ -73,7 +73,15 @@ def collapse_verdict(
       warmup, then climbs once the trigger silences the exchange); a
       monotone still-descending run has min ~= final
     - never trained: final loss at or above `random_loss` (10-class
-      random guessing is ln 10 ~= 2.303)."""
+      random guessing is ln 10 ~= 2.303), or non-finite (NaN/inf — the
+      hardest divergence mode must not slip through NaN's
+      compare-False semantics).
+
+    When a twin exists and the run TRACKS it (final within `factor`x),
+    the bounce signal is vetoed: a late-epoch noise bounce that the
+    dense twin shares is SGD noise, not collapse."""
+    import math
+
     if hasattr(losses, "__iter__"):
         hist = [float(x) for x in losses]  # list, array, or generator
         if not hist:
@@ -81,9 +89,8 @@ def collapse_verdict(
     else:
         hist = [float(losses)]
     final = hist[-1]
-    if final >= random_loss:
+    if not math.isfinite(final) or final >= random_loss:
         return True
-    if twin_loss is not None and final > max(factor * float(twin_loss),
-                                             abs_floor):
-        return True
+    if twin_loss is not None:
+        return final > max(factor * float(twin_loss), abs_floor)
     return final > max(bounce * min(hist), abs_floor)

@@ -120,3 +120,11 @@ def test_collapse_verdict_knee_fixture():
     # scalar input is accepted as a 1-entry history (twin check only)
     assert collapse_verdict(1.8, 0.1)
     assert not collapse_verdict(0.3)
+    # NaN/inf = the hardest divergence; compare-False semantics must not
+    # let it through any signal
+    assert collapse_verdict([0.5, float("nan")])
+    assert collapse_verdict([0.5, float("inf")], 0.1)
+    assert collapse_verdict(float("nan"), 0.1)
+    # twin agreement vetoes the bounce: a late noise bounce the dense
+    # twin shares is SGD noise, not collapse
+    assert not collapse_verdict([1.5, 0.78, 1.0], 0.95)

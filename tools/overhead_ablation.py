@@ -1,0 +1,255 @@
+"""Attribute the EventGraD-vs-D-PSGD wall overhead (round-3 verdict item 2).
+
+BENCH_r03 recorded wall_s_eventgrad/wall_s_dpsgd = 80.1/60.5 (1.32x) at the
+reduced tier — but wall_s wraps the whole train() call, jit compile
+included, so the ratio conflates one-time compile cost with per-step cost.
+This tool separates them at the same op-point (LeNetCifar, Ring(8), global
+batch 64, synthetic CIFAR prototypes), then microbenches each candidate
+component of the event step in isolation:
+
+  full steps   compile_s + steady-state step_ms for
+                 dpsgd            dense exchange, no trigger
+                 event_adaptive   the bench trigger (horizon 1.05 + guard)
+                 event_constant   constant threshold — drops the adaptive
+                                  slope/history machinery
+  micro (ms)   jit'd alone on the same shapes/topology:
+                 decide           the trigger state machine
+                                  (events.decide_and_update: per-leaf norms
+                                  + [L]-vector threshold update)
+                 exchange_dense   collectives.neighbor_vals (dpsgd's path)
+                 exchange_masked  collectives.masked_neighbor_vals
+                                  (mask + fire-bit ppermute + where-select)
+                 mix_sgd_tail     mix + optax SGD tail (shared)
+
+Derived: per-step overhead %, compile-time delta, and the projected wall
+attribution at the bench's 640-pass op-point. Reference point for scale:
+the reference's trigger is ~8 scalar norms/step (dmnist/event/event.cpp:
+316-343) — near-free; the TPU rebuild's should be too.
+
+Writes artifacts/overhead_ablation_r4_<platform>.json.
+
+Usage:
+  python tools/overhead_ablation.py [n_timed_steps]   micro attribution
+  python tools/overhead_ablation.py order <ed|de>     in-loop order twin:
+      runs the bench op-point's two train() legs in the given order
+      (ed = eventgrad first, the bench's order; de = dpsgd first) inside
+      THIS process and appends one JSON line per leg to
+      artifacts/overhead_order_r4_<platform>.jsonl. Run each order in a
+      fresh process: the experiment exists to expose what the FIRST
+      train() call of a process absorbs (jit/backend warmup) — the
+      round-3 bench's 1.32x wall ratio, measured with eventgrad always
+      first, turned out to be exactly that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+
+from eventgrad_tpu.data.datasets import load_or_synthesize  # noqa: E402
+from eventgrad_tpu.data.sharding import batched_epoch  # noqa: E402
+from eventgrad_tpu.models import LeNetCifar  # noqa: E402
+from eventgrad_tpu.parallel import collectives  # noqa: E402
+from eventgrad_tpu.parallel.events import (  # noqa: E402
+    EventConfig, decide_and_update,
+)
+from eventgrad_tpu.parallel.spmd import spmd  # noqa: E402
+from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
+from eventgrad_tpu.train.state import init_train_state  # noqa: E402
+from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
+from eventgrad_tpu.utils.profiling import timed_steps  # noqa: E402
+
+
+def _micro(fn, *args, iters: int = 30):
+    """(compile_s, steady ms/call) of jit'd fn on fixed args."""
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return compile_s, 1000 * (time.perf_counter() - t0) / iters
+
+
+def order_experiment(order: str) -> None:
+    """Time the reduced-tier train() twins in the given order, one JSON
+    line per leg (see module docstring)."""
+    import numpy as np
+
+    from eventgrad_tpu.train.loop import train
+
+    topo = Ring(8)
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    cfg = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+    common = dict(
+        epochs=40, batch_size=8, learning_rate=1e-2, momentum=0.9,
+        random_sampler=True, log_every_epoch=False,
+    )
+    d = jax.devices()[0]
+    out_path = os.path.join(
+        REPO, "artifacts", f"overhead_order_r4_{d.platform}.jsonl"
+    )
+    algos = ("eventgrad", "dpsgd") if order == "ed" else ("dpsgd", "eventgrad")
+    for pos, algo in enumerate(algos):
+        t0 = time.perf_counter()
+        _, hist = train(
+            LeNetCifar(), topo, x, y, algo=algo,
+            event_cfg=cfg if algo == "eventgrad" else None, **common,
+        )
+        wall = time.perf_counter() - t0
+        steady = hist[1:] or hist
+        rec = {
+            "order": order, "position": pos, "algo": algo,
+            "wall_s": round(wall, 2),
+            "epoch0_s": round(hist[0]["wall_s"], 2),
+            "steady_step_ms": round(1000 * float(
+                np.mean([h["wall_s"] / h["steps"] for h in steady])
+            ), 2),
+            "passes": common["epochs"] * hist[0]["steps"],
+            "platform": d.platform,
+            "captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "order":
+        order_experiment(sys.argv[2] if len(sys.argv) > 2 else "ed")
+        return
+    n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    topo = Ring(8)
+    model = LeNetCifar()
+    tx = optax.sgd(1e-2, momentum=0.9)
+    per_rank = 8  # global batch 64 over 8 ranks — the reduced-tier op-point
+
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    xb, yb = batched_epoch(x, y, topo.n_ranks, per_rank)
+    steps_avail = xb.shape[1]
+    batches = [
+        (jnp.asarray(xb[:, s % steps_avail]), jnp.asarray(yb[:, s % steps_avail]))
+        for s in range(n_steps)
+    ]
+
+    cfg_adapt = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+    cfg_const = EventConfig(adaptive=False, constant=0.05, warmup_passes=10)
+
+    full = {}
+    for name, algo, cfg in (
+        ("dpsgd", "dpsgd", None),
+        ("event_adaptive", "eventgrad", cfg_adapt),
+        ("event_constant", "eventgrad", cfg_const),
+    ):
+        state = init_train_state(model, x.shape[1:], tx, topo, algo, cfg)
+        step = jax.jit(
+            spmd(make_train_step(model, tx, topo, algo, event_cfg=cfg), topo)
+        )
+        out = timed_steps(step, state, batches, warmup=2)
+        out.pop("state")
+        full[name] = {k: round(v, 4) for k, v in out.items()}
+
+    # ---- micro benches on the same stacked shapes -----------------------
+    st = init_train_state(model, x.shape[1:], tx, topo, "eventgrad", cfg_adapt)
+    params, ev = st.params, st.event
+
+    decide = jax.jit(spmd(
+        lambda p, s: decide_and_update(
+            p, s, jnp.int32(100), cfg_adapt, topo.n_neighbors
+        ),
+        topo,
+    ))
+    ex_dense = jax.jit(spmd(
+        lambda p: collectives.neighbor_vals(p, topo), topo
+    ))
+    ex_masked = jax.jit(spmd(
+        lambda p, f, b: collectives.masked_neighbor_vals(p, f, b, topo)[0],
+        topo,
+    ))
+
+    def _tail(p, bufs, g, o):
+        mixed = collectives.mix(p, bufs, topo)
+        updates, o2 = tx.update(g, o, mixed)
+        return optax.apply_updates(mixed, updates), o2
+
+    tail = jax.jit(spmd(_tail, topo))
+
+    fire, ev2 = decide(params, ev)
+    jax.block_until_ready(fire)
+    grads = jax.tree.map(jnp.ones_like, params)
+
+    micro = {}
+    for name, fn, args in (
+        ("decide", decide, (params, ev)),
+        ("exchange_dense", ex_dense, (params,)),
+        ("exchange_masked", ex_masked, (params, fire, ev.bufs)),
+        ("mix_sgd_tail", tail, (params, ev.bufs, grads, st.opt_state)),
+    ):
+        compile_s, ms = _micro(fn, *args)
+        micro[name] = {"compile_s": round(compile_s, 4), "ms": round(ms, 4)}
+
+    dp, ea = full["dpsgd"], full["event_adaptive"]
+    passes = 640  # the reduced tier's captured op-point
+    step_delta_ms = ea["step_ms_mean"] - dp["step_ms_mean"]
+    compile_delta_s = ea["compile_s"] - dp["compile_s"]
+    derived = {
+        "step_overhead_pct": round(
+            100 * (ea["step_ms_mean"] / dp["step_ms_mean"] - 1), 2
+        ),
+        "compile_delta_s": round(compile_delta_s, 2),
+        "projected_wall_delta_s_at_640_passes": round(
+            compile_delta_s + passes * step_delta_ms / 1000, 2
+        ),
+        "micro_trigger_share_of_step_pct": round(
+            100 * micro["decide"]["ms"] / ea["step_ms_mean"], 2
+        ),
+        "micro_masked_minus_dense_ms": round(
+            micro["exchange_masked"]["ms"] - micro["exchange_dense"]["ms"], 4
+        ),
+    }
+
+    d = jax.devices()[0]
+    rec = {
+        "op_point": {
+            "model": "LeNetCifar", "topology": "ring8",
+            "global_batch": topo.n_ranks * per_rank,
+            "n_timed_steps": n_steps,
+            "trigger": {"horizon": 1.05, "max_silence": 50, "warmup": 10},
+        },
+        "full_steps": full,
+        "micro": micro,
+        "derived": derived,
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    out_path = os.path.join(
+        REPO, "artifacts", f"overhead_ablation_r4_{d.platform}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

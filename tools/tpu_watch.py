@@ -54,7 +54,11 @@ def _run(cmd: list, timeout_s: float, tag: str, artifact=None,
     (bench.py's supervisor applies the same rule to its metric line) and
     rejects a clean exit that silently skipped the write (e.g. a CPU
     fallback between probe and child init). Without `artifact`, success =
-    clean exit 0 within the deadline."""
+    clean exit 0 within the deadline. A tunnel that only answered the
+    long-deadline probe (EG_SLOW_TUNNEL in env) gets doubled rung
+    deadlines — proven-slow must not be held to healthy-tunnel budgets."""
+    if (env or os.environ).get("EG_SLOW_TUNNEL"):
+        timeout_s *= 2
     t0_wall = time.time()
     t0 = time.monotonic()
     out, timed_out, rc = run_deadlined(
