@@ -128,10 +128,15 @@ def main() -> None:
     # tiny: smoke-runs the full code path in seconds (CI).
     downshifted = False
     if tier == "full":
+        from eventgrad_tpu.parallel.events import (
+            MNIST_FULLSCALE_OP_POINT, resolve_bench_trigger_mnist,
+        )
+
         global_batch, n_train, n_test, epochs = 256, 16384, 2048, 61
         model = ResNet18(dtype=jnp.bfloat16)
         warmup = 30
-        mnist_n, mnist_epochs, mnist_batch = 8192, 73, 64
+        mnist_n, mnist_epochs, mnist_batch = MNIST_FULLSCALE_OP_POINT
+        rehearsal = os.environ.get("EG_BENCH_FULL_REHEARSAL") == "1"
         # the supervisor exports the wall budget this child actually got
         # (EG_BENCH_ATTEMPT_S). The 61-epoch reference scale (3904
         # passes x 2 CIFAR legs + 1168 MNIST passes + up to 4 TPU
@@ -141,7 +146,7 @@ def main() -> None:
         # risk the deadline. An UNSET var means no deadline (direct
         # child run): full scale.
         att = os.environ.get("EG_BENCH_ATTEMPT_S")
-        if att is not None and float(att) < 420:
+        if att is not None and float(att) < 420 and not rehearsal:
             # downshift the ResNet legs only: the MNIST CNN-2 leg is
             # seconds on-chip and 1168 passes IS the ~70% claim's
             # op-point (mnist_vs_baseline >= 1.0 rides on it)
@@ -161,7 +166,24 @@ def main() -> None:
         # leg drops back to the neutral horizon rather than run the
         # known-unstable 1.05-unguarded combination
         mnist_silence = max_silence
-        mnist_horizon_default = 1.05 if mnist_silence > 0 else 1.0
+        # one definition with tools/tpu_flagship.py (events.py helper);
+        # the generic EG_BENCH_HORIZON_MNIST read below re-applies the
+        # same env override idempotently
+        mnist_horizon_default = resolve_bench_trigger_mnist(
+            os.environ, mnist_silence
+        )
+        if rehearsal:
+            # off-chip rehearsal of the full-tier code path (round-3
+            # verdict item 4: the 61-epoch tier had never executed
+            # end-to-end before its first live TPU window). Identical
+            # branches, model (ResNet18 bf16), warmup, and trigger
+            # resolution — only the scale is miniature, because the real
+            # ResNet runs ~1 pass/min under XLA-CPU. The emitted JSON
+            # carries config "full-rehearsal" so the run can never pass
+            # for a real full-tier measurement.
+            n_train, n_test, epochs = 256, 64, 2
+            mnist_n, mnist_epochs, mnist_batch = 512, 2, 16
+            tier = "full-rehearsal"
     elif tier == "reduced":
         # CPU fallback: the reference's own LeNet-5 CIFAR model (M5,
         # dcifar10/common/nnet.hpp:3-33) instead of a gutted ResNet — it

@@ -130,13 +130,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["sim", "mesh"], default="sim",
                    help="sim = vmap all ranks onto one chip; mesh = one rank per device")
     p.add_argument("--dataset",
-                   choices=["mnist", "cifar10", "digits", "synthetic",
-                            "synthetic-lm", "synthetic-imagenet"],
+                   choices=["mnist", "cifar10", "digits", "digits32",
+                            "synthetic", "synthetic-lm",
+                            "synthetic-imagenet"],
                    default=None,
                    help="default: mnist for image models, synthetic-lm for "
                         "transformers; digits = real handwritten scans "
                         "bundled with scikit-learn (no --data-dir or "
-                        "network needed, MNIST geometry); "
+                        "network needed, MNIST geometry; digits32 = the "
+                        "same real scans at the 32x32x3 CIFAR geometry); "
                         "synthetic-imagenet is the ImageNet-shaped "
                         "scale-stress stand-in "
                         "(--image-size/--num-classes)")

@@ -211,18 +211,32 @@ def synthetic_dataset(
     return x, y
 
 
-def load_digits(split: str, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+def load_digits(
+    split: str, seed: int = 0, geometry: str = "mnist"
+) -> Tuple[np.ndarray, np.ndarray]:
     """Real handwritten-digit scans bundled with scikit-learn (UCI digits:
     1,797 genuine 8x8 grayscale images, 10 classes) — the one real image
-    dataset available without network egress. Upsampled 8x8 -> 32x32
-    (nearest) and center-cropped to the 28x28 MNIST geometry so the MNIST
-    models apply unchanged. Deterministic shuffle; 357 test / 1440 train.
+    dataset available without network egress. Deterministic shuffle;
+    357 test / 1440 train.
+
+    geometry="mnist": upsampled 8x8 -> 32x32 (nearest) and center-cropped
+    to 28x28x1 so the MNIST models apply unchanged.
+    geometry="cifar32": the full 32x32 upsample replicated to 3 channels —
+    real pixels at CIFAR shapes, so the E4/E5 CIFAR path (BN,
+    augmentation, 3-channel statistics — dcifar10/common/custom.hpp:26-122
+    is the unreachable real counterpart) gets non-synthetic evidence.
     """
     from sklearn.datasets import load_digits as _sk_digits
 
     d = _sk_digits()
     imgs = d.images.astype(np.float32) / 16.0
-    big = np.kron(imgs, np.ones((4, 4), np.float32))[:, 2:30, 2:30, None]
+    big = np.kron(imgs, np.ones((4, 4), np.float32))
+    if geometry == "cifar32":
+        big = np.repeat(big[:, :, :, None], 3, axis=3)
+    elif geometry == "mnist":
+        big = big[:, 2:30, 2:30, None]
+    else:
+        raise ValueError(f"unknown digits geometry {geometry!r}")
     labels = d.target.astype(np.int32)
     order = np.random.default_rng(seed).permutation(len(labels))
     big, labels = big[order], labels[order]
@@ -237,11 +251,14 @@ def load_or_synthesize(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Try real data, fall back to the synthetic stand-in of matching shape.
 
-    "digits" is always real (bundled with scikit-learn, no data_dir
-    needed); "mnist"/"cifar10" read real bytes from data_dir when present.
+    "digits" (MNIST geometry) and "digits32" (CIFAR geometry) are always
+    real (bundled with scikit-learn, no data_dir needed); "mnist"/
+    "cifar10" read real bytes from data_dir when present.
     """
     if dataset == "digits":
         return load_digits(split, seed=seed)
+    if dataset == "digits32":
+        return load_digits(split, seed=seed, geometry="cifar32")
     shape = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
     if data_dir:
         try:

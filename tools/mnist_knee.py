@@ -61,17 +61,38 @@ def main() -> None:
     if quick:
         grid = grid[:2]
     warmup = 10
-    if len(sys.argv) > 1 and sys.argv[1] == "fullscale":
+    if len(sys.argv) > 1 and sys.argv[1] == "r4":
+        # round-4 phase: candidate op-points for the budget-adaptive
+        # reduced-tier MNIST leg (verdict r3 item 3). Targets: >= 70%
+        # saved at accuracy within ~2pp of the ref-pure plateau (97-98),
+        # at pass counts a ~300-500 s attempt can afford. Known anchors:
+        # 1.02+50 @544p/4096 = 69.96 at 97.4 (350 s); 1.02+50 @360p =
+        # 68.69 at 94.2; 1.03+50 @360p = 74.96 at 83.4 (too lossy).
+        out_path = os.path.join(repo, "artifacts", "mnist_knee_r4_cpu.jsonl")
+        # 5th element (optional) overrides the warmup: the full-scale
+        # trail suggests the reference's 30-pass warmup bootstraps the
+        # adaptive thresholds better than the short tiers' 10
+        grid = [
+            (4096, 68, 1.025, 50),      # 544p, between the 1.02 near-miss
+            (4096, 68, 1.03, 50),       # 544p, does more data tame 1.03?
+            (2048, 95, 1.025, 50),      # 380p, the mid-budget candidate
+            (4096, 68, 1.02, 25),       # 544p, tighter guard
+            (4096, 70, 1.02, 50),       # 560p, ride the 1.02 trend over 70
+            (4096, 68, 1.02, 50, 30),   # 544p near-miss with ref warmup 30
+        ]
+    elif len(sys.argv) > 1 and sys.argv[1] == "fullscale":
         # r3 confirmation of the claim-level op-point mnist_proven cites
         # (r2: 75.5% at -1.17pp over 1168 passes, warmup 30)
         grid = [(8192, 73, 1.05, 50), (8192, 73, 1.0, 0)]
         warmup = 30
 
     xt, yt = load_or_synthesize("mnist", None, "test", n_synth=1024)
-    for n_train, epochs, horizon, silence in grid:
+    for row in grid:
+        n_train, epochs, horizon, silence = row[:4]
+        row_warmup = row[4] if len(row) > 4 else warmup
         x, y = load_or_synthesize("mnist", None, "train", n_synth=n_train)
         cfg = EventConfig(adaptive=True, horizon=horizon,
-                          warmup_passes=warmup, max_silence=silence)
+                          warmup_passes=row_warmup, max_silence=silence)
         t0 = time.perf_counter()
         state, hist = train(
             CNN2(), topo, x, y, algo="eventgrad", event_cfg=cfg,
@@ -85,7 +106,7 @@ def main() -> None:
         rec = {
             "n_train": n_train, "epochs": epochs,
             "passes": epochs * (n_train // (64 * topo.n_ranks)),
-            "horizon": horizon, "max_silence": silence, "warmup": warmup,
+            "horizon": horizon, "max_silence": silence, "warmup": row_warmup,
             "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
             "test_acc": round(acc, 2),
             "wall_s": round(wall, 1),

@@ -169,18 +169,79 @@ def main() -> None:
         out["test_acc_eventgrad"] - out["test_acc_dpsgd"], 2
     )
 
+    # collapse guard (same rule as bench.py): a diverged leg must not
+    # present as a savings win
+    from eventgrad_tpu.utils.metrics import collapse_verdict
+
+    out["collapsed_cifar"] = collapse_verdict(
+        [h["loss"] for h in hist], hist_d[-1]["loss"]
+    )
+
     out_name = sys.argv[2] if len(sys.argv) > 2 else "tpu_flagship.json"
     if out["platform"] != "tpu":
         # a non-chip run (smoke/ALLOW_CPU, any argv) must never write the
         # artifact names bench.py embeds and the watcher's rungs gate on
         out_name = "tpu_flagship_smoke.json"
     path = os.path.join(art, out_name)
-    # atomic publish: bench.py may read this file concurrently (it embeds
-    # the artifact as tpu_flagship_cached); never let it see a half-write
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(out, f, indent=1)
-    os.replace(tmp, path)
+
+    def publish() -> None:
+        # atomic publish: bench.py may read this file concurrently (it
+        # embeds the artifact as tpu_flagship_cached); never let it see a
+        # half-write
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, path)
+
+    # the ResNet legs are the expensive, hard-won part — publish them NOW
+    # so a tunnel wedge inside the added MNIST leg below cannot discard
+    # the whole window (the watcher's artifact-gated rung accepts this
+    # partial publish; the MNIST leg then republishes additively)
+    publish()
+
+    # MNIST claim leg, live on the same window: the ~70% headline's exact
+    # full-scale op-point (events.MNIST_FULLSCALE_OP_POINT — CNN-2,
+    # batch 64/rank, lr 0.05, sequential sampler, 1168 passes,
+    # dmnist/event/event.cpp:103,145,227,255). On-chip this leg is cheap
+    # next to the ResNet legs, and it is the number
+    # mnist_vs_baseline >= 1.0 rides on (round-3 verdict item 3).
+    from eventgrad_tpu.models import CNN2
+    from eventgrad_tpu.parallel.events import (
+        MNIST_FULLSCALE_OP_POINT, resolve_bench_trigger_mnist,
+    )
+
+    mnist_n, mnist_epochs, mnist_batch = MNIST_FULLSCALE_OP_POINT
+    if smoke:
+        mnist_n, mnist_epochs, mnist_batch = 512, 4, 16
+    mnist_horizon = resolve_bench_trigger_mnist(os.environ, max_silence)
+    mnist_cfg = EventConfig(
+        adaptive=True, horizon=mnist_horizon, warmup_passes=30,
+        max_silence=max_silence,
+    )
+    xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)
+    t0 = time.perf_counter()
+    _, hist_m = train(
+        CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=mnist_cfg,
+        epochs=mnist_epochs, batch_size=mnist_batch, learning_rate=0.05,
+        random_sampler=False, log_every_epoch=False,
+    )
+    out["wall_s_mnist"] = round(time.perf_counter() - t0, 1)
+    out["mnist_msgs_saved"] = round(hist_m[-1]["msgs_saved_pct"], 2)
+    out["mnist_passes"] = mnist_epochs * (
+        mnist_n // (mnist_batch * topo.n_ranks)
+    )
+    out["mnist_horizon"] = mnist_horizon
+    out["collapsed_mnist"] = collapse_verdict([h["loss"] for h in hist_m])
+    out["mnist_vs_baseline"] = (
+        0.0 if out["collapsed_mnist"]
+        else round(out["mnist_msgs_saved"] / 70.0, 4)
+    )
+    steady_m = hist_m[1:] or hist_m
+    out["step_ms_mnist"] = round(1000 * float(
+        np.mean([h["wall_s"] / h["steps"] for h in steady_m])
+    ), 3)
+
+    publish()
     print(json.dumps(out))
 
 

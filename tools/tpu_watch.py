@@ -46,6 +46,11 @@ def _log(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
 
 
+#: wall deadline of the whole watch (set by main); rung timeouts clamp to
+#: it so no child can hold the window hours past the session's end
+_deadline = None
+
+
 def _run(cmd: list, timeout_s: float, tag: str, artifact=None,
          env=None) -> bool:
     """Deadlined child. With `artifact`, success means exactly one thing:
@@ -59,6 +64,10 @@ def _run(cmd: list, timeout_s: float, tag: str, artifact=None,
     deadlines — proven-slow must not be held to healthy-tunnel budgets."""
     if (env or os.environ).get("EG_SLOW_TUNNEL"):
         timeout_s *= 2
+    if _deadline is not None:
+        # never past the watch window itself (+60s grace so a rung
+        # started just before the deadline still gets a token chance)
+        timeout_s = min(timeout_s, max(60.0, _deadline - time.monotonic()))
     t0_wall = time.time()
     t0 = time.monotonic()
     out, timed_out, rc = run_deadlined(
@@ -151,9 +160,10 @@ def _is_tpu_grid(path: str) -> bool:
 
 
 def main() -> None:
+    global _deadline
     os.makedirs(ART, exist_ok=True)
     max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
-    deadline = time.monotonic() + max_hours * 3600
+    deadline = _deadline = time.monotonic() + max_hours * 3600
     # a committed full artifact supersedes the quick rung entirely — never
     # spend a live window (or risk any overwrite) re-earning a lesser one.
     # Only chip-captured artifacts count (platform == "tpu"): a stray
