@@ -31,7 +31,7 @@ sequential sampler — reference claim ~70% messages saved) rides along as
 Env contract (single source of truth, mirrored in REPRO.md):
   EG_BENCH_TIER       full | reduced | tiny | auto   (default auto:
                       full when the probed backend is TPU, reduced on CPU)
-  EG_BENCH_DEADLINE_S per-attempt child wall budget (default 480)
+  EG_BENCH_DEADLINE_S per-attempt child wall budget (default 600)
   EG_BENCH_TOTAL_S    whole-bench wall budget across probes + both
                       attempts (default 560) — sized for a ~10 min
                       driver window. An accelerator attempt 1 reserves
@@ -86,6 +86,7 @@ def _tier() -> str:
 
 
 def main() -> None:
+    t_main = time.perf_counter()
     import jax.numpy as jnp
 
     from eventgrad_tpu.utils import compile_cache
@@ -177,12 +178,18 @@ def main() -> None:
             # verdict item 4: the 61-epoch tier had never executed
             # end-to-end before its first live TPU window). Identical
             # branches, model (ResNet18 bf16), warmup, and trigger
-            # resolution — only the scale is miniature, because the real
-            # ResNet runs ~1 pass/min under XLA-CPU. The emitted JSON
-            # carries config "full-rehearsal" so the run can never pass
-            # for a real full-tier measurement.
-            n_train, n_test, epochs = 256, 64, 2
-            mnist_n, mnist_epochs, mnist_batch = 512, 2, 16
+            # resolution — only the scale is miniature, because XLA-CPU
+            # runs the bf16 ResNet via emulation (a 256-global-batch
+            # 2-epoch rehearsal blew an 83-minute deadline; 64/128 is
+            # the measured-feasible size). The emitted JSON carries
+            # config "full-rehearsal" so the run can never pass for a
+            # real full-tier measurement.
+            global_batch, n_train, n_test, epochs = 64, 128, 32, 2
+            mnist_n, mnist_epochs, mnist_batch = 256, 2, 16
+            # scale warmup with the miniature: at 4 passes a 30-pass
+            # warmup would force-fire every pass and the post-warmup
+            # trigger path — the thing worth rehearsing — would never run
+            warmup = 2
             tier = "full-rehearsal"
     elif tier == "reduced":
         # CPU fallback: the reference's own LeNet-5 CIFAR model (M5,
@@ -202,7 +209,9 @@ def main() -> None:
         # even with the silence guard (measured knee,
         # artifacts/mnist_knee_r3_cpu.jsonl: 81.7% saved at 36.5% acc) —
         # reference-pure trigger here; the claim-level op-points ride in
-        # mnist_proven and the full tier measures 1168 passes live
+        # mnist_proven and the full tier measures 1168 passes live.
+        # When the attempt budget affords it, the leg upgrades itself to
+        # a measured honest op-point (the budget-adaptive ladder below).
         mnist_horizon_default, mnist_silence = 1.0, 0
     else:  # tiny: ~2 min on one CPU core — the late-fallback budget tier
         global_batch, n_train, n_test, epochs = 64, 512, 128, 6  # 48 passes
@@ -244,7 +253,36 @@ def main() -> None:
     test_d = evaluate(model, cons_d, stats_d, xt, yt)
 
     # secondary op-point: MNIST CNN-2, batch 64/rank, lr 0.05, sequential
-    # sampler (event.cpp:103,145,227,255) — reference ~70%
+    # sampler (event.cpp:103,145,227,255) — reference ~70%.
+    # Budget-adaptive ladder (reduced tier): the 160-pass reference-pure
+    # miniature is the floor that always fits; when the remaining attempt
+    # budget affords a measured honest op-point, the leg upgrades itself
+    # (mnist_knee_r4_cpu.jsonl, all at warmup 10 on one core):
+    #   544 passes, 1.025+guard50, 4096 samples: 71.09% saved at 97.7%
+    #     test acc, ~341 s  -> the >= 1.0 vs-baseline rung
+    #   380 passes, 1.025+guard50, 2048 samples: 69.71% at 94.8%, ~237 s
+    # A direct child run with no EG_BENCH_ATTEMPT_S (= no deadline)
+    # takes the top rung.
+    if tier == "reduced":
+        att_env = os.environ.get("EG_BENCH_ATTEMPT_S")
+        remaining = (
+            float(att_env) - (time.perf_counter() - t_main)
+            if att_env else float("inf")
+        )
+        # an explicit reference-pure request (EG_BENCH_MAX_SILENCE=0)
+        # keeps the trigger pure on the upgraded rungs too — only the
+        # pass budget grows (544 passes reference-pure measured 66.08%,
+        # mnist_knee_r3_cpu.jsonl); the stabilized 1.025+guard rungs are
+        # the default path only
+        refpure_req = int(os.environ.get("EG_BENCH_MAX_SILENCE", "50")) == 0
+        if remaining >= 390:
+            mnist_n, mnist_epochs = 4096, 68  # 544 passes
+            if not refpure_req:
+                mnist_horizon_default, mnist_silence = 1.025, 50
+        elif remaining >= 285:
+            mnist_n, mnist_epochs = 2048, 95  # 380 passes
+            if not refpure_req:
+                mnist_horizon_default, mnist_silence = 1.025, 50
     xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)
     horizon_mnist = float(
         os.environ.get("EG_BENCH_HORIZON_MNIST", str(mnist_horizon_default))
@@ -379,6 +417,14 @@ def main() -> None:
                 "passes": 1168, "n_train": 8192, "warmup": 30,
                 "artifact": "artifacts/mnist_knee_r3_cpu.jsonl",
             },
+            # the reduced-tier ladder's top rung, measured (round 4) —
+            # what this very leg runs live when the budget affords it
+            "reduced_ladder_top": {
+                "msgs_saved_pct": 71.09, "test_acc": 97.7,
+                "passes": 544, "n_train": 4096, "warmup": 10,
+                "horizon": 1.025, "max_silence": 50,
+                "artifact": "artifacts/mnist_knee_r4_cpu.jsonl",
+            },
         }
 
     def _trigger_kind(h: float, silence: int) -> str:
@@ -484,7 +530,11 @@ def _supervised() -> None:
     line is emitted so the harness always gets its line."""
     import sys
 
-    deadline = float(os.environ.get("EG_BENCH_DEADLINE_S", "480"))
+    # 600: large enough that a generous EG_BENCH_TOTAL_S window can fund
+    # the reduced tier's top MNIST ladder rung (~390 s remaining needed
+    # at the leg) in one attempt; under the default 560 s total the
+    # reservation math bounds attempts well below this anyway
+    deadline = float(os.environ.get("EG_BENCH_DEADLINE_S", "600"))
     probe_s = float(os.environ.get("EG_BENCH_PROBE_S", "60"))
     total_s = float(os.environ.get("EG_BENCH_TOTAL_S", "560"))
     #: wall budget a late tiny-tier fallback attempt needs (~2 min run

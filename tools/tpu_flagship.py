@@ -211,11 +211,15 @@ def main() -> None:
     )
 
     mnist_n, mnist_epochs, mnist_batch = MNIST_FULLSCALE_OP_POINT
+    mnist_warmup = 30
     if smoke:
+        # warmup scales with the miniature so the smoke exercises the
+        # post-warmup trigger math, not just warmup-forced fires
         mnist_n, mnist_epochs, mnist_batch = 512, 4, 16
+        mnist_warmup = 2
     mnist_horizon = resolve_bench_trigger_mnist(os.environ, max_silence)
     mnist_cfg = EventConfig(
-        adaptive=True, horizon=mnist_horizon, warmup_passes=30,
+        adaptive=True, horizon=mnist_horizon, warmup_passes=mnist_warmup,
         max_silence=max_silence,
     )
     xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)
