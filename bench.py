@@ -177,8 +177,8 @@ def main() -> None:
             # off-chip rehearsal of the full-tier code path (round-3
             # verdict item 4: the 61-epoch tier had never executed
             # end-to-end before its first live TPU window). Identical
-            # branches, model (ResNet18 bf16), warmup, and trigger
-            # resolution — only the scale is miniature, because XLA-CPU
+            # branches, model (ResNet18 bf16), and trigger resolution —
+            # the scale (and, below, the warmup) is miniature, because XLA-CPU
             # runs the bf16 ResNet via emulation (a 256-global-batch
             # 2-epoch rehearsal blew an 83-minute deadline; 64/128 is
             # the measured-feasible size). The emitted JSON carries
@@ -256,33 +256,26 @@ def main() -> None:
     # sampler (event.cpp:103,145,227,255) — reference ~70%.
     # Budget-adaptive ladder (reduced tier): the 160-pass reference-pure
     # miniature is the floor that always fits; when the remaining attempt
-    # budget affords a measured honest op-point, the leg upgrades itself
-    # (mnist_knee_r4_cpu.jsonl, all at warmup 10 on one core):
-    #   544 passes, 1.025+guard50, 4096 samples: 71.09% saved at 97.7%
-    #     test acc, ~341 s  -> the >= 1.0 vs-baseline rung
-    #   380 passes, 1.025+guard50, 2048 samples: 69.71% at 94.8%, ~237 s
+    # budget affords a measured honest op-point the leg upgrades itself —
+    # rung table and measured numbers live in events.pick_mnist_rung.
     # A direct child run with no EG_BENCH_ATTEMPT_S (= no deadline)
     # takes the top rung.
     if tier == "reduced":
+        from eventgrad_tpu.parallel.events import pick_mnist_rung
+
         att_env = os.environ.get("EG_BENCH_ATTEMPT_S")
+        # the supervisor's kill clock starts at child SPAWN, t_main at
+        # main() entry — allow ~15 s for interpreter + jax import so the
+        # rung pick never overshoots the real deadline
         remaining = (
-            float(att_env) - (time.perf_counter() - t_main)
+            float(att_env) - (time.perf_counter() - t_main) - 15.0
             if att_env else float("inf")
         )
-        # an explicit reference-pure request (EG_BENCH_MAX_SILENCE=0)
-        # keeps the trigger pure on the upgraded rungs too — only the
-        # pass budget grows (544 passes reference-pure measured 66.08%,
-        # mnist_knee_r3_cpu.jsonl); the stabilized 1.025+guard rungs are
-        # the default path only
-        refpure_req = int(os.environ.get("EG_BENCH_MAX_SILENCE", "50")) == 0
-        if remaining >= 390:
-            mnist_n, mnist_epochs = 4096, 68  # 544 passes
-            if not refpure_req:
-                mnist_horizon_default, mnist_silence = 1.025, 50
-        elif remaining >= 285:
-            mnist_n, mnist_epochs = 2048, 95  # 380 passes
-            if not refpure_req:
-                mnist_horizon_default, mnist_silence = 1.025, 50
+        # refpure = the already-resolved trigger config (one definition,
+        # resolve_bench_trigger above), not a re-parse of the env
+        rung = pick_mnist_rung(remaining, refpure=max_silence == 0)
+        if rung is not None:
+            mnist_n, mnist_epochs, mnist_horizon_default, mnist_silence = rung
     xm, ym = load_or_synthesize("mnist", None, "train", n_synth=mnist_n)
     horizon_mnist = float(
         os.environ.get("EG_BENCH_HORIZON_MNIST", str(mnist_horizon_default))

@@ -90,6 +90,26 @@ def resolve_bench_trigger(environ) -> tuple:
 MNIST_FULLSCALE_OP_POINT = (8192, 73, 64)
 
 
+def pick_mnist_rung(remaining_s: float, refpure: bool) -> tuple:
+    """Reduced-tier MNIST ladder (round-4): pick the best measured rung
+    the remaining attempt budget affords. Returns (n_train, epochs,
+    horizon, max_silence) or None to keep the tier's 160-pass floor.
+
+    Rungs (artifacts/mnist_knee_r4_cpu.jsonl, warmup 10, one core):
+      544 passes, 1.025+guard50, 4096 samples: 71.09% saved at 97.7%
+        test acc, ~341 s — the >= 1.0 vs-baseline rung
+      380 passes, 1.025+guard50, 2048 samples: 69.71% at 94.8%, ~237 s
+    With `refpure` (an explicit EG_BENCH_MAX_SILENCE=0 request) only the
+    pass budget upgrades — the trigger stays the paper's
+    (544 passes reference-pure measured 66.08%, mnist_knee_r3_cpu.jsonl).
+    """
+    if remaining_s >= 390:
+        return (4096, 68) + ((1.0, 0) if refpure else (1.025, 50))
+    if remaining_s >= 285:
+        return (2048, 95) + ((1.0, 0) if refpure else (1.025, 50))
+    return None
+
+
 def resolve_bench_trigger_mnist(environ, max_silence: int) -> float:
     """Full-tier MNIST-leg horizon — the same one-definition rule as
     resolve_bench_trigger. Stabilized 1.05 (proven 75.5% saved at

@@ -129,3 +129,20 @@ def test_max_silence_zero_is_reference_behavior():
                                    topo.n_neighbors)
         assert bool(f0["w"]) == bool(fs["w"])
     np.testing.assert_allclose(s0.thres[0], ss.thres[0])
+
+
+def test_pick_mnist_rung_ladder():
+    """Budget-adaptive reduced-tier MNIST ladder (round-4): rung choice
+    is a pure function of remaining budget + the reference-pure flag."""
+    from eventgrad_tpu.parallel.events import pick_mnist_rung
+
+    # generous budget: the >= 1.0 vs-baseline rung, stabilized trigger
+    assert pick_mnist_rung(float("inf"), refpure=False) == (4096, 68, 1.025, 50)
+    assert pick_mnist_rung(400.0, refpure=False) == (4096, 68, 1.025, 50)
+    # mid budget: the 380-pass rung
+    assert pick_mnist_rung(300.0, refpure=False) == (2048, 95, 1.025, 50)
+    # tight budget: keep the tier's 160-pass floor
+    assert pick_mnist_rung(200.0, refpure=False) is None
+    # reference-pure request: pass budget upgrades, trigger stays pure
+    assert pick_mnist_rung(400.0, refpure=True) == (4096, 68, 1.0, 0)
+    assert pick_mnist_rung(300.0, refpure=True) == (2048, 95, 1.0, 0)

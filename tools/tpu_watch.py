@@ -187,7 +187,7 @@ def main() -> None:
         os.path.join(ART, "tpu_flagship.json"), required=_FULL_KEYS
     )
     have_quick = have_full or _is_tpu_artifact(
-        os.path.join(ART, "tpu_flagship_quick.json")
+        os.path.join(ART, "tpu_flagship_quick.json"), required=_FULL_KEYS
     )
     have_kernels = False  # always re-capture once: round-2 grid had <1x configs
     have_tune = _is_swept_table(
@@ -212,11 +212,17 @@ def main() -> None:
         # once kernels are in, leftover windows go back to the full rung.
         if not have_quick:
             quick_env = dict(live_env, EG_FLAGSHIP_TRACE="0")  # cheapest first
-            have_quick = _run(
+            ran = _run(
                 [sys.executable, flagship, "8", "tpu_flagship_quick.json"],
                 900, "flagship_quick",
                 artifact=os.path.join(ART, "tpu_flagship_quick.json"),
                 env=quick_env,
+            )
+            # same completeness latch as the full rung: a partial
+            # (pre-MNIST) publish is kept as evidence, rung stays open
+            have_quick = ran and _is_tpu_artifact(
+                os.path.join(ART, "tpu_flagship_quick.json"),
+                required=_FULL_KEYS,
             )
             continue  # re-probe before committing to a longer run
         if not have_full and (full_fails < 2 or (have_tune and have_kernels)):
