@@ -96,6 +96,26 @@ def _run(cmd: list, timeout_s: float, tag: str, artifact=None,
 
 _probe_fails = 0
 
+#: the axon client claims a chip via the loopback orchestrator relay
+#: (AXON_POOL_SVC_OVERRIDE=127.0.0.1; the plugin dials
+#: http://127.0.0.1:10000 and retries /v1/claim forever). A refused
+#: connect here means the relay process is absent — the wedge is
+#: infrastructure-side and no client-side variant can fix it; an open
+#: port is the earliest possible signal that a live window is starting.
+_RELAY_ADDR = ("127.0.0.1", 10000)
+
+
+def _relay_tcp() -> str:
+    import socket
+
+    try:
+        with socket.create_connection(_RELAY_ADDR, timeout=2.0):
+            return "open"
+    except ConnectionRefusedError:
+        return "refused"
+    except OSError as e:
+        return type(e).__name__
+
 
 def _probe(timeout_s: float = 75.0):
     """Diagnostic probe with scheduled resurrection variants (round-3
@@ -119,11 +139,23 @@ def _probe(timeout_s: float = 75.0):
     elif _probe_fails and _probe_fails % 4 == 0:
         variant = "axon_pin"
         env["JAX_PLATFORMS"] = "axon"
+    relay = _relay_tcp()
+    if relay != "open" and variant == "base":
+        # relay absent -> the jit probe WILL wedge in the claim retry
+        # loop; log the cheap TCP diagnosis and skip the 75 s child.
+        # Variant probes (every 4th/12th failure) still run the real
+        # child as ground truth in case the relay-port inference is
+        # ever wrong — the skip can economize, never blind.
+        rec = {"event": "probe", "ok": False, "verdict": "relay_down",
+               "relay_tcp": relay, "variant": variant}
+        _log(rec)
+        _probe_fails += 1
+        return None
     d = probe_device_diag(env, timeout_s, require_tpu=True)
     ok = d["verdict"] == "ok"
     rec = {"event": "probe", "ok": ok, "verdict": d["verdict"],
            "platform": d["platform"], "stage": d["stage"],
-           "variant": variant}
+           "variant": variant, "relay_tcp": relay}
     if d.get("tail"):
         rec["tail"] = d["tail"][-600:]
     _log(rec)
