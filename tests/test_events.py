@@ -146,3 +146,12 @@ def test_pick_mnist_rung_ladder():
     # reference-pure request: pass budget upgrades, trigger stays pure
     assert pick_mnist_rung(400.0, refpure=True) == (4096, 68, 1.0, 0)
     assert pick_mnist_rung(300.0, refpure=True) == (2048, 95, 1.0, 0)
+
+
+def test_pick_cifar_epochs_ladder():
+    from eventgrad_tpu.parallel.events import pick_cifar_epochs
+
+    assert pick_cifar_epochs(float("inf")) == 60   # direct run: 960 passes
+    assert pick_cifar_epochs(660.0) == 60
+    assert pick_cifar_epochs(600.0) == 40          # MNIST top rung keeps priority
+    assert pick_cifar_epochs(200.0) == 40
