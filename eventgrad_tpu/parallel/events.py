@@ -102,6 +102,12 @@ def pick_mnist_rung(remaining_s: float, refpure: bool) -> tuple:
     With `refpure` (an explicit EG_BENCH_MAX_SILENCE=0 request) only the
     pass budget upgrades — the trigger stays the paper's
     (544 passes reference-pure measured 66.08%, mnist_knee_r3_cpu.jsonl).
+
+    There is deliberately NO rung below 380 passes: the 1.025+guard
+    trigger cliff-collapses at shorter scale (measured: 71.07% "saved"
+    at 55.8% accuracy at 240 passes, 70.6% at 75.1% at 280 — same
+    artifact), so tighter budgets keep the reference-pure 160-pass
+    floor.
     """
     if remaining_s >= 390:
         return (4096, 68) + ((1.0, 0) if refpure else (1.025, 50))
