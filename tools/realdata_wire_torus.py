@@ -1,0 +1,88 @@
+"""Wire-compression and 2D-torus legs on real pixels (round 4).
+
+Extends the real-data evidence (tools/realdata_digits.py: refpure 64.2%
+saved at -1.4pp on the UCI scans) to two beyond-reference capabilities
+that so far had synthetic-only measurements:
+
+  wire bf16 / int8   compressed gossip payloads (collectives.py wire
+                     codecs) — same op-point as the r3 refpure leg, so
+                     accuracy deltas read directly against
+                     realdata_digits_r3_cpu.json
+  torus:2x4          the 4-neighbor /5-mixing 2D torus (BASELINE's
+                     stress topology class) on real pixels
+
+Writes artifacts/realdata_wire_torus_r4_cpu.json.
+Usage: python tools/realdata_wire_torus.py [epochs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> None:
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from eventgrad_tpu.data.datasets import load_digits
+    from eventgrad_tpu.models import CNN2
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.parallel.topology import Ring, Torus
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 60
+    (x, y), (xt, yt) = (load_digits("train"), load_digits("test"))
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    batch = 20  # 9 steps/epoch on Ring(8) — r3 digits op-point
+    cfg = EventConfig(adaptive=True, horizon=1.0, warmup_passes=30)
+    out = {
+        "dataset": "sklearn-digits (real scans, MNIST geometry)",
+        "epochs": epochs,
+        "reference_leg": "realdata_digits_r3_cpu.json (refpure 64.2% at -1.4pp)",
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    legs = [
+        ("wire_bf16", Ring(8), {"wire": "bf16"}),
+        ("wire_int8", Ring(8), {"wire": "int8"}),
+        ("torus2x4", Torus(2, 4), {}),
+    ]
+    for tag, topo, extra in legs:
+        t0 = time.perf_counter()
+        state, hist = train(
+            CNN2(), topo, x, y, algo="eventgrad", event_cfg=cfg,
+            epochs=epochs, batch_size=batch, learning_rate=0.05,
+            random_sampler=False, log_every_epoch=False, **extra,
+        )
+        cons = consensus_params(state.params)
+        stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+        acc = evaluate(CNN2(), cons, stats0, xt, yt)["accuracy"]
+        out[tag] = {
+            "passes": epochs * (len(x) // (batch * topo.n_ranks)),
+            "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
+            "test_acc": round(acc, 2),
+            "sent_bytes_per_step": round(
+                hist[-1]["sent_bytes_per_step_per_chip"], 1
+            ),
+            "final_loss": round(hist[-1]["loss"], 4),
+            "n_neighbors": topo.n_neighbors,
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        print(tag, out[tag], flush=True)
+
+    path = os.path.join(repo, "artifacts", "realdata_wire_torus_r4_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
