@@ -50,8 +50,11 @@ Env contract (single source of truth, mirrored in REPRO.md):
   EG_BENCH_MAX_SILENCE    bounded-staleness guard (default 50; 0 =
                       reference-pure trigger — see events.py)
   EG_BENCH_ATTEMPT_S  (internal: supervisor -> child) the wall budget
-                      this attempt actually got; the full tier drops
-                      from 61 to 30 epochs below 420 s. Manual
+                      this attempt actually got; the full tier ladders
+                      its CIFAR legs by it (events.pick_full_epochs:
+                      61 / 30 / 12 epochs at >=420 / >=300 / below),
+                      and the reduced tier sizes its own rungs from it
+                      (pick_cifar_epochs, pick_mnist_rung). Manual
                       full-scale run: EG_BENCH_CHILD=1
                       EG_BENCH_ATTEMPT_S=3600 EG_BENCH_TIER=full
 Legacy aliases EG_BENCH_TINY=1 / EG_BENCH_CPU=1 map to tier tiny/reduced.
@@ -147,18 +150,30 @@ def main() -> None:
         # risk the deadline. An UNSET var means no deadline (direct
         # child run): full scale.
         att = os.environ.get("EG_BENCH_ATTEMPT_S")
-        if att is not None and float(att) < 420 and not rehearsal:
-            # downshift the ResNet legs only: the MNIST CNN-2 leg is
-            # seconds on-chip and 1168 passes IS the ~70% claim's
-            # op-point (mnist_vs_baseline >= 1.0 rides on it)
-            epochs = 30
-            downshifted = True
-            import sys as _sys
-            print(
-                f"full tier: budget {float(att):.0f}s < 420s, running the "
-                "30-epoch CIFAR variant (1920 passes; MNIST leg stays at "
-                "full scale)", file=_sys.stderr,
+        if att is not None and not rehearsal:
+            # downshift the ResNet legs only (ladder in
+            # events.pick_full_epochs — a short live window should still
+            # capture chip evidence rather than lose the tier to the CPU
+            # fallback): the MNIST CNN-2 leg is seconds on-chip and 1168
+            # passes IS the ~70% claim's op-point
+            from eventgrad_tpu.parallel.events import pick_full_epochs
+
+            # same spawn-overhead convention as the reduced-tier rungs:
+            # the kill clock started at child spawn, ~15 s before this
+            # line (interpreter + jax import)
+            new_epochs = pick_full_epochs(
+                float(att) - (time.perf_counter() - t_main) - 15.0
             )
+            if new_epochs != epochs:
+                epochs = new_epochs
+                downshifted = True
+                import sys as _sys
+                print(
+                    f"full tier: budget {float(att):.0f}s, running the "
+                    f"{epochs}-epoch CIFAR variant "
+                    f"({epochs * (n_train // global_batch)} passes; MNIST "
+                    "leg stays at full scale)", file=_sys.stderr,
+                )
         # at full scale the stabilized MNIST op-point is proven: 75.5%
         # saved at -1.17pp over 1168 passes (artifacts/
         # mnist_stabilized_fullscale_r2_cpu.jsonl). The aggressive

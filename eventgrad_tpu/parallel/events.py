@@ -116,6 +116,24 @@ def pick_mnist_rung(remaining_s: float, refpure: bool) -> tuple:
     return None
 
 
+def pick_full_epochs(attempt_s) -> int:
+    """Full (TPU) tier CIFAR epoch count by attempt budget. None (no
+    deadline, direct run) = the 61-epoch reference scale (3904 passes,
+    dcifar10/event/event.cpp:31-36). Under a supervised budget:
+    >= 420 s keeps 61; >= 300 s runs 30 epochs (1920 passes — past the
+    measured savings knee); below that, 12 epochs (768 passes) — a
+    short window should still capture platform/step_ms/MFU chip
+    evidence rather than lose the whole tier to the CPU fallback
+    (the MNIST claim leg keeps its full 1168 passes in every case:
+    seconds on-chip)."""
+    if attempt_s is None:
+        return 61
+    a = float(attempt_s)
+    if a >= 420:
+        return 61
+    return 30 if a >= 300 else 12
+
+
 def pick_cifar_epochs(remaining_s: float) -> int:
     """Reduced-tier CIFAR pass-count ladder (round-4): 40 epochs (640
     passes — stabilized 64.6% saved at gap 0.0, the floor) upgrades to

@@ -155,3 +155,12 @@ def test_pick_cifar_epochs_ladder():
     assert pick_cifar_epochs(660.0) == 60
     assert pick_cifar_epochs(600.0) == 40          # MNIST top rung keeps priority
     assert pick_cifar_epochs(200.0) == 40
+
+
+def test_pick_full_epochs_ladder():
+    from eventgrad_tpu.parallel.events import pick_full_epochs
+
+    assert pick_full_epochs(None) == 61      # direct run: reference scale
+    assert pick_full_epochs(500.0) == 61
+    assert pick_full_epochs("350") == 30     # env strings accepted
+    assert pick_full_epochs(250.0) == 12     # short window: chip evidence
