@@ -537,6 +537,39 @@ def _run_deadlined(cmd: list, env: dict, timeout_s: float):
     return out, timed_out
 
 
+def _last_metric_line(out):
+    """(line, record) of the last parseable metric line in a child's
+    stdout, or (None, None) — ONE definition for both supervisor phases
+    (a teardown crash after a completed measurement is still a
+    result)."""
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return line, rec
+    return None, None
+
+
+def _upgrade_wins(first: dict, second) -> bool:
+    """Should the upgrade attempt's record supersede the already-printed
+    conservative line? Only a strictly better combined baseline ratio
+    from an uncollapsed run — or a chip-captured record at an equal
+    score, since platform/step_ms/MFU evidence is the round's #1 ask."""
+    if not isinstance(second, dict) or second.get("collapsed"):
+        return False
+    old = (
+        (first.get("vs_baseline") or 0.0)
+        + (first.get("mnist_vs_baseline") or 0.0)
+    )
+    new = (
+        (second.get("vs_baseline") or 0.0)
+        + (second.get("mnist_vs_baseline") or 0.0)
+    )
+    return new > old or (second.get("platform") == "tpu" and new >= old)
+
+
 def _supervised() -> None:
     """Run main() in a child under a deadline sized for the driver window.
 
@@ -628,20 +661,6 @@ def _supervised() -> None:
                 d = max(min(floor, remaining), d)
         return d
 
-    def _last_metric_line(out):
-        """(line, record) of the last parseable metric line in a child's
-        stdout, or (None, None) — ONE definition for both phases (a
-        teardown crash after a completed measurement is still a
-        result)."""
-        for line in reversed((out or "").strip().splitlines()):
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict) and "metric" in rec:
-                return line, rec
-        return None, None
-
     def _maybe_upgrade(first_rec: dict) -> None:
         """One opportunistic upgrade attempt after the guaranteed line.
 
@@ -701,19 +720,7 @@ def _supervised() -> None:
             [sys.executable, os.path.abspath(__file__)], env2, d2
         )
         line2, rec2 = _last_metric_line(out2)
-        if rec2 is None or rec2.get("collapsed"):
-            return
-        old = (
-            (first_rec.get("vs_baseline") or 0.0)
-            + (first_rec.get("mnist_vs_baseline") or 0.0)
-        )
-        new = (
-            (rec2.get("vs_baseline") or 0.0)
-            + (rec2.get("mnist_vs_baseline") or 0.0)
-        )
-        # a chip-captured record also supersedes an equal-scoring CPU
-        # one: platform/step_ms/MFU evidence is the round's #1 ask
-        if new > old or (rec2.get("platform") == "tpu" and new >= old):
+        if _upgrade_wins(first_rec, rec2):
             print(line2, flush=True)
 
     # 2 attempts normally; a 3rd exists ONLY as the CPU backstop behind

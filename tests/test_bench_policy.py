@@ -1,0 +1,46 @@
+"""Pure-logic tests for the bench supervisor's two-phase policy.
+
+bench.py is import-safe (main/_supervised run only under __main__); these
+exercise the decision functions the live two-phase validation runs
+(artifacts/bench_default_twophase_r4_cpu.log) depend on.
+"""
+
+import json
+
+import bench
+
+
+def test_last_metric_line_takes_last_parseable():
+    first = json.dumps({"metric": "m", "value": 1.0})
+    second = json.dumps({"metric": "m", "value": 2.0})
+    out = "\n".join([
+        "stderr-ish noise", first, "not json {", second, "trailing noise",
+    ])
+    line, rec = bench._last_metric_line(out)
+    assert rec["value"] == 2.0 and json.loads(line) == rec
+    # records without "metric" are skipped; none at all -> (None, None)
+    assert bench._last_metric_line(json.dumps({"value": 3}))[1] is None
+    assert bench._last_metric_line("") == (None, None)
+    assert bench._last_metric_line(None) == (None, None)
+
+
+def test_upgrade_wins_policy():
+    floor = {"vs_baseline": 1.0769, "mnist_vs_baseline": 0.8774}
+    top = {"vs_baseline": 1.1219, "mnist_vs_baseline": 1.0156,
+           "platform": "cpu"}
+    assert bench._upgrade_wins(floor, top)
+    # never downgrade, never tie-break on CPU
+    assert not bench._upgrade_wins(top, floor)
+    assert not bench._upgrade_wins(floor, dict(floor, platform="cpu"))
+    # a collapsed run can never supersede (the cliff guard extends here)
+    assert not bench._upgrade_wins(
+        floor, dict(top, collapsed=True)
+    )
+    # chip-captured evidence supersedes at an equal score
+    assert bench._upgrade_wins(floor, dict(floor, platform="tpu"))
+    assert not bench._upgrade_wins(
+        top, dict(floor, platform="tpu")  # ...but not at a worse one
+    )
+    # malformed second record is rejected, missing ratios default to 0
+    assert not bench._upgrade_wins(floor, None)
+    assert not bench._upgrade_wins(floor, {"metric": "m"})
