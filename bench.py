@@ -801,19 +801,21 @@ def _supervised() -> None:
             env.pop("JAX_PLATFORMS", None)
             if "EG_BENCH_TIER" not in os.environ:
                 env.pop("EG_BENCH_TIER", None)
-    print(
-        json.dumps(
-            {
-                # no model ran on this path — keep the name model-agnostic
-                # (the success path derives its name from the model used)
-                "metric": "cifar10_eventgrad_msgs_saved",
-                "value": 0.0,
-                "unit": "%",
-                "vs_baseline": 0.0,
-                "error": "device stalled or bench failed twice; see stderr",
-            }
-        )
-    )
+    err_rec = {
+        # no model ran on this path — keep the name model-agnostic
+        # (the success path derives its name from the model used)
+        "metric": "cifar10_eventgrad_msgs_saved",
+        "value": 0.0,
+        "unit": "%",
+        "vs_baseline": 0.0,
+        "error": "device stalled or bench failed twice; see stderr",
+    }
+    print(json.dumps(err_rec), flush=True)
+    # even the all-attempts-failed path gets the upgrade try: a transient
+    # core overload that blew the conservative deadlines may clear, and
+    # any honest result strictly beats the zero line (which is already
+    # out as the guarantee)
+    _maybe_upgrade(err_rec)
 
 
 if __name__ == "__main__":
