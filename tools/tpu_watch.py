@@ -191,30 +191,33 @@ def _is_tpu_grid(path: str) -> bool:
         return False
 
 
+# a committed full artifact supersedes the quick rung entirely — never
+# spend a live window (or risk any overwrite) re-earning a lesser one.
+# Only chip-captured artifacts count (platform == "tpu"): a stray
+# CPU-written file must not gate a rung shut. The FULL rung latches
+# only on a COMPLETE artifact: the flagship publishes its ResNet legs
+# before the MNIST claim leg (wedge insurance), and a partial publish
+# must leave the rung open so a later window completes the MNIST
+# numbers the round-4 brief exists to capture.
+def _is_tpu_artifact(path, required=()):
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec.get("platform") == "tpu" and all(
+            k in rec for k in required
+        )
+    except (OSError, json.JSONDecodeError, AttributeError):
+        return False
+
+
+_FULL_KEYS = ("mnist_msgs_saved", "mnist_vs_baseline")
+
+
 def main() -> None:
     global _deadline
     os.makedirs(ART, exist_ok=True)
     max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
     deadline = _deadline = time.monotonic() + max_hours * 3600
-    # a committed full artifact supersedes the quick rung entirely — never
-    # spend a live window (or risk any overwrite) re-earning a lesser one.
-    # Only chip-captured artifacts count (platform == "tpu"): a stray
-    # CPU-written file must not gate a rung shut. The FULL rung latches
-    # only on a COMPLETE artifact: the flagship publishes its ResNet legs
-    # before the MNIST claim leg (wedge insurance), and a partial publish
-    # must leave the rung open so a later window completes the MNIST
-    # numbers the round-4 brief exists to capture.
-    def _is_tpu_artifact(path, required=()):
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-            return rec.get("platform") == "tpu" and all(
-                k in rec for k in required
-            )
-        except (OSError, json.JSONDecodeError, AttributeError):
-            return False
-
-    _FULL_KEYS = ("mnist_msgs_saved", "mnist_vs_baseline")
     have_full = _is_tpu_artifact(
         os.path.join(ART, "tpu_flagship.json"), required=_FULL_KEYS
     )
