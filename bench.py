@@ -1,4 +1,8 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — the LAST JSON line on stdout is the driver's result.
+
+(One line in the common case; under a generous wall budget the two-phase
+supervisor flushes a guaranteed conservative line early and may follow
+it with one strictly-better upgraded line — see EG_BENCH_TOTAL_S below.)
 
 Headline metric (BASELINE.json): messages-saved-% of EventGraD vs D-PSGD at
 the CIFAR-10 operating point (reference claim ~60%, /root/reference/README.md:4),
@@ -46,6 +50,9 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       and uncollapsed. The LAST JSON line on stdout is
                       the result.
   EG_BENCH_UPGRADE    0 disables the upgrade phase (default on)
+  EG_BENCH_FULL_REHEARSAL  1 + EG_BENCH_TIER=full: execute the full-tier
+                      code path at miniature scale off-chip (config
+                      "full-rehearsal"; never a real measurement)
   EG_BENCH_PROBE_S    device liveness probe deadline (default 60)
   EG_BENCH_HORIZON    CIFAR-leg adaptive horizon (default 1.05 — the
                       stabilized aggressive op-point; requires the
