@@ -115,7 +115,9 @@ def main() -> None:
     from eventgrad_tpu.models import CNN2, LeNetCifar, ResNet18
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import (
+        consensus_params, evaluate, rank0_slice, train,
+    )
     from eventgrad_tpu.utils import trees
 
     tier = _tier()
@@ -277,7 +279,7 @@ def main() -> None:
     )
     wall_event = time.perf_counter() - t0
     cons = consensus_params(state.params)
-    stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+    stats0 = rank0_slice(state.batch_stats)
     test = evaluate(model, cons, stats0, xt, yt)
 
     # D-PSGD comparison leg — SAME op-point, every tier (the other half of
@@ -286,7 +288,7 @@ def main() -> None:
     state_d, hist_d = train(model, topo, x, y, algo="dpsgd", **common)
     wall_dpsgd = time.perf_counter() - t0
     cons_d = consensus_params(state_d.params)
-    stats_d = jax.tree.map(lambda s: s[0], state_d.batch_stats)
+    stats_d = rank0_slice(state_d.batch_stats)
     test_d = evaluate(model, cons_d, stats_d, xt, yt)
 
     # secondary op-point: MNIST CNN-2, batch 64/rank, lr 0.05, sequential
@@ -353,11 +355,11 @@ def main() -> None:
     # reduced op-point (artifacts/overhead_ablation_r4_cpu.json).
     steady_d = hist_d[1:] or hist_d
     step_s_d = float(np.mean([h["wall_s"] / h["steps"] for h in steady_d]))
-    params0 = jax.tree.map(lambda p: p[0], state.params)
-    n_params = trees.tree_count_params(params0)
-    n_leaves = trees.tree_num_leaves(params0)
+    # shape/dtype metadata of the stacked tree — no device dispatch needed
+    n_params = trees.tree_count_params(state.params) // topo.n_ranks
+    n_leaves = trees.tree_num_leaves(state.params)
     param_bytes = int(
-        np.dtype(jax.tree.leaves(params0)[0].dtype).itemsize
+        np.dtype(jax.tree.leaves(state.params)[0].dtype).itemsize
     )
 
     # single-chip MFU of the flagship eventgrad step: all 8 vmap-ranks run

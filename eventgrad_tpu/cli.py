@@ -40,7 +40,7 @@ from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import build_mesh
 from eventgrad_tpu.parallel.topology import Ring, Topology, Torus
-from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 from eventgrad_tpu.train.steps import ALGOS
 from eventgrad_tpu.utils.metrics import JsonlLogger
 
@@ -404,7 +404,7 @@ def main(argv=None) -> int:
         stats_host = multihost.to_host(state.batch_stats)
         if primary:  # ...but only the primary spends the eval and logs it
             cons = consensus_params(params_host)
-            stats0 = jax.tree.map(lambda s: s[0], stats_host)
+            stats0 = rank0_slice(stats_host)
             final = evaluate(model, cons, stats0, xt, yt)
             logger.log({"final": True, **final})
     logger.close()

@@ -39,9 +39,21 @@ from eventgrad_tpu.utils import checkpoint, trees
 from eventgrad_tpu.utils.metrics import msgs_saved_pct
 
 
+@jax.jit
 def consensus_params(stacked_params: Any) -> Any:
-    """Average the per-rank models into the final consensus model."""
+    """Average the per-rank models into the final consensus model.
+
+    jit: one dispatch for the whole tree — eagerly this is one tunnel
+    round-trip per leaf (86 for the ResNet, ~0.4 s each over axon).
+    """
     return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked_params)
+
+
+@jax.jit
+def rank0_slice(tree: Any) -> Any:
+    """Rank 0's slice of a stacked pytree, as one compiled dispatch (the
+    eager per-leaf `x[0]` costs a tunnel round-trip per leaf)."""
+    return jax.tree.map(lambda x: x[0], tree)
 
 
 def _loss_record(pass_base: int, s_i: int, r: int,
@@ -243,9 +255,8 @@ def train(
 
     multi = multihost.is_multiprocess()
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
-    n_params = trees.tree_count_params(
-        jax.tree.map(lambda p: p[0], state.params)
-    )
+    # shape metadata only — never dispatch a device op just to count
+    n_params = trees.tree_count_params(state.params) // topo.n_ranks
     sz = trees.tree_num_leaves(state.params)
     # recv-trace staleness carry — part of the snapshot so a resumed run's
     # recv{r} records continue the interrupted trajectory exactly
@@ -372,7 +383,7 @@ def train(
                 # consensus eval — averaging across sp/tp/pp/ep ranks would
                 # mix differently-sharded parameters
                 cons = consensus_params(state.params)
-                stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+                stats0 = rank0_slice(state.batch_stats)
                 rec.update(
                     {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
                 )

@@ -28,7 +28,7 @@ from eventgrad_tpu.data.datasets import load_or_synthesize
 from eventgrad_tpu.models import CNN2
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.topology import Ring
-from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 
 
 def main() -> None:
@@ -43,7 +43,7 @@ def main() -> None:
     t0 = time.time()
     st, hist = train(CNN2(), topo, x, y, algo="eventgrad", event_cfg=cfg, **kw)
     cons = consensus_params(st.params)
-    stats = jax.tree.map(lambda s: s[0], st.batch_stats)
+    stats = rank0_slice(st.batch_stats)
     out["test_acc_eventgrad"] = round(
         evaluate(CNN2(), cons, stats, xt, yt)["accuracy"], 2
     )
@@ -54,7 +54,7 @@ def main() -> None:
     t0 = time.time()
     st, hist = train(CNN2(), topo, x, y, algo="dpsgd", **kw)
     cons = consensus_params(st.params)
-    stats = jax.tree.map(lambda s: s[0], st.batch_stats)
+    stats = rank0_slice(st.batch_stats)
     out["test_acc_dpsgd"] = round(
         evaluate(CNN2(), cons, stats, xt, yt)["accuracy"], 2
     )

@@ -34,7 +34,7 @@ def main() -> None:
     from eventgrad_tpu.models import CNN2
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out_path = os.path.join(repo, "artifacts", "mnist_knee_r3_cpu.jsonl")
@@ -101,7 +101,7 @@ def main() -> None:
         )
         wall = time.perf_counter() - t0
         cons = consensus_params(state.params)
-        stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+        stats0 = rank0_slice(state.batch_stats)
         acc = evaluate(CNN2(), cons, stats0, xt, yt)["accuracy"]
         rec = {
             "n_train": n_train, "epochs": epochs,

@@ -52,7 +52,7 @@ def main() -> None:
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.sparsify import SparseConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 60
@@ -119,7 +119,7 @@ def main() -> None:
             cons = consensus_params(state.params)
             # rank-0 local BN statistics evaluate the consensus model —
             # the reference's never-synced-buffers semantics (E4)
-            stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+            stats0 = rank0_slice(state.batch_stats)
             acc = evaluate(make_model(), cons, stats0, xt, yt)["accuracy"]
             sec[f"test_acc_{tag}"] = round(acc, 2)
             sec[f"final_loss_{tag}"] = round(hist[-1]["loss"], 4)

@@ -47,7 +47,7 @@ def main() -> None:
     from eventgrad_tpu.models import CNN2
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     (x, y), (xt, yt) = _load()
@@ -78,7 +78,7 @@ def main() -> None:
         t0 = time.perf_counter()
         state, hist = train(CNN2(), topo, x, y, algo=algo, **kw)
         cons = consensus_params(state.params)
-        stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+        stats0 = rank0_slice(state.batch_stats)
         acc = evaluate(CNN2(), cons, stats0, xt, yt)["accuracy"]
         out[f"test_acc_{tag}"] = round(acc, 2)
         out[f"wall_s_{tag}"] = round(time.perf_counter() - t0, 1)

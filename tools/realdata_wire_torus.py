@@ -36,7 +36,7 @@ def main() -> None:
     from eventgrad_tpu.models import CNN2
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.topology import Ring, Torus
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 60
@@ -63,7 +63,7 @@ def main() -> None:
             random_sampler=False, log_every_epoch=False, **extra,
         )
         cons = consensus_params(state.params)
-        stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+        stats0 = rank0_slice(state.batch_stats)
         acc = evaluate(CNN2(), cons, stats0, xt, yt)["accuracy"]
         out[tag] = {
             "passes": epochs * (len(x) // (batch * topo.n_ranks)),

@@ -46,7 +46,9 @@ def main() -> None:
     from eventgrad_tpu.models import ResNet18
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import (
+        consensus_params, evaluate, rank0_slice, train,
+    )
     from eventgrad_tpu.utils.flops import (
         chip_peak_flops, mfu, train_step_flops,
     )
@@ -115,7 +117,7 @@ def main() -> None:
                         **common)
     out["wall_s_eventgrad"] = round(time.perf_counter() - t0, 1)
     cons = consensus_params(state.params)
-    stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+    stats0 = rank0_slice(state.batch_stats)
     out["test_acc_eventgrad"] = round(
         evaluate(model, cons, stats0, xt, yt)["accuracy"], 2
     )
@@ -157,7 +159,7 @@ def main() -> None:
     state_d, hist_d = train(model, topo, x, y, algo="dpsgd", **common)
     out["wall_s_dpsgd"] = round(time.perf_counter() - t0, 1)
     cons_d = consensus_params(state_d.params)
-    stats_d = jax.tree.map(lambda s: s[0], state_d.batch_stats)
+    stats_d = rank0_slice(state_d.batch_stats)
     out["test_acc_dpsgd"] = round(
         evaluate(model, cons_d, stats_d, xt, yt)["accuracy"], 2
     )

@@ -45,7 +45,7 @@ def run_point(dataset: str, horizon: float, warmup: int = 30,
     from eventgrad_tpu.parallel.events import EventConfig
     from eventgrad_tpu.parallel.sparsify import SparseConfig
     from eventgrad_tpu.parallel.topology import Ring
-    from eventgrad_tpu.train.loop import consensus_params, evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, evaluate, rank0_slice, train
     from eventgrad_tpu.utils import trees
 
     topo = topo or Ring(8)
@@ -70,9 +70,9 @@ def run_point(dataset: str, horizon: float, warmup: int = 30,
         **kw,
     )
     cons = consensus_params(state.params)
-    stats0 = jax.tree.map(lambda s: s[0], state.batch_stats)
+    stats0 = rank0_slice(state.batch_stats)
     acc = evaluate(model, cons, stats0, xt, yt)["accuracy"]
-    n_params = trees.tree_count_params(jax.tree.map(lambda p: p[0], state.params))
+    n_params = trees.tree_count_params(state.params) // topo.n_ranks
 
     rec = {
         "dataset": dataset,
@@ -96,7 +96,7 @@ def run_point(dataset: str, horizon: float, warmup: int = 30,
     if dpsgd_leg:
         sd, hd = train(model, topo, x, y, algo="dpsgd", **kw)
         cons_d = consensus_params(sd.params)
-        stats_d = jax.tree.map(lambda s: s[0], sd.batch_stats)
+        stats_d = rank0_slice(sd.batch_stats)
         acc_d = evaluate(model, cons_d, stats_d, xt, yt)["accuracy"]
         rec["test_acc_dpsgd"] = round(acc_d, 2)
         rec["acc_gap"] = round(acc - acc_d, 2)
