@@ -561,12 +561,38 @@ def _last_metric_line(out):
     return None, None
 
 
+def _upgrade_eligible(first_rec: dict, environ) -> bool:
+    """Should the upgrade phase run at all after the guaranteed line?
+    No when disabled, when the first line is an un-downshifted chip
+    record (nothing above it on the ladder), or when the user pinned a
+    tier other than reduced. A DOWNSHIFTED chip line stays eligible —
+    the remaining real budget can fund a longer full-tier run."""
+    if environ.get("EG_BENCH_UPGRADE", "1") == "0":
+        return False
+    if first_rec.get("platform") == "tpu" and not first_rec.get(
+        "downshifted"
+    ):
+        return False
+    if (
+        environ.get("EG_BENCH_TINY") == "1"
+        or environ.get("EG_BENCH_TIER", "reduced") != "reduced"
+    ):
+        return False
+    return True
+
+
 def _upgrade_wins(first: dict, second) -> bool:
     """Should the upgrade attempt's record supersede the already-printed
     conservative line? Only a strictly better combined baseline ratio
     from an uncollapsed run — or a chip-captured record at an equal
-    score, since platform/step_ms/MFU evidence is the round's #1 ask."""
+    score, since platform/step_ms/MFU evidence is the round's #1 ask.
+    A chip-captured first line is NEVER superseded by a non-chip one:
+    higher CPU ladder ratios must not discard the platform/step_ms/MFU
+    evidence (the upgrade phase exists to extend chip runs, not replace
+    them)."""
     if not isinstance(second, dict) or second.get("collapsed"):
+        return False
+    if first.get("platform") == "tpu" and second.get("platform") != "tpu":
         return False
     old = (
         (first.get("vs_baseline") or 0.0)
@@ -681,20 +707,27 @@ def _supervised() -> None:
         MNIST op-point: 71.09% saved -> mnist_vs_baseline 1.0156 even
         with a dead tunnel, artifacts/bench_default_twophase_r4_cpu.log).
         The upgraded line prints only when strictly better on the
-        baseline ratios and not collapse-flagged; otherwise the
-        already-printed conservative line stands. Skipped when the
-        first result came from the chip (the full tier already
-        laddered), when the user pinned a tier other than reduced, or
-        with EG_BENCH_UPGRADE=0."""
-        if os.environ.get("EG_BENCH_UPGRADE", "1") == "0":
+        baseline ratios and not collapse-flagged (and a chip-captured
+        first line is never replaced by a CPU one — _upgrade_wins);
+        otherwise the already-printed conservative line stands. Skipped
+        when the first result came from the chip at its un-downshifted
+        scale (a DOWNSHIFTED chip line stays eligible: the remaining
+        real budget can fund a longer full-tier run), when the user
+        pinned a tier other than reduced, or with EG_BENCH_UPGRADE=0."""
+        if not _upgrade_eligible(first_rec, os.environ):
             return
         if first_rec.get("platform") == "tpu":
-            return
-        if (
-            os.environ.get("EG_BENCH_TINY") == "1"
-            or os.environ.get("EG_BENCH_TIER", "reduced") != "reduced"
-        ):
-            return
+            # a chip re-run is only worth the budget if it funds a
+            # strictly HIGHER epoch rung than the first line captured
+            # (else the whole remaining window re-buys the same tier)
+            from eventgrad_tpu.parallel.events import pick_full_epochs
+
+            rem_est = total_s - (time.monotonic() - t_start)
+            d2_est = min(deadline, rem_est - 20.0)
+            if pick_full_epochs(d2_est - 50.0) <= int(
+                first_rec.get("epochs") or 0
+            ):
+                return
         remaining = total_s - (time.monotonic() - t_start)
         if remaining < 540.0:  # top-rung child (~500 s) + margin
             if remaining > 60:

@@ -44,3 +44,37 @@ def test_upgrade_wins_policy():
     # malformed second record is rejected, missing ratios default to 0
     assert not bench._upgrade_wins(floor, None)
     assert not bench._upgrade_wins(floor, {"metric": "m"})
+
+
+def test_upgrade_eligibility_gate():
+    """An un-downshifted chip line ends the ladder; a downshifted one
+    stays eligible so the remaining budget can fund a longer full-tier
+    run (round-4: the first live window lands short attempts first)."""
+    import bench
+
+    cpu_line = {"platform": "cpu", "downshifted": False}
+    assert bench._upgrade_eligible(cpu_line, {})
+    assert not bench._upgrade_eligible(
+        {"platform": "tpu", "downshifted": False}, {}
+    )
+    assert bench._upgrade_eligible(
+        {"platform": "tpu", "downshifted": True}, {}
+    )
+    assert not bench._upgrade_eligible(cpu_line, {"EG_BENCH_UPGRADE": "0"})
+    assert not bench._upgrade_eligible(cpu_line, {"EG_BENCH_TIER": "tiny"})
+    assert not bench._upgrade_eligible(cpu_line, {"EG_BENCH_TINY": "1"})
+    assert bench._upgrade_eligible(cpu_line, {"EG_BENCH_TIER": "reduced"})
+
+
+def test_chip_line_never_superseded_by_cpu():
+    """_upgrade_wins: higher CPU ladder ratios must not discard a
+    chip-captured record's platform/step_ms/MFU evidence."""
+    import bench
+
+    tpu_line = {"platform": "tpu", "vs_baseline": 1.0,
+                "mnist_vs_baseline": 1.0}
+    cpu_better = {"platform": "cpu", "vs_baseline": 1.2,
+                  "mnist_vs_baseline": 1.2}
+    assert not bench._upgrade_wins(tpu_line, cpu_better)
+    tpu_better = dict(cpu_better, platform="tpu")
+    assert bench._upgrade_wins(tpu_line, tpu_better)

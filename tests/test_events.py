@@ -160,7 +160,11 @@ def test_pick_cifar_epochs_ladder():
 def test_pick_full_epochs_ladder():
     from eventgrad_tpu.parallel.events import pick_full_epochs
 
+    # ladder recalibrated from the round-4 live capture (~19.3 s per
+    # epoch pair + ~320 s cold fixed costs, tpu_flagship_quick.json)
     assert pick_full_epochs(None) == 61      # direct run: reference scale
-    assert pick_full_epochs(500.0) == 61
-    assert pick_full_epochs("350") == 30     # env strings accepted
-    assert pick_full_epochs(250.0) == 12     # short window: chip evidence
+    assert pick_full_epochs(1800.0) == 61
+    assert pick_full_epochs("1100") == 30    # env strings accepted
+    assert pick_full_epochs(700.0) == 12
+    assert pick_full_epochs(520.0) == 8      # warm-cache sizing
+    assert pick_full_epochs(250.0) == 5      # minimum chip evidence
