@@ -55,12 +55,19 @@ def timed_steps(
 
 
 @contextlib.contextmanager
-def trace(log_dir: str):
+def trace(log_dir: str, python_tracer: bool = False):
     """jax.profiler trace scope; degrades to a no-op if tracing is
-    unsupported on the active backend."""
+    unsupported on the active backend.
+
+    python_tracer=False (default) keeps the host Python call tracer OFF:
+    the round-4 flagship capture showed it flooding the export with ~1M
+    host events, truncating the DEVICE timeline out of the trace JSON —
+    the epoch scans' device events are the whole point of the capture."""
     started = False
     try:
-        jax.profiler.start_trace(log_dir)
+        opts = jax.profiler.ProfileOptions()
+        opts.python_tracer_level = 1 if python_tracer else 0
+        jax.profiler.start_trace(log_dir, profiler_options=opts)
         started = True
     except Exception as e:  # pragma: no cover - backend dependent
         import sys
