@@ -45,8 +45,10 @@ class EpochPrefetcher:
         last_epoch: Optional[int] = None,
     ):
         # preserve integer inputs (token sequences); images go to float32
-        x_dtype = np.int32 if np.issubdtype(np.asarray(x).dtype, np.integer) else np.float32
-        self.x = np.ascontiguousarray(x, x_dtype)
+        # (one rule with the device-resident path: sharding.input_cast_dtype)
+        from eventgrad_tpu.data.sharding import input_cast_dtype
+
+        self.x = np.ascontiguousarray(x, input_cast_dtype(x))
         self.y = np.ascontiguousarray(y, np.int32)
         self.n_ranks = n_ranks
         self.batch = batch_size

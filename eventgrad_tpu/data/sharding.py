@@ -16,6 +16,19 @@ from typing import Iterator, Tuple
 import numpy as np
 
 
+def input_cast_dtype(x: np.ndarray) -> np.dtype:
+    """The ONE cast rule for training inputs: integer data (token
+    sequences) stays int32, everything else (images) goes float32.
+    Shared by the host prefetcher and the device-resident dataset path
+    (train/loop.py device_data) so their trajectories stay bitwise
+    equal."""
+    return np.dtype(
+        np.int32
+        if np.issubdtype(np.asarray(x).dtype, np.integer)
+        else np.float32
+    )
+
+
 def _per_rank_count(n: int, n_ranks: int) -> int:
     """Samples per rank, dropping the remainder (allow_duplicates=false)."""
     return n // n_ranks

@@ -27,6 +27,7 @@ import numpy as np
 import optax
 
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
+from eventgrad_tpu.data.sharding import epoch_index_plan
 from eventgrad_tpu.parallel import multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
@@ -187,6 +188,8 @@ def train(
     staleness: int = 0,
     fault_inject: Optional[str] = None,
     on_epoch: Optional[Any] = None,
+    device_data: Optional[bool] = None,
+    epochs_per_dispatch: int = 1,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -204,6 +207,25 @@ def train(
     after epoch N's work (post-snapshot) — the fault-injection half of the
     elastic-recovery story (eventgrad_tpu/supervise.py); the reference has neither
     (a dead rank just hangs its peers' MPI_Recv, decent.cpp:200-205).
+
+    device_data=True uploads the full (cast) dataset to the device ONCE and
+    ships only the per-epoch permutation index plan ([n_ranks, steps, batch]
+    int32, ~KBs) per dispatch; batches are gathered on-device inside the
+    scan. One epoch's stacked batch tensor is the same bytes as the whole
+    dataset (an epoch is one full pass), so this removes ~all recurring H2D
+    traffic — the opposite end of the spectrum from the reference's
+    per-element item() marshalling (decent.cpp:183-189). None = auto:
+    enabled on TPU for single-process non-hybrid runs with datasets under
+    ~1.5 GB. Identical trajectories to the host path (same index plans,
+    same gather — tests/test_dispatch_modes.py).
+
+    epochs_per_dispatch=K fuses K consecutive epochs into ONE jit dispatch
+    (the scan simply runs K*steps steps), amortizing the per-dispatch host
+    and tunnel latency by K. Metrics come back stacked and are split into
+    per-epoch history records on the host; consensus/eval runs at block
+    ends (every K epochs), checkpoints still land exactly on `save_every`
+    boundaries (blocks are split there). fault_inject forces K=1 (the
+    fault must land at an exact epoch boundary).
     """
     fault_mode, fault_epoch = None, -1
     if fault_inject:
@@ -304,6 +326,51 @@ def train(
     )
     lifted = spmd(step, topo, mesh=mesh)
 
+    # --- dispatch-mode resolution (device-resident data + K-epoch blocks)
+    # eligibility: the single-process vmap/single-mesh path only — hybrid
+    # meshes reshape/slice batches per rank (expand_to_mesh) and multihost
+    # runs place shards across processes; both keep the host path.
+    eligible = mesh is None and not hybrid and not multi
+    data_bytes = np.asarray(x_train).size * 4  # post-cast f32/int32 bytes
+    if device_data is None:
+        device_data = (
+            eligible
+            and jax.default_backend() == "tpu"
+            and data_bytes <= int(os.environ.get(
+                "EG_DEVICE_DATA_MAX_BYTES", str(1_500_000_000)
+            ))
+        )
+    elif device_data and not eligible:
+        raise ValueError(
+            "device_data requires the single-process, non-hybrid, "
+            "mesh=None path (hybrid/multihost runs shard batches on host)"
+        )
+    K = max(1, int(epochs_per_dispatch))
+    if fault_mode is not None:
+        K = 1  # the fault must land at an exact epoch boundary
+    total_epochs = max(0, epochs - start_epoch)
+    # keep at least two blocks so a steady-state (post-compile) slice
+    # always exists: a single mega-block would smear the jit compile into
+    # every history record (steady_records' cold-block rule needs a warm
+    # block to keep)
+    if total_epochs >= 2:
+        K = min(K, total_epochs // 2)
+    else:
+        K = 1
+    if save_every and K > 1:
+        # blocks split at save points: keep K a divisor of save_every so
+        # block sizes REPEAT across save segments — otherwise every block
+        # could be a distinct (all-cold) size and no warm steady slice
+        # would exist
+        K = max(d for d in range(1, K + 1) if save_every % d == 0)
+    if not device_data and K > 1:
+        # host path: a K-epoch block materializes K stacked epoch copies
+        # in host RAM + HBM at once (no resident-dataset dedup) — cap the
+        # block bytes rather than multiply peak memory by K
+        K = max(1, min(K, int(os.environ.get(
+            "EG_HOST_BLOCK_MAX_BYTES", str(1_500_000_000)
+        )) // max(1, data_bytes)))
+
     # donate the carried state: the scan updates params/opt/event state in
     # place instead of holding two copies in HBM (batches can't alias — the
     # steps-major swapaxes relayouts them)
@@ -316,80 +383,184 @@ def train(
         xs = (jnp.swapaxes(xb, 0, 1), jnp.swapaxes(yb, 0, 1))
         return jax.lax.scan(body, st, xs)
 
+    # device-resident variant: batches are gathered on-device from the
+    # resident dataset each scan step — only the index plan crosses the
+    # host->device boundary per dispatch
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_epoch_idx(st, x_all, y_all, idx):
+        def body(s, ib):
+            return lifted(s, (x_all[ib], y_all[ib]))
+
+        # [n_ranks, S, B] -> scan over S; gather yields [n_ranks, B, ...]
+        return jax.lax.scan(body, st, jnp.swapaxes(idx, 0, 1))
+
     history: List[Dict[str, Any]] = []
 
-    prefetcher = EpochPrefetcher(
-        x_train, y_train, n_data, batch_size,
-        random=random_sampler, seed=seed, last_epoch=epochs,
-    )
+    x_dev = y_dev = None
+    prefetcher = None
+    if device_data:
+        from eventgrad_tpu.data.sharding import input_cast_dtype
+
+        x_dev = jnp.asarray(
+            np.ascontiguousarray(x_train, input_cast_dtype(x_train))
+        )
+        y_dev = jnp.asarray(np.ascontiguousarray(y_train, np.int32))
+        steps_per_epoch = epoch_index_plan(
+            len(x_train), n_data, batch_size
+        ).shape[1]
+    else:
+        prefetcher = EpochPrefetcher(
+            x_train, y_train, n_data, batch_size,
+            random=random_sampler, seed=seed, last_epoch=epochs,
+        )
+        steps_per_epoch = prefetcher.steps
+
+    def _blocks():
+        """Consecutive (first, last) epoch blocks of up to K epochs, split
+        so every `save_every` multiple lands exactly on a block end."""
+        e = start_epoch + 1
+        while e <= epochs:
+            be = min(e + K - 1, epochs)
+            if save_every:
+                nxt = ((e + save_every - 1) // save_every) * save_every
+                if e <= nxt <= be:
+                    be = nxt
+            yield e, be
+            e = be + 1
+
+    seen_block_sizes: set = set()
     try:
-        for epoch in range(start_epoch + 1, epochs + 1):
-            xb, yb = prefetcher.get(epoch)
-            if hybrid:
-                xb, yb = expand_to_mesh(xb, yb, topo)
-            steps = xb.shape[1]
-            if mesh is not None:  # global placement (spans hosts if any)
-                xb = multihost.put_stacked(xb, mesh, topo)
-                yb = multihost.put_stacked(yb, mesh, topo)
+        for blk_i, (blk_start, blk_end) in enumerate(_blocks()):
+            n_e = blk_end - blk_start + 1
+            # first block of each distinct size pays a jit trace+compile
+            # (scan length is part of the shape) — tag its records so
+            # steady-state step math can exclude them (the tail-remainder
+            # block recompiles too, not just block 0)
+            cold = n_e not in seen_block_sizes
+            seen_block_sizes.add(n_e)
+            label_shape: Tuple[int, ...] = ()
+            if device_data:
+                idx_np = np.concatenate(
+                    [
+                        epoch_index_plan(
+                            len(x_train), n_data, batch_size,
+                            random=random_sampler, seed=seed, epoch=e,
+                        )
+                        for e in range(blk_start, blk_end + 1)
+                    ],
+                    axis=1,
+                ).astype(np.int32)
+                # per-(step, rank) target count: batch plus any trailing
+                # label dims (LM token axes)
+                label_shape = (batch_size,) + tuple(y_dev.shape[1:])
+                t0 = time.perf_counter()
+                state, m = run_epoch_idx(
+                    state, x_dev, y_dev, jnp.asarray(idx_np)
+                )
             else:
-                xb, yb = jnp.asarray(xb), jnp.asarray(yb)
-            t0 = time.perf_counter()
-            state, m = run_epoch(state, xb, yb)
+                parts = [prefetcher.get(e) for e in range(blk_start, blk_end + 1)]
+                xb = (
+                    np.concatenate([p[0] for p in parts], axis=1)
+                    if n_e > 1 else parts[0][0]
+                )
+                yb = (
+                    np.concatenate([p[1] for p in parts], axis=1)
+                    if n_e > 1 else parts[0][1]
+                )
+                del parts
+                if hybrid:
+                    xb, yb = expand_to_mesh(xb, yb, topo)
+                if mesh is not None:  # global placement (spans hosts if any)
+                    xb = multihost.put_stacked(xb, mesh, topo)
+                    yb = multihost.put_stacked(yb, mesh, topo)
+                else:
+                    xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+                label_shape = tuple(yb.shape[2:])
+                t0 = time.perf_counter()
+                state, m = run_epoch(state, xb, yb)
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
 
-            # metrics are [steps, n_ranks]
+            # block metrics are [n_e * steps, n_ranks]; split per epoch
             m = multihost.to_host(m)
-            total_passes = start_passes + (epoch - start_epoch) * steps
-            rec = {
-                "epoch": epoch,
-                "algo": algo,
-                "steps": steps,
-                "wall_s": dt,
-                "loss": float(m["loss"].mean()),
-                # targets per step per rank: batch for classification,
-                # batch x t_local for LM (correct counts tokens elementwise)
-                "train_acc": 100.0 * float(m["correct"].sum())
-                / (topo.n_ranks * steps * int(np.prod(yb.shape[2:]))),
-                "sent_bytes_per_step_per_chip": float(m["sent_bytes"][..., 0].mean()),
-                "n_params": n_params,
-            }
-            if algo in ("eventgrad", "sp_eventgrad"):
-                # msgs-saved vs D-PSGD: events/(n_neighbors * passes * sz) fired
-                events_total = int(m["num_events"][-1].sum())
-                rec["num_events"] = events_total
-                rec["msgs_saved_pct"] = msgs_saved_pct(
-                    events_total, total_passes, sz, topo.n_neighbors, topo.n_ranks
-                )
-                rec["fired_frac"] = float(m["fired_frac"].mean())
-            if trace_file and "trace_fired" in m and multihost.is_primary():
-                _write_trace(
-                    trace_file, m, total_passes - steps, topo, state, trace_carry
-                )
-            elif trace_file and multihost.is_primary():
-                # non-event algos: per-step per-rank loss records — the
-                # (epoch, loss) stream cent/decent call values{r}.txt
-                # (cent.cpp:124, decent.cpp:166)
-                loss_all = np.asarray(m["loss"])
-                with open(trace_file, "a") as tf:
-                    for s_i in range(steps):
-                        for r in range(topo.n_ranks):
-                            tf.write(json.dumps(_loss_record(
-                                total_passes - steps, s_i, r, loss_all
-                            )) + "\n")
-            if x_test is not None and log_every_epoch and not multi and not hybrid:
-                # multi-process callers evaluate once at the end on
-                # allgathered params (multihost.to_host); hybrid meshes skip
-                # consensus eval — averaging across sp/tp/pp/ep ranks would
-                # mix differently-sharded parameters
-                cons = consensus_params(state.params)
-                stats0 = rank0_slice(state.batch_stats)
-                rec.update(
-                    {"test_" + k: v for k, v in evaluate(model, cons, stats0, x_test, y_test).items()}
-                )
-            history.append(rec)
-            if on_epoch is not None:  # live metrics (and liveness signal)
-                on_epoch(rec)
+            steps = steps_per_epoch
+            for j, epoch in enumerate(range(blk_start, blk_end + 1)):
+                sl = slice(j * steps, (j + 1) * steps)
+                m_e = {k: np.asarray(v)[sl] for k, v in m.items()}
+                total_passes = start_passes + (epoch - start_epoch) * steps
+                rec = {
+                    "epoch": epoch,
+                    "algo": algo,
+                    "steps": steps,
+                    # 0-based jit-dispatch block index; dispatch_cold marks
+                    # records from a block that paid a compile (first block
+                    # of its size) — steady-state step math drops those
+                    # (utils.metrics.steady_records)
+                    "dispatch_block": blk_i,
+                    "dispatch_cold": cold,
+                    "wall_s": dt / n_e,
+                    "loss": float(m_e["loss"].mean()),
+                    # targets per step per rank: batch for classification,
+                    # batch x t_local for LM (correct counts tokens
+                    # elementwise)
+                    "train_acc": 100.0 * float(m_e["correct"].sum())
+                    / (topo.n_ranks * steps * int(np.prod(label_shape) or 1)),
+                    "sent_bytes_per_step_per_chip": float(
+                        m_e["sent_bytes"][..., 0].mean()
+                    ),
+                    "n_params": n_params,
+                }
+                if algo in ("eventgrad", "sp_eventgrad"):
+                    # msgs-saved vs D-PSGD: events/(n_neighbors * passes *
+                    # sz) fired
+                    events_total = int(m_e["num_events"][-1].sum())
+                    rec["num_events"] = events_total
+                    rec["msgs_saved_pct"] = msgs_saved_pct(
+                        events_total, total_passes, sz, topo.n_neighbors,
+                        topo.n_ranks,
+                    )
+                    rec["fired_frac"] = float(m_e["fired_frac"].mean())
+                if trace_file and "trace_fired" in m_e and multihost.is_primary():
+                    _write_trace(
+                        trace_file, m_e, total_passes - steps, topo, state,
+                        trace_carry,
+                    )
+                elif trace_file and multihost.is_primary():
+                    # non-event algos: per-step per-rank loss records — the
+                    # (epoch, loss) stream cent/decent call values{r}.txt
+                    # (cent.cpp:124, decent.cpp:166)
+                    loss_all = np.asarray(m_e["loss"])
+                    with open(trace_file, "a") as tf:
+                        for s_i in range(steps):
+                            for r in range(topo.n_ranks):
+                                tf.write(json.dumps(_loss_record(
+                                    total_passes - steps, s_i, r, loss_all
+                                )) + "\n")
+                is_block_end = epoch == blk_end
+                if (
+                    x_test is not None and log_every_epoch and not multi
+                    and not hybrid and is_block_end
+                ):
+                    # multi-process callers evaluate once at the end on
+                    # allgathered params (multihost.to_host); hybrid meshes
+                    # skip consensus eval — averaging across sp/tp/pp/ep
+                    # ranks would mix differently-sharded parameters.
+                    # K-epoch blocks evaluate at block ends (every-K
+                    # cadence) — the final epoch is always a block end.
+                    cons = consensus_params(state.params)
+                    stats0 = rank0_slice(state.batch_stats)
+                    rec.update(
+                        {
+                            "test_" + k: v
+                            for k, v in evaluate(
+                                model, cons, stats0, x_test, y_test
+                            ).items()
+                        }
+                    )
+                history.append(rec)
+                if on_epoch is not None:  # live metrics (liveness signal)
+                    on_epoch(rec)
+            epoch = blk_end
             if ckpt_path and (
                 epoch == epochs or (save_every and epoch % save_every == 0)
             ):
@@ -411,6 +582,7 @@ def train(
                 while True:  # "hang": alive but no progress (no heartbeat)
                     time.sleep(3600)
     finally:
-        prefetcher.close()
+        if prefetcher is not None:
+            prefetcher.close()
 
     return state, history

@@ -42,6 +42,22 @@ def msgs_saved_pct(num_events: int, passes: int, n_tensors: int, n_neighbors: in
     return 100.0 * (1.0 - num_events / possible) if possible else 0.0
 
 
+def steady_records(history) -> list:
+    """The steady-state slice of a train() history: every record outside a
+    COLD jit-dispatch block (a block that paid a trace+compile — block 0,
+    plus the first block of any other size, e.g. the tail remainder when
+    epochs % K != 0). With K-epoch blocks (loop.py epochs_per_dispatch)
+    dropping only epoch 1 would smear 1/K of the compile into the
+    'steady' mean — the cold-block tag is the honest cut. Falls back to
+    history[1:] (the legacy rule) when every block was cold, and to the
+    full history when that leaves nothing."""
+    out = [
+        h for h in history
+        if not h.get("dispatch_cold", h.get("dispatch_block", h["epoch"] - 1) == 0)
+    ]
+    return out or history[1:] or list(history)
+
+
 def collapse_verdict(
     losses,
     twin_loss: Optional[float] = None,

@@ -1,0 +1,128 @@
+"""Dispatch-mode equivalences: device-resident data and K-epoch blocks.
+
+Round-5 host-dispatch-tax work (train/loop.py device_data /
+epochs_per_dispatch) must not change trajectories: the device gather uses
+the SAME epoch_index_plan as the host prefetcher, and a K-epoch block is
+the same scan run K*steps steps — so final parameters and per-epoch
+metrics must match the host / per-epoch path exactly.
+"""
+
+import numpy as np
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+
+N_RANKS = 4
+BATCH = 8
+EPOCHS = 5
+
+
+def _train(algo="eventgrad", **kw):
+    topo = Ring(N_RANKS)
+    x, y = synthetic_dataset(N_RANKS * BATCH * 3, (28, 28, 1), seed=7)
+    cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2)
+    return train(
+        MLP(hidden=16), topo, x, y, algo=algo, epochs=EPOCHS,
+        batch_size=BATCH, learning_rate=0.05,
+        event_cfg=cfg if algo in ("eventgrad", "sp_eventgrad") else None,
+        **kw,
+    )
+
+
+def _leaves(state):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(state.params)]
+
+
+def _assert_same(state_a, hist_a, state_b, hist_b):
+    for la, lb in zip(_leaves(state_a), _leaves(state_b)):
+        np.testing.assert_array_equal(la, lb)
+    assert len(hist_a) == len(hist_b)
+    for ra, rb in zip(hist_a, hist_b):
+        assert ra["epoch"] == rb["epoch"]
+        np.testing.assert_allclose(ra["loss"], rb["loss"], rtol=0, atol=0)
+        if "msgs_saved_pct" in ra:
+            assert ra["msgs_saved_pct"] == rb["msgs_saved_pct"]
+        assert ra["sent_bytes_per_step_per_chip"] == (
+            rb["sent_bytes_per_step_per_chip"]
+        )
+        assert ra["train_acc"] == rb["train_acc"]
+
+
+@pytest.mark.parametrize("sampler", [False, True])
+def test_device_data_matches_host_path(sampler):
+    """device_data gathers on device from the identical index plan — the
+    whole trajectory is bitwise the host path's."""
+    s0, h0 = _train(random_sampler=sampler, device_data=False)
+    s1, h1 = _train(random_sampler=sampler, device_data=True)
+    _assert_same(s0, h0, s1, h1)
+
+
+@pytest.mark.parametrize("algo", ["dpsgd", "eventgrad"])
+def test_k_epoch_blocks_match_per_epoch(algo):
+    """A K-epoch block is the same scan with K*steps steps: 5 epochs as
+    3+2 blocks reproduce the per-epoch dispatch exactly, including the
+    per-epoch history split."""
+    s0, h0 = _train(algo=algo, device_data=False, epochs_per_dispatch=1)
+    s1, h1 = _train(algo=algo, device_data=False, epochs_per_dispatch=3)
+    _assert_same(s0, h0, s1, h1)
+
+
+def test_k_blocks_with_device_data_and_random_sampler():
+    s0, h0 = _train(random_sampler=True, device_data=False,
+                    epochs_per_dispatch=1)
+    s1, h1 = _train(random_sampler=True, device_data=True,
+                    epochs_per_dispatch=4)
+    _assert_same(s0, h0, s1, h1)
+
+
+def test_blocks_split_on_save_every(tmp_path):
+    """Checkpoint cadence survives K-epoch blocks: save_every=2 with K=3
+    still snapshots at epochs 2 and 4 (blocks split at save points)."""
+    topo = Ring(N_RANKS)
+    x, y = synthetic_dataset(N_RANKS * BATCH * 2, (28, 28, 1), seed=7)
+    ck = str(tmp_path / "ck")
+    s0, h0 = train(
+        MLP(hidden=16), topo, x, y, algo="dpsgd", epochs=EPOCHS,
+        batch_size=BATCH, learning_rate=0.05,
+        checkpoint_dir=ck, save_every=2, epochs_per_dispatch=3,
+    )
+    from eventgrad_tpu.utils import checkpoint
+    import os
+
+    found = checkpoint.latest(os.path.join(ck, "ckpt"))
+    assert found is not None
+    # resume from the last snapshot reproduces the non-checkpointed run
+    s1, h1 = train(
+        MLP(hidden=16), topo, x, y, algo="dpsgd", epochs=EPOCHS,
+        batch_size=BATCH, learning_rate=0.05,
+        checkpoint_dir=ck, save_every=2, resume=True,
+        epochs_per_dispatch=3,
+    )
+    s2, h2 = train(
+        MLP(hidden=16), topo, x, y, algo="dpsgd", epochs=EPOCHS,
+        batch_size=BATCH, learning_rate=0.05,
+    )
+    for la, lb in zip(_leaves(s1), _leaves(s2)):
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_eval_at_block_ends():
+    """x_test + K>1: consensus eval runs at block ends only (every-K
+    cadence), and always on the final epoch."""
+    topo = Ring(N_RANKS)
+    x, y = synthetic_dataset(N_RANKS * BATCH * 2, (28, 28, 1), seed=7)
+    xt, yt = synthetic_dataset(64, (28, 28, 1), seed=8)
+    _, hist = train(
+        MLP(hidden=16), topo, x, y, algo="dpsgd", epochs=EPOCHS,
+        batch_size=BATCH, learning_rate=0.05,
+        x_test=xt, y_test=yt, epochs_per_dispatch=2,
+    )
+    evaled = [r["epoch"] for r in hist if "test_accuracy" in r]
+    assert evaled == [2, 4, 5]
+    assert hist[-1]["epoch"] == EPOCHS
