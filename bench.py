@@ -734,12 +734,17 @@ def _supervised() -> None:
         if first_rec.get("platform") == "tpu":
             # a chip re-run is only worth the budget if it funds a
             # strictly HIGHER epoch rung than the first line captured
-            # (else the whole remaining window re-buys the same tier)
+            # (else the whole remaining window re-buys the same tier).
+            # The budget estimate charges the re-probe's REAL allowance
+            # (up to min(probe_s, 75) + spawn margin), not a flat 50 s —
+            # ADVICE r4: the probe could eat the cushion and land the
+            # child back on the rung this gate predicted it would exceed.
             from eventgrad_tpu.parallel.events import pick_full_epochs
 
+            probe_allow = min(probe_s, 75.0) + 15.0
             rem_est = total_s - (time.monotonic() - t_start)
-            d2_est = min(deadline, rem_est - 20.0)
-            if pick_full_epochs(d2_est - 50.0) <= int(
+            d2_est = min(deadline, rem_est - 20.0 - probe_allow)
+            if pick_full_epochs(d2_est) <= int(
                 first_rec.get("epochs") or 0
             ):
                 return
@@ -761,6 +766,11 @@ def _supervised() -> None:
             )
             if verdict2 == "ok":
                 plat2 = p2 or "accelerator"
+        if first_rec.get("platform") == "tpu" and plat2 == "cpu":
+            # ADVICE r4: a CPU child can never supersede a chip first
+            # line (_upgrade_wins) — don't spend the whole remaining
+            # budget on a run whose output is guaranteed to be discarded
+            return
         if plat2 == "cpu":
             env2["JAX_PLATFORMS"] = "cpu"
             env2.setdefault("EG_BENCH_TIER", "reduced")

@@ -121,11 +121,13 @@ def _fused_case(name, p, b_, g, t):
     )
     assert err < 1e-5, f"fused_mix_sgd diverges from XLA twin: {err}"
     ms_f, ms_r = _time(fused, p, b_, g, t), _time(ref, p, b_, g, t)
+    speedup = round(ms_r / ms_f, 2)
     _emit({
         "kernel": "fused_mix_sgd", "config": name,
         "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_r, 3),
-        "speedup": round(ms_r / ms_f, 2), "max_err": err,
+        "speedup": speedup, "max_err": err,
     })
+    return speedup
 
 
 def bench_fused_update():
@@ -164,7 +166,24 @@ def bench_fused_update():
             for j, x in enumerate(leaves)
         ])
 
-    _fused_case("ResNet18-as-coded tree (86 leaves)", p, like(1), like(2), like(3))
+    tree_speedup = _fused_case(
+        "ResNet18-as-coded tree (86 leaves)", p, like(1), like(2), like(3)
+    )
+    if jax.devices()[0].platform == "tpu":
+        # record the measured verdict for the auto-demote policy
+        # (ops/fused_tuning.py): a losing tree case must not run in the
+        # train step's fused tail
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "eventgrad_tpu", "ops", "fused_tuning.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": jax.devices()[0].device_kind,
+                       "tree_speedup": tree_speedup}, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        _emit({"tuned": path, "tree_speedup": tree_speedup})
 
 
 def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
@@ -223,6 +242,26 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
             _emit({"kernel": f"flash_{mode}", "config": f"T{t}:winner",
                    **{k_: best[k_] for k_ in ("pallas", "block", "pallas_ms",
                                               "xla_ms")}})
+    # sanity pass (ADVICE r4: a broken xla baseline — 0.017 ms at T=512,
+    # ~200x below the same-shape full-depth grid — was committed into the
+    # dispatch table): attention cost grows ~t^2, so within a mode an
+    # xla_ms more than 8x below the quadratic back-projection of the next
+    # LARGER t is a broken measurement; impute t^2-scaled and re-verdict.
+    for mode in ("fwd", "fwd_bwd"):
+        es = sorted((e for e in entries if e["mode"] == mode),
+                    key=lambda e: e["t"])
+        for a, bigger in zip(es, es[1:]):
+            expect = bigger["xla_ms"] / (bigger["t"] / a["t"]) ** 2
+            if a["xla_ms"] < expect / 8.0:
+                a["xla_ms_broken"] = a["xla_ms"]
+                a["xla_ms"] = round(expect, 3)
+                a["xla_ms_imputed"] = True
+                a["pallas"] = bool(
+                    a["pallas_ms"] is not None and a["pallas_ms"] < a["xla_ms"]
+                )
+                _emit({"kernel": f"flash_{mode}", "config": f"T{a['t']}:sanity",
+                       "xla_ms_broken": a["xla_ms_broken"],
+                       "xla_ms_imputed": a["xla_ms"]})
     if jax.devices()[0].platform == "tpu":
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "eventgrad_tpu", "ops", "flash_tuning.json")
@@ -232,6 +271,7 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
             # uses it to tell this apart from a hand-seeded table
             json.dump({"platform": jax.devices()[0].device_kind,
                        "swept": True, "entries": entries}, f, indent=1)
+            f.write("\n")
         os.replace(tmp, path)
         _emit({"tuned": path, "n_entries": len(entries)})
     else:

@@ -1,0 +1,58 @@
+"""Measured dispatch policy for the fused_mix_sgd Pallas kernel.
+
+Round-4 chip capture (KERNELS_TPU.json): the kernel is ~1.0x on one big
+lane-aligned leaf but 0.87x on the flagship ResNet's real 86-leaf tree —
+86 separate launches lose to XLA's fused elementwise chains. Packing the
+tree into one superleaf would add a concat+split pass over every element
+(strictly worse than XLA's fusion), so the honest mechanism is the same
+one flash_tuning uses: measure on chip, record the verdict, and demote
+the losing case automatically.
+
+`fused_tuning.json` (next to this module) is written by
+`bench_kernels.py fused` on the real chip:
+
+  {"platform": "...", "tree_speedup": 0.87, "single_leaf_speedup": 1.0}
+
+Policy: `tree_fused_ok()` gates the MULTI-LEAF pytree case of
+train.steps' fused tail. With no table the kernel runs (legacy
+behavior); a measured tree_speedup < 1.0 demotes it. EG_FORCE_FUSED=1
+overrides (manual experiments). Single-leaf callers are not affected —
+their measured case is ~break-even and the kernel keeps its guaranteed
+one-HBM-pass property there.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+_TABLE_PATH = os.path.join(os.path.dirname(__file__), "fused_tuning.json")
+
+#: a params pytree with at most this many leaves counts as "single-leaf
+#: like" (launch overhead amortized); above it the tree verdict governs
+SMALL_TREE_LEAVES = 4
+
+
+@functools.lru_cache(maxsize=1)
+def _table():
+    try:
+        with open(_TABLE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def tree_fused_ok(n_leaves: int) -> bool:
+    """Should the fused Pallas tail run on an `n_leaves`-leaf tree?
+
+    True when the tree is small (launch overhead amortized over few
+    launches), when no measurement exists (legacy opt-in behavior), when
+    the chip measured a win, or when EG_FORCE_FUSED=1 pins it on.
+    """
+    if os.environ.get("EG_FORCE_FUSED") == "1":
+        return True
+    if n_leaves <= SMALL_TREE_LEAVES:
+        return True
+    ratio = _table().get("tree_speedup")
+    return ratio is None or float(ratio) >= 1.0

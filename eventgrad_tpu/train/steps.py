@@ -259,7 +259,16 @@ def make_train_step(
             )
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
-        if fused_sgd is not None and algo != "allreduce":
+        use_fused = fused_sgd is not None and algo != "allreduce"
+        if use_fused:
+            # measured dispatch policy (ops/fused_tuning.py): the chip
+            # capture showed the many-launch tree case losing to XLA's
+            # fused chains (0.87x on the 86-leaf ResNet) — auto-demote to
+            # the optax tail there; EG_FORCE_FUSED=1 overrides
+            from eventgrad_tpu.ops.fused_tuning import tree_fused_ok
+
+            use_fused = tree_fused_ok(trees.tree_num_leaves(params))
+        if use_fused:
             # Pallas fused tail: mix + momentum-SGD in one HBM pass.
             lr_f, mom_f = fused_sgd
             buf_sum = trees.tree_zeros_like(params)

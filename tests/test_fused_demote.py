@@ -1,0 +1,76 @@
+"""Auto-demotion of the fused_mix_sgd tail on measured-losing trees
+(VERDICT r4 item 6: 0.87x on the 86-leaf ResNet tree -> the dispatch must
+measure-and-demote like flash_tuning does)."""
+
+import json
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.ops import fused_tuning
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+
+@pytest.fixture
+def table(tmp_path, monkeypatch):
+    """Point the policy at a scratch table; clear the lru cache around it."""
+    path = tmp_path / "fused_tuning.json"
+
+    def write(rec):
+        path.write_text(json.dumps(rec))
+        fused_tuning._table.cache_clear()
+
+    monkeypatch.setattr(fused_tuning, "_TABLE_PATH", str(path))
+    fused_tuning._table.cache_clear()
+    yield write
+    fused_tuning._table.cache_clear()
+
+
+def test_policy_verdicts(table, monkeypatch):
+    monkeypatch.delenv("EG_FORCE_FUSED", raising=False)
+    # no table: legacy opt-in behavior (kernel runs)
+    assert fused_tuning.tree_fused_ok(86)
+    # measured loss: demote multi-leaf trees, keep small ones
+    table({"tree_speedup": 0.87})
+    assert not fused_tuning.tree_fused_ok(86)
+    assert fused_tuning.tree_fused_ok(fused_tuning.SMALL_TREE_LEAVES)
+    # measured win: keep
+    table({"tree_speedup": 1.12})
+    assert fused_tuning.tree_fused_ok(86)
+    # manual override
+    table({"tree_speedup": 0.5})
+    monkeypatch.setenv("EG_FORCE_FUSED", "1")
+    assert fused_tuning.tree_fused_ok(86)
+
+
+def test_demoted_step_equals_optax_tail(table, monkeypatch):
+    """With a losing table entry, fused_update=True silently takes the
+    optax tail — bitwise the same step as fused off (MLP has 6 leaves,
+    so shrink the small-tree floor to cover it)."""
+    monkeypatch.delenv("EG_FORCE_FUSED", raising=False)
+    monkeypatch.setattr(fused_tuning, "SMALL_TREE_LEAVES", 0)
+    table({"tree_speedup": 0.87})
+    topo = Ring(4)
+    model = MLP(hidden=16)
+    tx = optax.sgd(0.05, momentum=0.9)
+    x, y = synthetic_dataset(4 * 8, (28, 28, 1), seed=3)
+    xb, yb = batched_epoch(x, y, 4, 8)
+
+    outs = []
+    for fused in (None, (0.05, 0.9)):
+        state = init_train_state(model, (28, 28, 1), tx, topo, "dpsgd")
+        step = make_train_step(model, tx, topo, "dpsgd", fused_sgd=fused)
+        lifted = jax.jit(spmd(step, topo))
+        state, _ = lifted(state, (xb[:, 0], yb[:, 0]))
+        outs.append(state)
+    for a, b in zip(jax.tree.leaves(outs[0].params),
+                    jax.tree.leaves(outs[1].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,144 @@
+"""EventGraD step overhead at the FLAGSHIP op-point, on chip (round-5
+verdict item 2: eventgrad must be <= 1.0x dpsgd step time, or the trigger
+machinery is costing wall time instead of buying it).
+
+Times the steady-state step of the flagship ResNet op-point (8-rank vmap
+ring, global batch 256, bf16 compute — the same config bench.py's full
+tier and tools/tpu_flagship.py measure) for a variant matrix:
+
+  dpsgd                  the dense baseline
+  eventgrad              the bench trigger (synchronous exchange)
+  eventgrad_stale        staleness=1 — mixes with the PREVIOUS step's
+                         buffers, the deterministic model of the
+                         reference's RMA asynchrony (event.cpp:348-360 vs
+                         :399-438); frees XLA to overlap the exchange
+  eventgrad_bf16         wire="bf16" — half-width exchange payloads
+  eventgrad_stale_bf16   both
+  spevent                sparsified top-k 10% (E5) — the top_k+scatter
+                         path's chip cost (round-4 verdict missing #2)
+
+Each variant runs a short multi-epoch train() with the round-5 dispatch
+modes (device-resident data, K-epoch blocks); step_ms comes from the warm
+(non-cold) dispatch blocks only, so compiles never contaminate it.
+
+Writes artifacts/flagship_overhead_r5_<platform>.json.
+Usage: python tools/flagship_overhead.py [epochs_per_variant]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+compile_cache.enable()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from eventgrad_tpu.data.datasets import load_or_synthesize
+    from eventgrad_tpu.models import ResNet18
+    from eventgrad_tpu.parallel.events import (
+        EventConfig, resolve_bench_trigger,
+    )
+    from eventgrad_tpu.parallel.sparsify import SparseConfig
+    from eventgrad_tpu.parallel.topology import Ring
+    from eventgrad_tpu.train.loop import train
+    from eventgrad_tpu.utils.metrics import steady_records
+
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    topo = Ring(8)
+    global_batch, n_train = 256, 16384
+    if os.environ.get("EG_OVERHEAD_SMOKE") == "1":
+        # script-path validation off-chip (never a measurement)
+        from eventgrad_tpu.models import LeNetCifar
+
+        model_fn = LeNetCifar
+        global_batch, n_train = 64, 512
+    else:
+        model_fn = lambda: ResNet18(dtype=jnp.bfloat16)  # noqa: E731
+    per_rank = global_batch // topo.n_ranks
+    horizon, max_silence = resolve_bench_trigger(os.environ)
+    cfg = EventConfig(adaptive=True, horizon=horizon, warmup_passes=30,
+                      max_silence=max_silence)
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=n_train)
+    common = dict(
+        epochs=epochs, batch_size=per_rank, learning_rate=1e-2,
+        momentum=0.9, random_sampler=True, log_every_epoch=False,
+        epochs_per_dispatch=8,
+    )
+
+    variants = [
+        ("dpsgd", dict(algo="dpsgd")),
+        ("eventgrad", dict(algo="eventgrad", event_cfg=cfg)),
+        ("eventgrad_stale", dict(algo="eventgrad", event_cfg=cfg,
+                                 staleness=1)),
+        ("eventgrad_bf16", dict(algo="eventgrad", event_cfg=cfg,
+                                wire="bf16")),
+        ("eventgrad_stale_bf16", dict(algo="eventgrad", event_cfg=cfg,
+                                      staleness=1, wire="bf16")),
+        ("spevent", dict(algo="sp_eventgrad", event_cfg=cfg,
+                         sparse_cfg=SparseConfig(10.0))),
+    ]
+
+    d = jax.devices()[0]
+    out = {
+        "op_point": {
+            "model": type(model_fn()).__name__, "topology": "ring8",
+            "global_batch": global_batch, "n_train": n_train,
+            "epochs_per_variant": epochs,
+            "trigger": {"horizon": horizon, "max_silence": max_silence,
+                        "warmup": 30},
+        },
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "variants": {},
+    }
+    path = os.path.join(
+        REPO, "artifacts", f"flagship_overhead_r5_{d.platform}.json"
+    )
+    for name, kw in variants:
+        t0 = time.perf_counter()
+        _, hist = train(model_fn(), topo, x, y, **common, **kw)
+        wall = time.perf_counter() - t0
+        steady = steady_records(hist)
+        rec = {
+            "step_ms": round(1000 * float(np.mean(
+                [h["wall_s"] / h["steps"] for h in steady]
+            )), 3),
+            "wall_s": round(wall, 1),
+            "final_loss": round(hist[-1]["loss"], 4),
+        }
+        if "msgs_saved_pct" in hist[-1]:
+            rec["msgs_saved_pct"] = round(hist[-1]["msgs_saved_pct"], 2)
+        out["variants"][name] = rec
+        print(json.dumps({name: rec}), flush=True)
+        # publish incrementally: a tunnel wedge mid-matrix keeps the
+        # completed variants
+        base = out["variants"].get("dpsgd", {}).get("step_ms")
+        for vn, vr in out["variants"].items():
+            if base:
+                vr["vs_dpsgd"] = round(vr["step_ms"] / base, 4)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,26 @@ def main() -> None:
            "horizon": horizon, "max_silence": max_silence,
            "warmup_passes": 30, "epochs_per_dispatch": k_disp}
 
+    out_name = sys.argv[2] if len(sys.argv) > 2 else "tpu_flagship.json"
+    if out["platform"] != "tpu":
+        # a non-chip run (smoke/ALLOW_CPU, any argv) must never write the
+        # artifact names bench.py embeds and the watcher's rungs gate on
+        out_name = "tpu_flagship_smoke.json"
+    path = os.path.join(art, out_name)
+
+    def publish() -> None:
+        # atomic publish: bench.py may read this file concurrently (it
+        # embeds the artifact as tpu_flagship_cached); never let it see a
+        # half-write. Called after EVERY leg — the round-4 full capture
+        # died to a mid-run device fault with publish() at the end and
+        # lost an 850 s eventgrad leg; the tunnel is flaky by nature, so
+        # every completed leg is published immediately.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+
     t0 = time.perf_counter()
     state, hist = train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,
                         **common)
@@ -147,24 +167,7 @@ def main() -> None:
     out["chip_peak_flops"] = peak or None
     got = mfu(flops, step_s) if flops else None
     out["mfu_eventgrad"] = round(got, 4) if got else None
-
-    # profiler trace over a couple of steady-state epochs. Skippable
-    # (EG_FLAGSHIP_TRACE=0): the watcher's quick rung wants the cheapest
-    # possible artifact and must not mix a small-scale trace into the
-    # committed full-scale trace dir.
-    if os.environ.get("EG_FLAGSHIP_TRACE", "0" if smoke else "1") != "0":
-        trace_dir = os.path.join(art, "tpu_trace")
-        try:
-            # 4 epochs -> two 2-epoch blocks: the second block is a warm
-            # K-epoch dispatch, so the trace shows the round-5 dispatch
-            # pattern (device-resident gathers, no per-epoch H2D), not the
-            # compile
-            with profiling.trace(trace_dir):
-                train(model, topo, x, y, algo="eventgrad", event_cfg=cfg,
-                      **dict(common, epochs=4))
-            out["trace_dir"] = os.path.relpath(trace_dir, repo)
-        except Exception as e:  # tracing over the tunnel may be unsupported
-            out["trace_error"] = repr(e)
+    publish()
 
     t0 = time.perf_counter()
     state_d, hist_d = train(model, topo, x, y, algo="dpsgd", **common)
@@ -189,28 +192,39 @@ def main() -> None:
     out["collapsed_cifar"] = collapse_verdict(
         [h["loss"] for h in hist], hist_d[-1]["loss"]
     )
-
-    out_name = sys.argv[2] if len(sys.argv) > 2 else "tpu_flagship.json"
-    if out["platform"] != "tpu":
-        # a non-chip run (smoke/ALLOW_CPU, any argv) must never write the
-        # artifact names bench.py embeds and the watcher's rungs gate on
-        out_name = "tpu_flagship_smoke.json"
-    path = os.path.join(art, out_name)
-
-    def publish() -> None:
-        # atomic publish: bench.py may read this file concurrently (it
-        # embeds the artifact as tpu_flagship_cached); never let it see a
-        # half-write
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(out, f, indent=1)
-        os.replace(tmp, path)
-
-    # the ResNet legs are the expensive, hard-won part — publish them NOW
-    # so a tunnel wedge inside the added MNIST leg below cannot discard
-    # the whole window (the watcher's artifact-gated rung accepts this
-    # partial publish; the MNIST leg then republishes additively)
     publish()
+
+    # E5 sparsified leg at the same op-point (round-4 verdict missing #2:
+    # sp_eventgrad had never touched the chip) — top-k 10%, the reference's
+    # spevent default (spevent.cpp:60). Skippable for the cheapest quick
+    # rung (EG_FLAGSHIP_SPEVENT=0). After the headline pair: a wedge here
+    # must not cost the eventgrad/dpsgd evidence.
+    if os.environ.get("EG_FLAGSHIP_SPEVENT", "1") != "0":
+        from eventgrad_tpu.parallel.sparsify import SparseConfig
+
+        t0 = time.perf_counter()
+        state_s, hist_s = train(
+            model, topo, x, y, algo="sp_eventgrad", event_cfg=cfg,
+            sparse_cfg=SparseConfig(10.0), **common,
+        )
+        out["wall_s_spevent"] = round(time.perf_counter() - t0, 1)
+        cons_s = consensus_params(state_s.params)
+        stats_s = rank0_slice(state_s.batch_stats)
+        out["test_acc_spevent"] = round(
+            evaluate(model, cons_s, stats_s, xt, yt)["accuracy"], 2
+        )
+        out["spevent_msgs_saved_pct"] = round(hist_s[-1]["msgs_saved_pct"], 2)
+        out["spevent_sent_bytes_per_step"] = round(
+            hist_s[-1]["sent_bytes_per_step_per_chip"], 1
+        )
+        out["step_ms_spevent"] = round(1000 * float(np.mean(
+            [h["wall_s"] / h["steps"] for h in steady_records(hist_s)]
+        )), 3)
+        out["spevent_final_loss"] = round(hist_s[-1]["loss"], 4)
+        out["spevent_acc_gap_vs_dpsgd"] = round(
+            out["test_acc_spevent"] - out["test_acc_dpsgd"], 2
+        )
+        publish()
 
     # MNIST claim leg, live on the same window: the ~70% headline's exact
     # full-scale op-point (events.MNIST_FULLSCALE_OP_POINT — CNN-2,
