@@ -71,6 +71,12 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       (pick_cifar_epochs, pick_mnist_rung). Manual
                       full-scale run: EG_BENCH_CHILD=1
                       EG_BENCH_ATTEMPT_S=3600 EG_BENCH_TIER=full
+  EG_BENCH_CHAOS      chaos mode (robustness instead of savings): run the
+                      tools/chaos_sweep.py drop-rate/recovery sweep and
+                      emit ITS record as the last JSON line. "1" =
+                      default points, or a comma list of drop rates
+                      ("0,0.1,0.3"). In-process (no supervisor): the
+                      sweep is a deterministic CPU-scale miniature.
 Legacy aliases EG_BENCH_TINY=1 / EG_BENCH_CPU=1 map to tier tiny/reduced.
 Identical behavior from `python bench.py` and the driver's invocation:
 every knob above has exactly one default, read in one place.
@@ -526,6 +532,12 @@ def main() -> None:
                 "step_ms": round(1000 * step_s, 2),
                 "step_ms_dpsgd": round(1000 * step_s_d, 2),
                 "step_overhead_ratio": round(step_s / step_s_d, 4),
+                # every block was cold (steady_records fell back): the
+                # step timings above include compile contamination
+                "steady_contaminated": bool(
+                    any(h.get("steady_contaminated") for h in steady)
+                    or any(h.get("steady_contaminated") for h in steady_d)
+                ),
                 "mfu": mfu,
                 "flops_per_step": flops or None,
                 "chip_peak_flops": peak or None,
@@ -885,8 +897,41 @@ def _supervised() -> None:
     _maybe_upgrade(err_rec)
 
 
+def _chaos_mode() -> None:
+    """Chaos bench mode: the robustness sweep (drop-rate vs accuracy +
+    recovery latency, tools/chaos_sweep.py) replaces the savings headline;
+    schedules are serialized into the record so the run replays.
+    EG_BENCH_CHAOS=1 -> default points, or a comma list of drop rates
+    ("0,0.1,0.3,0.6"). Runs in-process, no supervisor: the sweep is a
+    deterministic CPU-scale miniature (~30 s) with none of the
+    accelerator-tunnel wedge risk the supervisor exists for. Result is
+    the LAST JSON line, the same contract as every other bench mode."""
+    from eventgrad_tpu.utils import compile_cache
+
+    compile_cache.honor_cpu_pin()
+    compile_cache.enable()
+    from tools.chaos_sweep import run_sweep
+
+    spec = os.environ["EG_BENCH_CHAOS"]
+    drops = (
+        tuple(float(d) for d in spec.split(","))
+        if spec != "1" else (0.0, 0.2, 0.5)
+    )
+    art = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts",
+        f"chaos_sweep_{jax.default_backend()}.json",
+    )
+    out = run_sweep(drops, out_path=art)
+    out["config"] = "chaos"
+    print(json.dumps(out), flush=True)
+
+
 if __name__ == "__main__":
-    if os.environ.get("EG_BENCH_CHILD") == "1":
+    # "0"/unset = off, matching the EG_BENCH_CHILD-style on/off convention
+    # (a disable attempt must run the normal bench, not crash chaos mode)
+    if os.environ.get("EG_BENCH_CHAOS", "0") != "0":
+        _chaos_mode()
+    elif os.environ.get("EG_BENCH_CHILD") == "1":
         main()
     else:
         _supervised()

@@ -244,24 +244,42 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
                                               "xla_ms")}})
     # sanity pass (ADVICE r4: a broken xla baseline — 0.017 ms at T=512,
     # ~200x below the same-shape full-depth grid — was committed into the
-    # dispatch table): attention cost grows ~t^2, so within a mode an
-    # xla_ms more than 8x below the quadratic back-projection of the next
-    # LARGER t is a broken measurement; impute t^2-scaled and re-verdict.
+    # dispatch table): attention cost grows ~t^2, so every xla_ms should
+    # sit near one shared t^2-normalized cost. The r4 rule trusted the
+    # next-LARGER t as its back-projection anchor, so a broken-low
+    # largest-t entry escaped detection AND corrupted the check for its
+    # smaller neighbor (ADVICE r5 #3); the MEDIAN normalized cost across
+    # the sweep is anchor-free — any single broken entry, including the
+    # largest t, lands >8x below it and gets imputed from the healthy
+    # majority.
+    def _median(vals):
+        s = sorted(vals)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
     for mode in ("fwd", "fwd_bwd"):
         es = sorted((e for e in entries if e["mode"] == mode),
                     key=lambda e: e["t"])
-        for a, bigger in zip(es, es[1:]):
-            expect = bigger["xla_ms"] / (bigger["t"] / a["t"]) ** 2
-            if a["xla_ms"] < expect / 8.0:
-                a["xla_ms_broken"] = a["xla_ms"]
-                a["xla_ms"] = round(expect, 3)
-                a["xla_ms_imputed"] = True
-                a["pallas"] = bool(
-                    a["pallas_ms"] is not None and a["pallas_ms"] < a["xla_ms"]
-                )
-                _emit({"kernel": f"flash_{mode}", "config": f"T{a['t']}:sanity",
-                       "xla_ms_broken": a["xla_ms_broken"],
-                       "xla_ms_imputed": a["xla_ms"]})
+        if len(es) < 2:
+            continue  # a single entry has nothing to cross-check against
+        med = _median([e["xla_ms"] / e["t"] ** 2 for e in es])
+        broken = [e for e in es if e["xla_ms"] < med * e["t"] ** 2 / 8.0]
+        if not broken:
+            continue
+        healthy = [
+            e["xla_ms"] / e["t"] ** 2 for e in es if e not in broken
+        ]
+        impute_cost = _median(healthy) if healthy else med
+        for a in broken:
+            a["xla_ms_broken"] = a["xla_ms"]
+            a["xla_ms"] = round(impute_cost * a["t"] ** 2, 3)
+            a["xla_ms_imputed"] = True
+            a["pallas"] = bool(
+                a["pallas_ms"] is not None and a["pallas_ms"] < a["xla_ms"]
+            )
+            _emit({"kernel": f"flash_{mode}", "config": f"T{a['t']}:sanity",
+                   "xla_ms_broken": a["xla_ms_broken"],
+                   "xla_ms_imputed": a["xla_ms"]})
     if jax.devices()[0].platform == "tpu":
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "eventgrad_tpu", "ops", "flash_tuning.json")

@@ -1,0 +1,43 @@
+"""Chaos subsystem: deterministic fault injection and recovery for gossip.
+
+EventGraD's core claim is stale-tolerance: a receiver that misses a send
+keeps mixing with the last value it got (PAPER.md; the zero-initialized RMA
+window of event.cpp:177-179 already exercises this on pass 1). That is the
+exact failure semantics of a lossy network, so this package makes loss a
+first-class, *measured* property instead of a hope:
+
+  * `schedule` — seeded, fully reproducible fault schedules (per-edge drop
+    probability, flaky windows, k-pass delivery thinning, permanent peer
+    death), serializable into bench records so every run is replayable.
+  * `inject`   — JIT-compatible injection that masks gossip edges inside
+    the mixing step; a dropped message is "receiver keeps its stale
+    buffer", composing with the fired/not-fired mask of
+    `parallel.events.decide_and_update` in one fused program.
+  * `monitor`  — peer-health tracking: per-edge silence counters, injected
+    drop counters, and a consensus-error probe `||p_i - mean(p)||` that
+    distinguishes "quiet because the threshold says so" from "quiet
+    because the link is dead".
+  * `policy`   — recovery: receiver-side forced full-sync (generalizing
+    the sender-side `max_silence` knob), edge-freeze with renormalized mix
+    weights, and ring heal on permanent death (survivors bridge the gap
+    via a rewritten `Topology`).
+
+Entry points: `train.loop.train(chaos=..., chaos_policy=...)`, the CLI's
+`--chaos/--chaos-sync-after/--chaos-freeze-after` flags, `bench.py`'s
+EG_BENCH_CHAOS mode, and `tools/chaos_sweep.py` (drop-rate vs accuracy and
+recovery-latency curves). Fault model and formats: docs/chaos.md.
+"""
+
+from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.chaos.monitor import PeerHealth, consensus_error
+from eventgrad_tpu.chaos.policy import RecoveryPolicy, heal_ring, apply_ring_heal
+
+__all__ = [
+    "ChaosSchedule",
+    "FlakyWindow",
+    "PeerHealth",
+    "RecoveryPolicy",
+    "consensus_error",
+    "heal_ring",
+    "apply_ring_heal",
+]

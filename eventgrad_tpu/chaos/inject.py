@@ -1,0 +1,147 @@
+"""JIT-compatible fault injection inside the gossip mixing step.
+
+A dropped message is implemented as "the receiver keeps its stale buffer":
+`collectives.masked_neighbor_vals` already selects
+`where(neighbor_fired, payload, stale)` per edge, so injection just ANDs a
+per-edge `delivered` bit into that select — one fused program handles both
+event-triggered silence and injected loss, and an injected drop is
+*bitwise-identical* to an event that did not fire (tests/test_chaos.py).
+
+Determinism: the delivered bit for (pass, receiver rank, edge index) is a
+pure function of the schedule seed via counter-style `fold_in` chains —
+no carried RNG state, so the scan body stays shape-stable and the whole
+schedule replays from its serialized form. `delivery_table` computes the
+same bits on the host (same ops, same seeds) for replay analysis and
+tests.
+
+Everything here runs under `jax.vmap(axis_name=...)` and `jax.shard_map`
+alike: rank identity comes from `lax.axis_index` on the topology's named
+axes, exactly like the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from eventgrad_tpu.chaos.schedule import ChaosSchedule
+from eventgrad_tpu.parallel.topology import Topology
+
+#: fold_in tags separating the independent per-schedule random streams
+#: (drop draws vs. delivery-thinning phases); arbitrary but frozen —
+#: changing them changes every serialized schedule's replay.
+_TAG_DROP = 0x5EED
+_TAG_PHASE = 0x9A5E
+
+
+def rank_and_sources(topo: Topology) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(my flat rank, per-edge source flat rank [n_neighbors]) from inside
+    the SPMD context — the traced twin of `Topology.neighbor_source`'s
+    row-major arithmetic."""
+    coords = [lax.axis_index(a) for a in topo.axes]
+
+    def ravel(cs) -> jnp.ndarray:
+        r = jnp.int32(0)
+        for c, size in zip(cs, topo.shape):
+            r = r * size + c.astype(jnp.int32)
+        return r
+
+    srcs = []
+    for nb in topo.neighbors:
+        ax = topo.axes.index(nb.axis)
+        shifted = list(coords)
+        shifted[ax] = (coords[ax] + nb.offset) % topo.shape[ax]
+        srcs.append(ravel(shifted))
+    me = ravel(coords)
+    if not srcs:  # neighborless topology: keep a well-formed empty vector
+        return me, jnp.zeros((0,), jnp.int32)
+    return me, jnp.stack(srcs)
+
+
+def delivery_mask(
+    sched: ChaosSchedule,
+    topo: Topology,
+    pass_num: jnp.ndarray,
+    rank: Optional[jnp.ndarray] = None,
+    srcs: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-edge delivered bits (bool [n_neighbors]) for the current pass.
+
+    Inside the SPMD step leave `rank`/`srcs` None (derived from
+    `lax.axis_index`); the host-side `delivery_table` passes them
+    explicitly so both paths run the identical fold_in chain. A True bit
+    means "a message sent on this edge this pass arrives"; the event
+    fire bit still decides whether anything WAS sent.
+    """
+    n_nb = topo.n_neighbors
+    if rank is None or srcs is None:
+        rank, srcs = rank_and_sources(topo)
+    rank = jnp.asarray(rank, jnp.int32)
+    srcs = jnp.asarray(srcs, jnp.int32)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    key = jax.random.PRNGKey(sched.seed)
+
+    # iid drop draw, one uniform per (pass, receiver, edge)
+    u = jax.random.uniform(
+        jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(key, _TAG_DROP), pass_i),
+            rank,
+        ),
+        (n_nb,),
+    )
+    p = jnp.full((n_nb,), sched.drop_p, jnp.float32)
+    for w in sched.flaky:
+        in_window = (pass_i >= w.start_pass) & (pass_i < w.end_pass)
+        p = jnp.where(in_window, jnp.maximum(p, jnp.float32(w.drop_p)), p)
+    deliver = u >= p  # u in [0, 1): drop_p == 0 can never drop
+
+    if sched.deliver_every > 1:
+        # k-pass thinning: each edge refreshes only when the pass hits its
+        # seed-derived phase — staleness up to k-1 extra passes
+        phase = jax.random.randint(
+            jax.random.fold_in(
+                jax.random.fold_in(key, _TAG_PHASE), rank
+            ),
+            (n_nb,), 0, sched.deliver_every,
+        )
+        deliver = deliver & ((pass_i % sched.deliver_every) == phase)
+
+    for dead_rank, t in sched.death:
+        dead_now = pass_i >= t
+        # a dead peer neither sends (its outgoing edges drop) nor receives
+        # (every edge INTO it drops too); its rows are excluded at
+        # heal/consensus time (policy.heal_ring, survivor evaluation)
+        deliver = deliver & ~(dead_now & (srcs == dead_rank))
+        deliver = deliver & ~(dead_now & (rank == dead_rank))
+    return deliver
+
+
+def delivery_table(
+    sched: ChaosSchedule, topo: Topology, n_passes: int, start_pass: int = 1
+) -> np.ndarray:
+    """Host-side replay of the full schedule: bool [n_passes, n_ranks,
+    n_neighbors], pass axis starting at `start_pass` (passes are 1-based
+    in the step, event.cpp:273). Runs the exact fold_in chain of
+    `delivery_mask`, so it IS the ground truth of what a run saw."""
+    srcs = np.array(
+        [
+            [topo.neighbor_source(r, nb) for nb in topo.neighbors]
+            for r in range(topo.n_ranks)
+        ],
+        np.int32,
+    ).reshape(topo.n_ranks, topo.n_neighbors)
+    out = np.zeros((n_passes, topo.n_ranks, topo.n_neighbors), bool)
+    fn = jax.jit(
+        lambda p, r, s: delivery_mask(sched, topo, p, rank=r, srcs=s),
+        static_argnums=(),
+    )
+    for pi in range(n_passes):
+        for r in range(topo.n_ranks):
+            out[pi, r] = np.asarray(
+                fn(jnp.int32(start_pass + pi), jnp.int32(r), srcs[r])
+            )
+    return out

@@ -1,0 +1,148 @@
+"""Peer-health monitoring: who has gone quiet, and why it matters.
+
+An event-triggered link is *supposed* to go quiet — that is the whole
+savings claim — so a receiver cannot read "no message" as "peer dead".
+What it CAN know:
+
+  * the sender-side trigger bounds silence: with `EventConfig.max_silence
+    = M > 0` every parameter fires at least every M passes, so a healthy
+    incoming edge is silent at most M consecutive passes (plus wire loss);
+  * therefore observed silence far beyond M is evidence of a dead or
+    lossy link, not a quiet threshold.
+
+`PeerHealth` carries that evidence through the jitted scan: per-edge
+silence counters (passes since the last *delivered* payload), a count of
+injected drops actually observed (schedule ground truth, for artifacts),
+and the force-fire request bit of `policy.RecoveryPolicy.sync_after`
+(receiver-side forced full-sync, applied by the sender one pass later).
+
+The consensus-error probe `||p_i - mean(p)||` is the host-side
+ground-truth drift metric, logged into the per-epoch history records
+(through the same JSONL stream as every other metric) at dispatch-block
+ends.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.topology import NeighborSpec, Topology
+
+
+class PeerHealth(struct.PyTreeNode):
+    """Per-rank receiver-side link state, threaded through the train scan.
+
+    silence:  int32 [n_neighbors] — passes since a payload last ARRIVED on
+              each incoming edge (an undelivered or unfired pass counts).
+    sync_req: bool [] — some neighbor asked this rank to force-fire next
+              pass (set via the reverse-edge gossip of `sync_requests`).
+    drops:    int32 [] — cumulative injected drops observed on this rank's
+              incoming edges (messages that WERE sent but the schedule ate).
+    """
+
+    silence: jnp.ndarray
+    sync_req: jnp.ndarray
+    drops: jnp.ndarray
+
+    @classmethod
+    def init(cls, topo: Topology) -> "PeerHealth":
+        return cls(
+            silence=jnp.zeros((topo.n_neighbors,), jnp.int32),
+            sync_req=jnp.zeros((), bool),
+            drops=jnp.zeros((), jnp.int32),
+        )
+
+
+def update(
+    health: PeerHealth,
+    delivered_any: jnp.ndarray,
+    dropped_any: jnp.ndarray,
+) -> PeerHealth:
+    """Advance the counters one pass. `delivered_any`/`dropped_any` are
+    bool [n_neighbors]: did any parameter's payload arrive / get eaten by
+    the schedule on each edge this pass."""
+    return health.replace(
+        silence=jnp.where(delivered_any, 0, health.silence + 1),
+        drops=health.drops + jnp.sum(dropped_any.astype(jnp.int32)),
+    )
+
+
+def sync_requests(need: jnp.ndarray, topo: Topology) -> jnp.ndarray:
+    """Gossip each rank's per-edge force-sync requests back to the edge
+    SOURCES; returns this rank's aggregated incoming request (bool []).
+
+    My incoming edge with shift `+o` sources from rank `me+o`; my request
+    about it must land on that rank, which receives it via the REVERSE
+    shift `-o` (ppermute pairs always come in +-o pairs on a gossip axis,
+    so the reverse edge exists by construction). One bool per edge on the
+    wire — the cheapest possible control channel, and still a collective,
+    so it is SPMD-legal under vmap and shard_map alike.
+    """
+    got = jnp.zeros((), bool)
+    for i, nb in enumerate(topo.neighbors):
+        rev = NeighborSpec(nb.axis, -nb.offset)
+        got = got | collectives.recv_from(need[i], topo, rev)
+    return got
+
+
+@jax.jit
+def consensus_error(stacked_params) -> jnp.ndarray:
+    """Per-rank consensus error ||p_i - mean_r(p_r)||_2 over the stacked
+    rank axis (f32 [n_ranks]) — the drift metric that tells a healthy
+    quiet network from a partitioned one. One fused dispatch."""
+    flat = jnp.concatenate(
+        [
+            x.reshape(x.shape[0], -1).astype(jnp.float32)
+            for x in jax.tree.leaves(stacked_params)
+        ],
+        axis=1,
+    )
+    return jnp.linalg.norm(flat - flat.mean(axis=0, keepdims=True), axis=1)
+
+
+def edge_status(
+    silence: int, max_silence: int, suspect_factor: float = 3.0
+) -> str:
+    """Host-side classification of one edge's observed silence:
+
+      'healthy'  — silence within the sender-side trigger bound (or the
+                   bound is off, in which case any silence is plausible
+                   threshold behavior and only 'unbounded' can be said);
+      'suspect'  — silence exceeds `suspect_factor` x the sender's
+                   max_silence guarantee: the link is losing messages or
+                   the peer is dead (policy should force-sync or freeze);
+      'unbounded'— no sender-side bound exists (max_silence == 0), so
+                   quiet-by-threshold and quiet-by-death are
+                   indistinguishable from silence alone: use the
+                   consensus-error probe instead.
+    """
+    if max_silence <= 0:
+        return "unbounded"
+    return "suspect" if silence > suspect_factor * max_silence else "healthy"
+
+
+def health_record(silence, drops, max_silence: int) -> Dict[str, object]:
+    """Summarize host-fetched PeerHealth counters into JSONL-ready fields:
+    per-edge max silence across ranks, its `edge_status` classification,
+    and the total injected-drop count. The ONE summarizer behind the
+    epoch records of train() and the sweep artifacts — `silence` is
+    [n_ranks, n_neighbors], `drops` any array of per-rank cumulative
+    counts."""
+    import numpy as np
+
+    silence = np.asarray(silence)
+    per_edge_max = (
+        silence.max(axis=0) if silence.size else np.zeros((0,), np.int64)
+    )
+    return {
+        "edge_silence_max": [int(v) for v in per_edge_max],
+        "edge_status": [
+            edge_status(int(v), max_silence) for v in per_edge_max
+        ],
+        "chaos_drops": int(np.asarray(drops).sum()),
+    }

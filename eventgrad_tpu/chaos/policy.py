@@ -1,0 +1,147 @@
+"""Recovery policies: forced sync, edge freeze, ring heal.
+
+Three escalating answers to a quiet edge, keyed off `monitor.PeerHealth`
+silence counters:
+
+  * forced full-sync (`sync_after`) — the receiver-side generalization of
+    `EventConfig.max_silence`: when an incoming edge has been silent
+    `sync_after` passes, the receiver gossips a 1-bit request back along
+    the reverse edge (`monitor.sync_requests`) and the sender force-fires
+    EVERY parameter on its next pass (`decide_and_update(force_fire=...)`),
+    refreshing the stale buffer through the normal exchange. Works through
+    loss (the request repeats every pass while silence persists), costs
+    real messages (counted in num_events — robustness spends savings).
+
+  * edge freeze (`freeze_after`) — when silence exceeds the bound, the
+    edge's stale buffer leaves the mix and the weights renormalize:
+    p <- (p + sum(alive bufs)) / (1 + n_alive)  (collectives.mix_weighted)
+    instead of averaging in a years-old value forever. Un-freezes itself
+    the moment a payload arrives again (silence resets) — a flaky window
+    ends and the edge rejoins.
+
+  * ring heal (`heal_ring` / `apply_ring_heal`) — permanent peer death:
+    survivors bridge the gap by rewriting the `Topology` to the (n-1)-rank
+    ring and slicing the dead rank's rows out of the stacked state. The
+    healed ring's `neighbor_source` is exactly `Ring(n-1)`'s, so every
+    downstream collective just works; receive buffers are kept (stale
+    values are legal gossip input by construction, event.cpp:177-179) and
+    refresh within one fire cycle, while PeerHealth silence resets so the
+    new edges start healthy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.parallel.topology import Ring, Topology
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    """Receiver-side recovery bounds (0 disables a mechanism).
+
+    Both bounds should comfortably exceed the sender's
+    `EventConfig.max_silence` guarantee (see `monitor.edge_status`):
+    below it they would fight legitimate event-triggered silence and
+    spend messages on healthy links.
+    """
+
+    sync_after: int = 0
+    freeze_after: int = 0
+
+    def __post_init__(self):
+        if self.sync_after < 0 or self.freeze_after < 0:
+            raise ValueError(
+                f"recovery bounds must be >= 0, got {self}"
+            )
+
+    @property
+    def is_noop(self) -> bool:
+        return self.sync_after == 0 and self.freeze_after == 0
+
+    def validate_against(self, max_silence: int) -> None:
+        """Loud guard: bounds at or below the sender-side silence
+        guarantee would force-sync/freeze healthy edges every cycle."""
+        for name, bound in (
+            ("sync_after", self.sync_after),
+            ("freeze_after", self.freeze_after),
+        ):
+            if bound and max_silence and bound <= max_silence:
+                raise ValueError(
+                    f"{name}={bound} is within the sender's "
+                    f"max_silence={max_silence} guarantee: healthy "
+                    "event-triggered silence would trip it every cycle "
+                    f"(use {name} > max_silence)"
+                )
+
+    def to_dict(self) -> dict:
+        return {
+            "sync_after": self.sync_after,
+            "freeze_after": self.freeze_after,
+        }
+
+
+def alive_mask(health_silence: jnp.ndarray, policy: "RecoveryPolicy"):
+    """bool [n_neighbors]: edges whose buffers stay in the mix. With
+    freeze disabled this is None (callers keep the untouched mix path,
+    which is bitwise-identical to pre-chaos trajectories)."""
+    if not policy.freeze_after:
+        return None
+    return health_silence < policy.freeze_after
+
+
+def heal_ring(
+    topo: Topology, dead: Iterable[int]
+) -> Tuple[Topology, Tuple[int, ...]]:
+    """Rewrite a ring topology without the dead ranks.
+
+    Returns (healed topology, survivors) where survivors[j] is the OLD
+    flat rank now living at healed rank j: surviving neighbors bridge the
+    gap, i.e. healed `neighbor_source` is `Ring(n_survivors)`'s, which in
+    old-rank terms wires each survivor to the cyclically-next survivor.
+    Ring (single-gossip-axis) topologies only — a torus heal has
+    non-unique bridge choices and is future work.
+    """
+    dead_set = set(int(d) for d in dead)
+    if len(topo.gossip_axes) != 1 or len(topo.axes) != 1:
+        raise ValueError(
+            f"heal_ring handles single-axis rings; got axes {topo.axes}"
+        )
+    bad = [d for d in dead_set if not 0 <= d < topo.n_ranks]
+    if bad:
+        raise ValueError(f"dead ranks {bad} outside 0..{topo.n_ranks - 1}")
+    survivors = tuple(r for r in range(topo.n_ranks) if r not in dead_set)
+    if len(survivors) < 2:
+        raise ValueError(
+            f"cannot heal: only {len(survivors)} of {topo.n_ranks} ranks "
+            "survive (a ring needs >= 2)"
+        )
+    return Ring(len(survivors), axis=topo.axes[0]), survivors
+
+
+def apply_ring_heal(state, topo: Topology, dead: Iterable[int]):
+    """Slice a stacked train state down to the survivors of a ring heal.
+
+    Returns (healed state, healed topology, survivors). Every leaf keeps
+    its meaning — params/optimizer/event thresholds are per-rank rows;
+    receive buffers now face the bridged neighbors and are stale until
+    the next fire, which gossip tolerates by construction. PeerHealth
+    silence resets so recovery policies don't instantly re-trip on the
+    fresh edges.
+    """
+    healed, survivors = heal_ring(topo, dead)
+    idx = jnp.asarray(np.asarray(survivors, np.int32))
+    new_state = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), state)
+    chaos = getattr(new_state, "chaos", None)
+    if chaos is not None:
+        chaos = chaos.replace(
+            silence=jnp.zeros_like(chaos.silence),
+            sync_req=jnp.zeros_like(chaos.sync_req),
+        )
+        new_state = new_state.replace(chaos=chaos)
+    return new_state, healed, survivors

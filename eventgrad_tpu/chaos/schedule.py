@@ -1,0 +1,189 @@
+"""Seeded, fully reproducible fault schedules for the gossip path.
+
+A schedule is pure data: it names WHAT faults happen WHEN, never how they
+are applied (that is `chaos.inject`). Everything is deterministic in
+(seed, pass, receiver rank, edge index), so two runs of the same schedule
+see bit-identical faults, and a schedule serialized into a bench record
+replays exactly.
+
+Fault vocabulary (all composable):
+
+  * `drop_p`       — iid per-edge per-pass message-drop probability.
+  * `flaky`        — windows `[start_pass, end_pass)` during which the
+                     drop probability is raised to `max(drop_p, window p)`
+                     (a link that flakes hard for a while, then recovers).
+  * `deliver_every`— k-pass delivery thinning: an edge refreshes its
+                     receive buffer at most every k passes (per-edge phase
+                     derived from the seed), i.e. staleness up to k-1
+                     extra passes. This is the deterministic stand-in for
+                     k-pass delayed delivery: a true queueing delay would
+                     need k in-flight payload copies per edge, while
+                     EventGraD's stale-buffer semantics make "late" and
+                     "thinned" equivalent from the mixing step's view.
+  * `death`        — permanent peer death at pass T: from T on, the rank
+                     neither sends nor receives (every edge touching it is
+                     masked). Recovery is `policy.heal_ring`.
+
+CLI spec grammar (comma-separated clauses, see `parse`):
+
+    drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500
+
+Multiple `flaky=` / `die=` clauses accumulate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FlakyWindow:
+    """Drop probability raised to `drop_p` for passes in [start, end)."""
+
+    start_pass: int
+    end_pass: int
+    drop_p: float = 1.0
+
+    def __post_init__(self):
+        if self.start_pass < 0 or self.end_pass < self.start_pass:
+            raise ValueError(
+                f"flaky window [{self.start_pass}, {self.end_pass}) invalid"
+            )
+        if not 0.0 <= self.drop_p <= 1.0:
+            raise ValueError(f"flaky drop_p {self.drop_p} outside [0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    """A replayable fault schedule. `death` is ((rank, pass), ...) pairs."""
+
+    seed: int = 0
+    drop_p: float = 0.0
+    flaky: Tuple[FlakyWindow, ...] = ()
+    deliver_every: int = 1
+    death: Tuple[Tuple[int, int], ...] = ()
+
+    def __post_init__(self):
+        if not 0.0 <= self.drop_p <= 1.0:
+            raise ValueError(f"drop_p {self.drop_p} outside [0, 1]")
+        if self.deliver_every < 1:
+            raise ValueError(f"deliver_every must be >= 1, got {self.deliver_every}")
+        # normalize so equal schedules compare/serialize equal
+        object.__setattr__(
+            self, "flaky",
+            tuple(sorted(self.flaky, key=lambda w: (w.start_pass, w.end_pass))),
+        )
+        object.__setattr__(self, "death", tuple(sorted(self.death)))
+        for r, t in self.death:
+            if r < 0 or t < 0:
+                raise ValueError(f"death ({r}, {t}) invalid")
+
+    @property
+    def is_noop(self) -> bool:
+        """True when the schedule injects nothing (the drop-rate-0 regression
+        point: the trajectory must be bitwise-identical to chaos=None)."""
+        return (
+            self.drop_p == 0.0
+            and not self.flaky
+            and self.deliver_every == 1
+            and not self.death
+        )
+
+    def dead_ranks(self, up_to_pass: int) -> Tuple[int, ...]:
+        """Ranks whose death pass is <= `up_to_pass` (host-side helper for
+        heal decisions and survivor-consensus evaluation)."""
+        return tuple(sorted({r for r, t in self.death if t <= up_to_pass}))
+
+    # --- serialization (bench records / artifacts) ---------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "drop_p": self.drop_p,
+            "flaky": [
+                [w.start_pass, w.end_pass, w.drop_p] for w in self.flaky
+            ],
+            "deliver_every": self.deliver_every,
+            "death": [list(d) for d in self.death],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosSchedule":
+        return cls(
+            seed=int(d.get("seed", 0)),
+            drop_p=float(d.get("drop_p", 0.0)),
+            flaky=tuple(
+                FlakyWindow(int(s), int(e), float(p))
+                for s, e, p in d.get("flaky", ())
+            ),
+            deliver_every=int(d.get("deliver_every", 1)),
+            death=tuple(
+                (int(r), int(t)) for r, t in d.get("death", ())
+            ),
+        )
+
+    # --- CLI spec round trip -------------------------------------------
+
+    def to_spec(self) -> str:
+        parts = [f"drop={self.drop_p:g}", f"seed={self.seed}"]
+        for w in self.flaky:
+            parts.append(f"flaky={w.start_pass}-{w.end_pass}@{w.drop_p:g}")
+        if self.deliver_every != 1:
+            parts.append(f"delay={self.deliver_every}")
+        for r, t in self.death:
+            parts.append(f"die={r}@{t}")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSchedule":
+        """Parse the CLI grammar, e.g. `drop=0.2,seed=7,flaky=10-20@0.8`."""
+        kw: Dict[str, Any] = {"flaky": [], "death": []}
+        for clause in spec.split(","):
+            clause = clause.strip()
+            if not clause:
+                continue
+            key, sep, val = clause.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"bad chaos clause {clause!r} (expected key=value)"
+                )
+            try:
+                if key == "drop":
+                    kw["drop_p"] = float(val)
+                elif key == "seed":
+                    kw["seed"] = int(val)
+                elif key == "delay":
+                    kw["deliver_every"] = int(val)
+                elif key == "flaky":
+                    span, _, p = val.partition("@")
+                    s, _, e = span.partition("-")
+                    kw["flaky"].append(
+                        FlakyWindow(int(s), int(e), float(p) if p else 1.0)
+                    )
+                elif key == "die":
+                    r, _, t = val.partition("@")
+                    kw["death"].append((int(r), int(t)))
+                else:
+                    raise ValueError(f"unknown chaos key {key!r}")
+            except ValueError as err:
+                raise ValueError(
+                    f"bad chaos clause {clause!r}: {err}"
+                ) from None
+        kw["flaky"] = tuple(kw["flaky"])
+        kw["death"] = tuple(kw["death"])
+        return cls(**kw)
+
+
+def resolve(chaos) -> "ChaosSchedule":
+    """Accept a ChaosSchedule, a spec string, or a serialized dict — the one
+    coercion used by train(), the CLI, and the sweep tool."""
+    if isinstance(chaos, ChaosSchedule):
+        return chaos
+    if isinstance(chaos, str):
+        return ChaosSchedule.parse(chaos)
+    if isinstance(chaos, dict):
+        return ChaosSchedule.from_dict(chaos)
+    raise TypeError(
+        f"chaos must be a ChaosSchedule, spec string, or dict; got {type(chaos)}"
+    )

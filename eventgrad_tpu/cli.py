@@ -230,6 +230,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic-recovery drill: crash:N exits 13 after "
                         "epoch N (post-snapshot), hang:N stops making "
                         "progress — pair with eventgrad_tpu.supervise")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic gossip fault injection (chaos/): "
+                        "e.g. 'drop=0.2,seed=7,flaky=100-200@0.8,"
+                        "delay=3,die=3@500' — per-edge drop probability, "
+                        "flaky windows [start-end)@p, k-pass delivery "
+                        "thinning, permanent peer death; gossip algos "
+                        "(dpsgd/eventgrad) only. Replayable: the "
+                        "schedule is serialized into the first history "
+                        "record")
+    p.add_argument("--chaos-sync-after", type=int, default=0, metavar="N",
+                   help="recovery: an edge silent N passes makes the "
+                        "receiver request a forced full sync from that "
+                        "peer (eventgrad + --chaos; use N > "
+                        "--max-silence)")
+    p.add_argument("--chaos-freeze-after", type=int, default=0, metavar="N",
+                   help="recovery: an edge silent N passes leaves the "
+                        "mix with renormalized weights until it speaks "
+                        "again (requires --chaos)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler (XPlane/TensorBoard) trace "
                         "of the training run into this directory")
@@ -299,6 +317,46 @@ def main(argv=None) -> int:
                 "--trace-file records the synchronous exchange; not "
                 "available with --staleness"
             )
+    chaos_sched = None
+    chaos_policy = None
+    if args.chaos is not None:
+        from eventgrad_tpu.chaos import ChaosSchedule, RecoveryPolicy
+
+        if args.algo not in ("dpsgd", "eventgrad"):
+            raise SystemExit(
+                "--chaos injects loss into the gossip exchange; "
+                f"--algo {args.algo} has no maskable edges"
+            )
+        if args.fused:
+            raise SystemExit(
+                "--chaos is not combinable with --fused (the Pallas tail "
+                "bakes in the uniform mix weight)"
+            )
+        try:
+            chaos_sched = ChaosSchedule.parse(args.chaos)
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
+        if args.chaos_sync_after and args.algo != "eventgrad":
+            raise SystemExit(
+                "--chaos-sync-after rides the event fire decision; "
+                "dpsgd already sends everything every pass — a dropped "
+                "message there is final (use --chaos-freeze-after)"
+            )
+        if args.chaos_sync_after or args.chaos_freeze_after:
+            try:
+                chaos_policy = RecoveryPolicy(
+                    sync_after=args.chaos_sync_after,
+                    freeze_after=args.chaos_freeze_after,
+                )
+                chaos_policy.validate_against(args.max_silence)
+            except ValueError as e:
+                raise SystemExit(f"--chaos-sync-after/--chaos-freeze-after: {e}")
+    elif args.chaos_sync_after or args.chaos_freeze_after:
+        raise SystemExit(
+            "--chaos-sync-after/--chaos-freeze-after need --chaos (use "
+            "--chaos 'drop=0' for recovery monitoring without injected "
+            "faults)"
+        )
     if not is_lm and not args.model.startswith("resnet") and (
         args.num_classes != 10 or args.num_filters != 64
     ):
@@ -385,6 +443,7 @@ def main(argv=None) -> int:
             resume=args.resume, trace_file=args.trace_file,
             wire=args.wire, staleness=args.staleness,
             fused_update=args.fused, fault_inject=args.fault_inject,
+            chaos=chaos_sched, chaos_policy=chaos_policy,
             on_epoch=logger.log,  # records stream as epochs finish: live
             # metrics for the user, a liveness signal for supervise.py
         )

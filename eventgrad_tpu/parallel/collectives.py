@@ -164,6 +164,7 @@ def masked_neighbor_vals(
     last_bufs: Tuple[Any, ...],
     topo: Topology,
     wire=None,
+    deliver: "Optional[Any]" = None,
 ) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
     """Event-triggered exchange (EventGraD's RMA window, deterministic form).
 
@@ -178,6 +179,13 @@ def masked_neighbor_vals(
     is well-defined (and compressible); receivers never read torn data,
     unlike the reference's MPI_LOCK_SHARED races (event.cpp:348-360 vs
     :399-438) — staleness is explicit carried state instead.
+
+    `deliver` (chaos.inject): optional bool [n_neighbors] of per-edge
+    delivered bits — a False edge keeps its stale buffer even when the
+    sender fired, making an injected message drop bitwise-identical to an
+    event that did not fire. `recv_fires` stays the RAW sender bits
+    (what was on the wire), so callers can count injected drops as
+    `sent & ~delivered`.
     """
     masked = jax.tree.map(
         lambda p, f: jnp.where(f, p, jnp.zeros_like(p)), payload, fire
@@ -227,10 +235,15 @@ def masked_neighbor_vals(
             return _wire_in(got_p, masked), got_f
 
     new_bufs, recv_fires = [], []
-    for nb, last in zip(topo.neighbors, last_bufs):
+    for i, (nb, last) in enumerate(zip(topo.neighbors, last_bufs)):
         got_p, got_f = receive(nb)
+        eff_f = got_f
+        if deliver is not None:
+            eff_f = jax.tree.map(
+                lambda f, _d=deliver[i]: jnp.logical_and(f, _d), got_f
+            )
         buf = jax.tree.map(
-            lambda f, new, old: jnp.where(f, new, old), got_f, got_p, last
+            lambda f, new, old: jnp.where(f, new, old), eff_f, got_p, last
         )
         new_bufs.append(buf)
         recv_fires.append(got_f)
@@ -246,4 +259,26 @@ def mix(params: Any, bufs: Tuple[Any, ...], topo: Topology) -> Any:
     acc = params
     for buf in bufs:
         acc = jax.tree.map(jnp.add, acc, buf)
+    return jax.tree.map(lambda x: x * w, acc)
+
+
+def mix_weighted(params: Any, bufs: Tuple[Any, ...], gate: Any) -> Any:
+    """Gossip averaging over a data-dependent subset of edges:
+    p <- (p + sum(gate_i * buf_i)) / (1 + sum(gate_i)).
+
+    `gate` is bool [n_neighbors] (chaos.policy.alive_mask and the lossy
+    D-PSGD path): a gated-off edge leaves the mix entirely and the weight
+    renormalizes over the survivors, instead of averaging in a frozen
+    buffer forever. With every gate on this reproduces `mix` bitwise:
+    where(True, b, 0) == b, the adds run in the same order, and the f32
+    reciprocal of a small integer equals the cast Python double (both
+    correctly rounded to the same float32)."""
+    acc = params
+    for i, buf in enumerate(bufs):
+        acc = jax.tree.map(
+            lambda x, b, _g=gate[i]: x + jnp.where(_g, b, jnp.zeros_like(b)),
+            acc, buf,
+        )
+    n_alive = jnp.sum(gate.astype(jnp.float32))
+    w = 1.0 / (1.0 + n_alive)
     return jax.tree.map(lambda x: x * w, acc)

@@ -224,12 +224,19 @@ def decide_and_update(
     pass_num: jnp.ndarray,
     cfg: EventConfig,
     n_neighbors: int,
+    force_fire: "Any" = None,
 ) -> Tuple[Any, EventState]:
     """One pass of the sender state machine for every parameter at once.
 
     Returns (fire, new_state) where `fire` is a pytree of bools per param.
     `pass_num` is 1-based and already incremented for this pass, matching
     `pass_num++` at the top of the batch loop (event.cpp:273).
+
+    `force_fire` (optional bool scalar or [L]) ORs into the fire decision —
+    the receiver-side forced-full-sync channel of chaos.policy (a neighbor
+    whose silence bound tripped asked for fresh values last pass). Forced
+    fires update the sender state and event counters like any fire: the
+    wire cost of recovery is accounted, not hidden.
     """
     pass_f = pass_num.astype(jnp.float32)
 
@@ -252,6 +259,8 @@ def decide_and_update(
     fire_vec = (value_diff >= thres) | warm
     if cfg.max_silence > 0:  # bounded staleness (beyond-reference)
         fire_vec = fire_vec | (iter_diff >= cfg.max_silence)
+    if force_fire is not None:  # receiver-requested full sync (chaos.policy)
+        fire_vec = fire_vec | force_fire
 
     # slope ring buffer: drop oldest, append value_diff/iter_diff (:363-373)
     new_slopes = jnp.concatenate(

@@ -26,12 +26,15 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.chaos import schedule as chaos_schedule
+from eventgrad_tpu.chaos.policy import RecoveryPolicy
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.data.sharding import epoch_index_plan
 from eventgrad_tpu.parallel import multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
-from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.data.sharding import expand_to_mesh
 from eventgrad_tpu.train.state import init_train_state, init_train_state_spmd
@@ -187,6 +190,8 @@ def train(
     wire: "Optional[str]" = None,
     staleness: int = 0,
     fault_inject: Optional[str] = None,
+    chaos: Optional[Any] = None,
+    chaos_policy: Optional[RecoveryPolicy] = None,
     on_epoch: Optional[Any] = None,
     device_data: Optional[bool] = None,
     epochs_per_dispatch: int = 1,
@@ -208,6 +213,15 @@ def train(
     elastic-recovery story (eventgrad_tpu/supervise.py); the reference has neither
     (a dead rank just hangs its peers' MPI_Recv, decent.cpp:200-205).
 
+    chaos (a chaos.ChaosSchedule, spec string like "drop=0.2,seed=7", or
+    serialized dict) injects deterministic message loss INSIDE the gossip
+    step — the network-fault counterpart of fault_inject's process faults;
+    chaos_policy (chaos.RecoveryPolicy) adds receiver-side forced-sync /
+    edge-freeze recovery. History records gain per-edge silence maxima,
+    injected-drop counts, and a consensus-error probe at dispatch-block
+    ends; the first record carries the serialized schedule so the run is
+    replayable from its log alone. See docs/chaos.md.
+
     device_data=True uploads the full (cast) dataset to the device ONCE and
     ships only the per-epoch permutation index plan ([n_ranks, steps, batch]
     int32, ~KBs) per dispatch; batches are gathered on-device inside the
@@ -227,6 +241,7 @@ def train(
     boundaries (blocks are split there). fault_inject forces K=1 (the
     fault must land at an exact epoch boundary).
     """
+    chaos_sched = chaos_schedule.resolve(chaos) if chaos is not None else None
     fault_mode, fault_epoch = None, -1
     if fault_inject:
         fault_mode, _, n = fault_inject.partition(":")
@@ -274,6 +289,13 @@ def train(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
         input_dtype=input_dtype,
     )
+    if chaos_sched is not None:
+        # per-edge receiver-side health, stacked like every other state
+        # leaf (also the checkpoint-restore target shape: chaos runs
+        # snapshot and resume WITH their monitor counters)
+        state = state.replace(
+            chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
+        )
 
     multi = multihost.is_multiprocess()
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
@@ -323,6 +345,7 @@ def train(
         sync_bn=sync_bn, trace=trace_file is not None,
         fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
         wire_bf16=wire_bf16, wire=wire, staleness=staleness,
+        chaos=chaos_sched, chaos_policy=chaos_policy,
     )
     lifted = spmd(step, topo, mesh=mesh)
 
@@ -357,12 +380,6 @@ def train(
         K = min(K, total_epochs // 2)
     else:
         K = 1
-    if save_every and K > 1:
-        # blocks split at save points: keep K a divisor of save_every so
-        # block sizes REPEAT across save segments — otherwise every block
-        # could be a distinct (all-cold) size and no warm steady slice
-        # would exist
-        K = max(d for d in range(1, K + 1) if save_every % d == 0)
     if not device_data and K > 1:
         # host path: a K-epoch block materializes K stacked epoch copies
         # in host RAM + HBM at once (no resident-dataset dedup) — cap the
@@ -370,6 +387,15 @@ def train(
         K = max(1, min(K, int(os.environ.get(
             "EG_HOST_BLOCK_MAX_BYTES", str(1_500_000_000)
         )) // max(1, data_bytes)))
+    if save_every and K > 1:
+        # blocks split at save points: keep K a divisor of save_every so
+        # block sizes REPEAT across save segments — otherwise every block
+        # could be a distinct (all-cold) size and no warm steady slice
+        # would exist. Runs AFTER the host-RAM clamp (which only ever
+        # lowers K, preserving the memory bound): clamping second could
+        # leave a non-divisor K and pollute steady-state step math with
+        # extra cold blocks (ADVICE r5 #1).
+        K = max(d for d in range(1, K + 1) if save_every % d == 0)
 
     # donate the carried state: the scan updates params/opt/event state in
     # place instead of holding two copies in HBM (batches can't alias — the
@@ -520,6 +546,18 @@ def train(
                         topo.n_ranks,
                     )
                     rec["fired_frac"] = float(m_e["fired_frac"].mean())
+                if chaos_sched is not None:
+                    if not history:  # replayability: schedule rides record 1
+                        rec["chaos"] = chaos_sched.to_dict()
+                        if chaos_policy is not None:
+                            rec["chaos_policy"] = chaos_policy.to_dict()
+                    # silence/drops are carried state: the epoch's last
+                    # step is its end-of-epoch snapshot
+                    rec.update(chaos_monitor.health_record(
+                        np.asarray(m_e["edge_silence"])[-1],
+                        np.asarray(m_e["chaos_drops"])[-1],
+                        event_cfg.max_silence if event_cfg else 0,
+                    ))
                 if trace_file and "trace_fired" in m_e and multihost.is_primary():
                     _write_trace(
                         trace_file, m_e, total_passes - steps, topo, state,
@@ -537,6 +575,19 @@ def train(
                                     total_passes - steps, s_i, r, loss_all
                                 )) + "\n")
                 is_block_end = epoch == blk_end
+                if (
+                    chaos_sched is not None and is_block_end
+                    and not multi and not hybrid
+                ):
+                    # periodic consensus-error probe ||p_i - mean(p)||:
+                    # the ground-truth drift metric that tells "quiet
+                    # because the threshold says so" from "quiet because
+                    # the link is dead" (chaos/monitor.py)
+                    cerr = np.asarray(
+                        chaos_monitor.consensus_error(state.params)
+                    )
+                    rec["consensus_err_max"] = float(cerr.max())
+                    rec["consensus_err_mean"] = float(cerr.mean())
                 if (
                     x_test is not None and log_every_epoch and not multi
                     and not hybrid and is_block_end

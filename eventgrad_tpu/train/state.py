@@ -40,6 +40,8 @@ class TrainState(struct.PyTreeNode):
     rng: jax.Array
     event: Optional[EventState] = None
     sparse: Optional[SparseState] = None
+    #: chaos.monitor.PeerHealth when fault injection / recovery is on
+    chaos: Optional[Any] = None
 
 
 def init_train_state(

@@ -35,6 +35,10 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from eventgrad_tpu.chaos import inject as chaos_inject
+from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.chaos.policy import RecoveryPolicy, alive_mask
+from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import collectives
@@ -69,6 +73,8 @@ def make_train_step(
     wire_bf16: bool = False,
     wire: "Optional[str]" = None,
     staleness: int = 0,
+    chaos: Optional[ChaosSchedule] = None,
+    chaos_policy: Optional[RecoveryPolicy] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -99,6 +105,19 @@ def make_train_step(
     vectors to the metrics — current norm, threshold, fired bit, leaf-major
     order — the reference's `file_write=1` send{r}.txt instrumentation
     (event.cpp:337-339,385-391).
+
+    chaos (a chaos.ChaosSchedule) injects deterministic message loss into
+    the gossip edges inside this fused step: a dropped message keeps the
+    receiver's stale buffer (eventgrad) or leaves the edge out of a
+    weight-renormalized mix (dpsgd) — see chaos/inject.py. chaos_policy
+    (chaos.RecoveryPolicy, requires chaos; ChaosSchedule() is the no-fault
+    schedule if only monitoring/recovery is wanted) adds receiver-side
+    forced full-sync and edge-freeze recovery, with per-edge PeerHealth
+    carried in state.chaos and surfaced in the metrics. Gossip exchange
+    algorithms only (allreduce has no edges to drop; sp_eventgrad's
+    scatter replicas are future work), and not combinable with the fused
+    Pallas tail (whose mix weight is baked in, incompatible with
+    edge-gated renormalization).
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
@@ -114,6 +133,31 @@ def make_train_step(
             "trace records model the synchronous exchange; not available "
             "with staleness > 0"
         )
+    if chaos is not None and algo not in ("dpsgd", "eventgrad"):
+        raise ValueError(
+            "chaos injection targets the gossip exchange algorithms "
+            f"(dpsgd, eventgrad); got algo={algo!r}"
+        )
+    if chaos is not None and fused_sgd is not None:
+        raise ValueError(
+            "chaos is not combinable with the fused update tail: the "
+            "Pallas kernel bakes in the uniform mix weight, which "
+            "edge-freeze renormalization must vary per step"
+        )
+    if chaos_policy is not None and chaos is None:
+        raise ValueError(
+            "chaos_policy requires chaos (pass ChaosSchedule() to run "
+            "monitoring/recovery without injected faults)"
+        )
+    chaos_policy = chaos_policy or RecoveryPolicy()
+    if chaos is not None:
+        chaos_policy.validate_against(event_cfg.max_silence if event_cfg else 0)
+        if chaos_policy.sync_after and algo != "eventgrad":
+            raise ValueError(
+                "sync_after rides the event fire decision (force_fire); "
+                "dpsgd already sends everything every pass — a dropped "
+                "message there is final (use freeze_after / ring heal)"
+            )
     event_cfg = event_cfg or EventConfig()
     sparse_cfg = sparse_cfg or SparseConfig()
     n_nb = topo.n_neighbors
@@ -203,6 +247,13 @@ def make_train_step(
         fired_frac = jnp.float32(1.0)
         sent_bytes = jnp.float32(n_nb) * total_bytes
 
+        # chaos: per-edge delivered bits for this pass (deterministic in
+        # (seed, pass, rank, edge) — see chaos/inject.py); [n_nb] bool
+        health = state.chaos
+        deliver = None
+        if chaos is not None:
+            deliver = chaos_inject.delivery_mask(chaos, topo, pass_num)
+
         bufs = ()
         if algo == "allreduce":
             # E1: average gradients over the data-parallel (gossip) axes
@@ -217,14 +268,41 @@ def make_train_step(
 
         elif algo == "dpsgd":
             bufs = collectives.neighbor_vals(params, topo, wire)
+            if deliver is not None:
+                # lossy D-PSGD has no stale buffer to fall back to: a
+                # dropped edge leaves this pass's mix and the weight
+                # renormalizes (mix_weighted below)
+                health = chaos_monitor.update(health, deliver, ~deliver)
 
         elif algo == "eventgrad":
+            force_fire = (
+                health.sync_req
+                if (chaos is not None and chaos_policy.sync_after)
+                else None
+            )
             fire, event_state = decide_and_update(
-                params, event_state, pass_num, event_cfg, n_nb
+                params, event_state, pass_num, event_cfg, n_nb,
+                force_fire=force_fire,
             )
-            new_bufs, _ = collectives.masked_neighbor_vals(
-                params, fire, event_state.bufs, topo, wire
+            new_bufs, recv_fires = collectives.masked_neighbor_vals(
+                params, fire, event_state.bufs, topo, wire, deliver=deliver
             )
+            if deliver is not None:
+                # recv_fires are the RAW sender bits: sent & delivered
+                # resets silence, sent & ~delivered is an observed
+                # injected drop, ~sent is legitimate event quiet
+                sent_any = jnp.stack([
+                    jnp.any(jnp.stack(jax.tree.leaves(rf)))
+                    for rf in recv_fires
+                ])
+                health = chaos_monitor.update(
+                    health, sent_any & deliver, sent_any & ~deliver
+                )
+                if chaos_policy.sync_after:
+                    need = health.silence >= chaos_policy.sync_after
+                    health = health.replace(
+                        sync_req=chaos_monitor.sync_requests(need, topo)
+                    )
             # staleness=1: mix with what had arrived as of the PREVIOUS
             # step; this step's exchange lands for the next one
             bufs = event_state.bufs if staleness else new_bufs
@@ -289,7 +367,22 @@ def make_train_step(
             else:
                 opt_state = state.opt_state
         else:
-            mixed = collectives.mix(params, bufs, topo) if bufs else params
+            # chaos edge gating of the mix: dpsgd drops leave this pass's
+            # average (no stale buffer exists); a frozen edge (silence >=
+            # freeze_after) leaves it for either algorithm. Weights
+            # renormalize to 1/(1 + n_live) — with every gate on,
+            # mix_weighted is bitwise mix (the drop-rate-0 guarantee).
+            gate = None
+            if deliver is not None and bufs:
+                alive = alive_mask(health.silence, chaos_policy)
+                if algo == "dpsgd":
+                    gate = deliver if alive is None else deliver & alive
+                elif alive is not None:
+                    gate = alive
+            if gate is not None:
+                mixed = collectives.mix_weighted(params, bufs, gate)
+            else:
+                mixed = collectives.mix(params, bufs, topo) if bufs else params
             # optimizer applies gradients (computed at pre-mix params) to the
             # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
             updates, opt_state = tx.update(grads, state.opt_state, mixed)
@@ -306,6 +399,7 @@ def make_train_step(
             rng=rng,
             event=event_state,
             sparse=sparse_state,
+            chaos=health,
         )
         metrics = {
             "loss": loss,
@@ -316,6 +410,9 @@ def make_train_step(
                 event_state.num_events if event_state is not None else jnp.int32(0)
             ),
         }
+        if chaos is not None:
+            metrics["edge_silence"] = health.silence  # int32 [n_nb]
+            metrics["chaos_drops"] = health.drops  # cumulative int32
         if trace and algo in ("eventgrad", "sp_eventgrad"):
             # send{r}.txt columns: norm of the (pre-mix) param at the event
             # check, the post-decay/post-fire threshold, and the fire bit

@@ -48,14 +48,24 @@ def steady_records(history) -> list:
     plus the first block of any other size, e.g. the tail remainder when
     epochs % K != 0). With K-epoch blocks (loop.py epochs_per_dispatch)
     dropping only epoch 1 would smear 1/K of the compile into the
-    'steady' mean — the cold-block tag is the honest cut. Falls back to
-    history[1:] (the legacy rule) when every block was cold, and to the
-    full history when that leaves nothing."""
+    'steady' mean — the cold-block tag is the honest cut.
+
+    When EVERY block was cold (e.g. 2-3 distinct block sizes over few
+    epochs) no honest steady slice exists: fall back to dropping the
+    first record unconditionally (the legacy hist[1:] rule — it sheds the
+    worst of the compile even in legacy/resumed histories without
+    dispatch_cold tags), and to the full history only when that leaves
+    nothing. Every fallback record is a COPY carrying
+    `steady_contaminated: True` so benches report compile contamination
+    instead of silently absorbing it (ADVICE r5 #2)."""
     out = [
         h for h in history
         if not h.get("dispatch_cold", h.get("dispatch_block", h["epoch"] - 1) == 0)
     ]
-    return out or history[1:] or list(history)
+    if out:
+        return out
+    fallback = list(history)[1:] or list(history)
+    return [dict(h, steady_contaminated=True) for h in fallback]
 
 
 def collapse_verdict(

@@ -1,0 +1,373 @@
+"""Chaos subsystem: deterministic schedules, injection ≡ silence, recovery.
+
+The load-bearing guarantees (ISSUE 1 acceptance):
+  * schedules replay bit-for-bit from (seed, pass, rank, edge);
+  * an injected drop is BITWISE the same mixing as an event that did not
+    fire (chaos composes with EventGraD's stale-buffer semantics, it does
+    not approximate them);
+  * a drop-rate-0 chaos run is BITWISE the unmodified training loop;
+  * ring heal rewires survivors exactly like the (n-1)-ring;
+  * the receiver-side forced-sync bound keeps consensus error bounded
+    where the unguarded aggressive trigger diverges.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from eventgrad_tpu.chaos import inject, monitor
+from eventgrad_tpu.chaos.policy import (
+    RecoveryPolicy, apply_ring_heal, heal_ring,
+)
+from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import EventConfig, decide_and_update
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
+from eventgrad_tpu.parallel.topology import Ring, Topology
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+from tools import chaos_sweep
+
+#: one bitwise comparator and one step-at-a-time chaos harness, shared
+#: with the sweep tool instead of duplicated here
+_leaves_equal_bitwise = chaos_sweep._params_equal_bitwise
+
+
+# --- (a) schedule determinism + serialization --------------------------
+
+
+def test_schedule_spec_and_dict_round_trip():
+    s = ChaosSchedule(
+        seed=7, drop_p=0.2, flaky=(FlakyWindow(10, 20, 0.8),),
+        deliver_every=3, death=((3, 500),),
+    )
+    assert ChaosSchedule.parse(s.to_spec()) == s
+    assert ChaosSchedule.from_dict(s.to_dict()) == s
+    assert ChaosSchedule.parse("drop=0").is_noop
+    assert not s.is_noop
+    with pytest.raises(ValueError):
+        ChaosSchedule.parse("drop=1.5")
+    with pytest.raises(ValueError):
+        ChaosSchedule.parse("bogus")
+
+
+def test_schedule_deterministic_under_fixed_seed():
+    topo = Ring(4)
+    s = ChaosSchedule(seed=7, drop_p=0.3, flaky=(FlakyWindow(5, 9, 1.0),))
+    t1 = inject.delivery_table(s, topo, 20)
+    t2 = inject.delivery_table(s, topo, 20)
+    np.testing.assert_array_equal(t1, t2)
+    t3 = inject.delivery_table(
+        ChaosSchedule(seed=8, drop_p=0.3, flaky=s.flaky), topo, 20
+    )
+    assert not np.array_equal(t1, t3), "seed must matter"
+    # blackout window drops everything; noop schedule drops nothing
+    assert not t1[4:8].any()  # passes 5..8 (table starts at pass 1)
+    assert inject.delivery_table(ChaosSchedule(seed=7), topo, 8).all()
+
+
+def test_in_step_mask_matches_host_table():
+    """The SPMD-context mask (lax.axis_index identity) must be the same
+    bits as the host replay table — it IS the ground truth artifact."""
+    topo = Ring(4)
+    s = ChaosSchedule(seed=11, drop_p=0.5, death=((2, 4),))
+    table = inject.delivery_table(s, topo, 8)
+    for pass_num in (1, 4, 7):
+        def fn(_x, _p=pass_num):
+            return inject.delivery_mask(s, topo, jnp.int32(_p))
+
+        got = np.asarray(spmd(fn, topo)(jnp.zeros(4)))
+        np.testing.assert_array_equal(got, table[pass_num - 1])
+
+
+def test_death_silences_both_directions():
+    topo = Ring(4)
+    s = ChaosSchedule(seed=0, death=((1, 3),))
+    t = inject.delivery_table(s, topo, 10)
+    srcs = np.array(
+        [[topo.neighbor_source(r, nb) for nb in topo.neighbors]
+         for r in range(4)]
+    )
+    for p in range(10):
+        for r in range(4):
+            for e in range(2):
+                dead = (p + 1) >= 3 and (r == 1 or srcs[r, e] == 1)
+                assert t[p, r, e] == (not dead), (p, r, e)
+
+
+# --- (b) injected drop ≡ event that did not fire (bitwise) -------------
+
+
+def test_drop_bitwise_equals_not_fired():
+    topo = Ring(4)
+    p = {"w": jnp.arange(4.0), "b": 10.0 + jnp.arange(8.0).reshape(4, 2)}
+    fire_on = {
+        "w": jnp.ones(4, bool), "b": jnp.ones(4, bool)
+    }
+    fire_off = {
+        "w": jnp.zeros(4, bool), "b": jnp.zeros(4, bool)
+    }
+    last = {"w": jnp.full(4, -7.0), "b": jnp.full((4, 2), -9.0)}
+
+    def dropped(pp, ff, ll):
+        bufs, _ = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo,
+            deliver=jnp.zeros((2,), bool),  # sent, but the wire ate it
+        )
+        return bufs
+
+    def unfired(pp, ff, ll):
+        bufs, _ = collectives.masked_neighbor_vals(pp, ff, (ll, ll), topo)
+        return bufs
+
+    got_drop = spmd(dropped, topo)(p, fire_on, last)
+    got_quiet = spmd(unfired, topo)(p, fire_off, last)
+    assert _leaves_equal_bitwise(got_drop, got_quiet)
+    # and both are exactly the stale buffers
+    assert _leaves_equal_bitwise(got_drop, (last, last))
+
+
+def test_partial_delivery_masks_per_edge():
+    topo = Ring(4)
+    p = jnp.array([1.0, 2.0, 3.0, 4.0])
+    fire = jnp.ones(4, bool)
+    last = jnp.full(4, -7.0)
+
+    def fn(pp, ff, ll):
+        bufs, fires = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo,
+            deliver=jnp.array([False, True]),
+        )
+        return bufs, fires
+
+    (left, right), (lf, rf) = spmd(fn, topo)(p, fire, last)
+    np.testing.assert_allclose(left, [-7.0] * 4)  # dropped edge: stale
+    np.testing.assert_allclose(right, [2.0, 3.0, 4.0, 1.0])  # delivered
+    # recv_fires stay RAW (what was sent) so drops are observable
+    np.testing.assert_array_equal(np.asarray(lf), [True] * 4)
+
+
+def test_mix_weighted_all_alive_is_bitwise_mix():
+    topo = Ring(4)
+    key = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(key, (4, 3, 3))}
+    bufs = tuple(
+        {"w": jax.random.normal(jax.random.fold_in(key, i), (4, 3, 3))}
+        for i in range(2)
+    )
+
+    def plain(pp, b0, b1):
+        return collectives.mix(pp, (b0, b1), topo)
+
+    def weighted(pp, b0, b1):
+        return collectives.mix_weighted(
+            pp, (b0, b1), jnp.ones((2,), bool)
+        )
+
+    a = spmd(plain, topo)(params, *bufs)
+    b = spmd(weighted, topo)(params, *bufs)
+    assert _leaves_equal_bitwise(a, b)
+
+
+def test_mix_weighted_renormalizes_dead_edge():
+    topo = Ring(4)
+    p = jnp.array([0.0, 3.0, 6.0, 9.0])
+
+    def fn(pp):
+        bufs = collectives.neighbor_vals(pp, topo)
+        return collectives.mix_weighted(
+            pp, bufs, jnp.array([False, True])  # left edge frozen
+        )
+
+    out = spmd(fn, topo)(p)
+    # (self + right)/2, the /3 weight renormalized over survivors
+    np.testing.assert_allclose(out, [(0 + 3) / 2, (3 + 6) / 2,
+                                     (6 + 9) / 2, (9 + 0) / 2])
+
+
+# --- (c) ring heal -----------------------------------------------------
+
+
+def test_ring_heal_matches_smaller_ring():
+    topo = Ring(8)
+    healed, survivors = heal_ring(topo, {2, 5})
+    assert healed.n_ranks == 6 and survivors == (0, 1, 3, 4, 6, 7)
+    assert healed.axes == topo.axes
+    # healed neighbor_source IS Ring(6)'s; in old-rank terms each survivor
+    # bridges to the cyclically-next survivor (6->7, 7->0, 1->3, 4->6)
+    ref = Ring(6)
+    for j in range(6):
+        for k, nb in enumerate(healed.neighbors):
+            assert healed.neighbor_source(j, nb) == ref.neighbor_source(
+                j, ref.neighbors[k]
+            )
+        right_src = healed.neighbor_source(j, healed.neighbors[1])
+        assert survivors[right_src] == survivors[(j + 1) % 6]
+    with pytest.raises(ValueError):
+        heal_ring(topo, set(range(7)))  # < 2 survivors
+    with pytest.raises(ValueError):
+        heal_ring(topo, {99})
+    with pytest.raises(ValueError):
+        heal_ring(Topology(axes=("x", "y"), shape=(2, 2)), {0})
+
+
+def test_apply_ring_heal_slices_state_rows():
+    topo = Ring(4)
+    tx = optax.sgd(0.1)
+    state = init_train_state(
+        MLP(hidden=8), (8, 8, 1), tx, topo, "eventgrad", EventConfig()
+    )
+    state = state.replace(
+        chaos=stack_for_ranks(monitor.PeerHealth.init(topo), topo)
+    )
+    # make rows distinguishable, and silence nonzero to check the reset
+    state = state.replace(
+        pass_num=jnp.arange(4, dtype=jnp.int32),
+        chaos=state.chaos.replace(
+            silence=jnp.full((4, 2), 9, jnp.int32)
+        ),
+    )
+    healed, healed_topo, survivors = apply_ring_heal(state, topo, {1})
+    assert survivors == (0, 2, 3)
+    assert healed_topo.n_ranks == 3
+    np.testing.assert_array_equal(np.asarray(healed.pass_num), [0, 2, 3])
+    for a, b in zip(
+        jax.tree.leaves(healed.params), jax.tree.leaves(state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[[0, 2, 3]])
+    assert not np.asarray(healed.chaos.silence).any(), "silence must reset"
+
+
+# --- (d) forced sync bounds consensus error ----------------------------
+
+
+_BLACKOUT = (5, 25)
+
+
+def _drift_run(policy, passes=45):
+    """A trigger that goes permanently quiet after warmup (the limit of
+    the documented collapse mode, where an over-aggressive threshold
+    silences every parameter indefinitely), a total-blackout flaky window,
+    and decorrelated shards: with the stale buffers frozen out of the mix
+    the ranks genuinely diverge, so recovery is observable. Returns the
+    per-pass max consensus error (via the sweep tool's shared harness)."""
+    cfg = EventConfig(adaptive=False, constant=1e9, warmup_passes=2,
+                      max_silence=0)
+    sched = ChaosSchedule(seed=0, flaky=(FlakyWindow(*_BLACKOUT, 1.0),))
+    _, _, errs, _ = chaos_sweep._manual_leg(
+        sched, policy, passes, seed=0, event_cfg=cfg,
+        hidden=8, lr=0.2, data_seed=6, batch=8,
+    )
+    return errs
+
+
+def test_forced_sync_bound_restores_consensus():
+    """Twin runs differing ONLY in the sync bound: freeze-only never
+    recovers (the silent trigger means no edge ever speaks again after
+    the blackout — silence keeps every edge frozen and ranks run pure
+    local SGD), while the receiver-side sync bound forces fresh full
+    syncs as soon as the wire heals, pulling consensus error back down."""
+    w_end = _BLACKOUT[1]
+    freeze_only = _drift_run(RecoveryPolicy(freeze_after=8))
+    with_sync = _drift_run(RecoveryPolicy(sync_after=6, freeze_after=8))
+    # deterministic twins through the blackout...
+    np.testing.assert_allclose(
+        freeze_only[: w_end - 2], with_sync[: w_end - 2]
+    )
+    peak = with_sync[:w_end + 2].max()
+    assert peak > 2.0 * with_sync[2], "blackout must cause real drift"
+    # ...then forced sync restores consensus below the divergence peak
+    assert with_sync[w_end:w_end + 10].min() < 0.5 * peak
+    # while the syncless twin keeps drifting apart
+    assert freeze_only[-1] > peak
+    assert freeze_only[-1] > 1.1 * freeze_only[w_end]
+    assert with_sync[-1] < 0.6 * freeze_only[-1], (
+        with_sync[-1], freeze_only[-1]
+    )
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_silence"):
+        RecoveryPolicy(sync_after=3).validate_against(5)
+    RecoveryPolicy(sync_after=6).validate_against(5)
+    with pytest.raises(ValueError):
+        RecoveryPolicy(sync_after=-1)
+    with pytest.raises(ValueError, match="chaos_policy requires chaos"):
+        make_train_step(
+            MLP(hidden=8), optax.sgd(0.1), Ring(4), "eventgrad",
+            chaos_policy=RecoveryPolicy(sync_after=6),
+        )
+    with pytest.raises(ValueError, match="gossip"):
+        make_train_step(
+            MLP(hidden=8), optax.sgd(0.1), Ring(4), "allreduce",
+            chaos=ChaosSchedule(),
+        )
+    with pytest.raises(ValueError, match="force_fire"):
+        make_train_step(
+            MLP(hidden=8), optax.sgd(0.1), Ring(4), "dpsgd",
+            chaos=ChaosSchedule(),
+            chaos_policy=RecoveryPolicy(sync_after=6),
+        )
+
+
+def test_force_fire_overrides_threshold():
+    topo = Ring(2)
+    params = {"w": jnp.ones((3,))}
+    from eventgrad_tpu.parallel.events import EventState
+
+    cfg = EventConfig(adaptive=False, constant=1e9, warmup_passes=0)
+    state = EventState.init(params, topo, cfg)
+    fire, _ = decide_and_update(
+        params, state, jnp.int32(5), cfg, 2
+    )
+    assert not bool(jax.tree.leaves(fire)[0])  # huge threshold: quiet
+    fire_f, st_f = decide_and_update(
+        params, state, jnp.int32(5), cfg, 2, force_fire=jnp.bool_(True)
+    )
+    assert bool(jax.tree.leaves(fire_f)[0])
+    assert int(st_f.num_events) > 0  # forced sends are accounted
+
+
+# --- drop-rate-0 regression guard (acceptance criterion) ---------------
+
+
+def test_drop0_bitwise_identical_to_unmodified_loop():
+    topo = Ring(4)
+    x, y = synthetic_dataset(512, (8, 8, 1), seed=1)
+    cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=3,
+                      max_silence=5)
+    kw = dict(algo="eventgrad", epochs=2, batch_size=16,
+              learning_rate=0.1, event_cfg=cfg)
+    st_plain, _ = train(MLP(hidden=16), topo, x, y, **kw)
+    st_chaos, hist = train(
+        MLP(hidden=16), topo, x, y,
+        chaos=ChaosSchedule(seed=3, drop_p=0.0),
+        chaos_policy=RecoveryPolicy(sync_after=12, freeze_after=24),
+        **kw,
+    )
+    assert _leaves_equal_bitwise(st_plain.params, st_chaos.params)
+    assert hist[0]["chaos"]["drop_p"] == 0.0  # schedule rides the record
+    assert hist[-1]["chaos_drops"] == 0
+
+
+def test_sweep_artifact_structure(tmp_path):
+    out = chaos_sweep.run_sweep(
+        drops=(0.0, 0.3, 0.7), epochs=2, seed=0,
+        out_path=str(tmp_path / "sweep.json"), legs=("drop",),
+    )
+    assert len(out["points"]) >= 3
+    assert out["points"][0]["bitwise_identical_to_baseline"] is True
+    for pt in out["points"]:
+        assert {"drop_p", "test_acc", "schedule", "edge_silence_max",
+                "chaos_drops", "consensus_err_max"} <= set(pt)
+    assert (tmp_path / "sweep.json").exists()

@@ -151,3 +151,36 @@ def test_digits32_cifar_geometry_loader():
 
     with pytest.raises(ValueError):
         load_digits("train", geometry="bogus")
+
+
+def test_steady_records_flags_all_cold_fallback():
+    """ADVICE r5 #2: when every dispatch block was cold, the fallback must
+    drop the first record unconditionally (legacy hist[1:] rule) and mark
+    the returned COPIES steady_contaminated, so benches can report compile
+    contamination instead of silently absorbing it."""
+    from eventgrad_tpu.utils.metrics import steady_records
+
+    warm = [
+        {"epoch": 1, "dispatch_cold": True},
+        {"epoch": 2, "dispatch_cold": False},
+        {"epoch": 3, "dispatch_cold": False},
+    ]
+    out = steady_records(warm)
+    assert [h["epoch"] for h in out] == [2, 3]
+    assert not any(h.get("steady_contaminated") for h in out)
+
+    all_cold = [
+        {"epoch": 1, "dispatch_cold": True},
+        {"epoch": 2, "dispatch_cold": True},
+    ]
+    out = steady_records(all_cold)
+    assert [h["epoch"] for h in out] == [2]
+    assert all(h["steady_contaminated"] for h in out)
+    # inputs must stay pristine (history is reused by callers)
+    assert "steady_contaminated" not in all_cold[1]
+    # a single all-cold record: full-history fallback, still flagged
+    out = steady_records(all_cold[:1])
+    assert [h["epoch"] for h in out] == [1] and out[0]["steady_contaminated"]
+    # legacy histories without dispatch_cold tags: epoch-1 drop + no flag
+    legacy = [{"epoch": 1}, {"epoch": 2}]
+    assert [h["epoch"] for h in steady_records(legacy)] == [2]

@@ -1,0 +1,322 @@
+"""Chaos sweep: drop-rate vs accuracy and recovery-latency curves.
+
+The robustness analogue of BASELINE.json's msgs-saved-vs-accuracy
+headline: how much wire loss can EventGraD's stale-buffer semantics
+absorb before accuracy collapses, how fast do the recovery policies
+(chaos/policy.py) restore consensus after a flaky window, and how does a
+ring heal after a permanent peer death. Everything is deterministic — the
+serialized schedules ride in the artifact, so every point replays.
+
+Three legs, one JSON artifact (artifacts/chaos_sweep_<platform>.json):
+
+  * drop curve   — train() at >= 3 drop rates on the miniature op-point;
+                   final consensus-model test accuracy, per-edge silence
+                   maxima / injected-drop counts / consensus error per
+                   point. The 0.0 point doubles as the regression guard:
+                   its trajectory must be BITWISE-identical to a chaos=None
+                   run (also asserted in tests/test_chaos.py).
+  * flaky window — a total blackout window mid-run with the forced-sync
+                   policy on; recovery latency = passes from window end
+                   until consensus error returns to its pre-window level.
+  * ring heal    — permanent death of one rank; detection latency (silence
+                   crossing the suspect bound, chaos/monitor.edge_status),
+                   then policy.apply_ring_heal to the survivor ring and
+                   passes until survivor consensus recovers.
+
+Runs on CPU in tier-1 time (~30 s; MLP miniature, the test_loop op-point).
+Also reachable as bench.py's chaos mode: EG_BENCH_CHAOS=1 python bench.py.
+
+Usage: python tools/chaos_sweep.py [--drops 0,0.2,0.5] [--epochs 6]
+                                   [--seed 0] [--out PATH] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.utils import compile_cache
+
+compile_cache.honor_cpu_pin()
+
+from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.chaos.policy import RecoveryPolicy, apply_ring_heal
+from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+# miniature op-point (test_loop scale: trains to >50% on the prototype
+# task in seconds on one CPU core); max_silence=5 gives the sender-side
+# silence guarantee the monitor needs to classify edges, and the policy
+# bounds sit comfortably above it (policy.validate_against)
+N_RANKS = 4
+BATCH = 16
+LR = 0.1
+EVENT_CFG = EventConfig(
+    adaptive=True, horizon=0.95, warmup_passes=5, max_silence=5
+)
+POLICY = RecoveryPolicy(sync_after=12, freeze_after=24)
+
+
+def _data(n_train=2048, n_test=256):
+    x, y = synthetic_dataset(n_train, (8, 8, 1), seed=1)
+    xt, yt = synthetic_dataset(n_test, (8, 8, 1), seed=1, split="test")
+    return x, y, xt, yt
+
+
+def _train_point(x, y, xt, yt, epochs, seed, chaos=None, policy=None):
+    topo = Ring(N_RANKS)
+    state, hist = train(
+        MLP(hidden=32), topo, x, y,
+        algo="eventgrad", epochs=epochs, batch_size=BATCH,
+        learning_rate=LR, event_cfg=EVENT_CFG, seed=seed,
+        x_test=xt, y_test=yt, chaos=chaos, chaos_policy=policy,
+    )
+    return state, hist
+
+
+def _params_equal_bitwise(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(la), np.asarray(lb))
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _manual_leg(sched, policy, passes, seed=0, event_cfg=EVENT_CFG,
+                hidden=32, lr=LR, data_seed=2, batch=BATCH):
+    """Step-at-a-time run with a per-pass consensus-error trace (train()
+    probes only at block ends; the latency legs need pass resolution).
+    Also the shared chaos micro-harness reused by tests/test_chaos.py."""
+    from eventgrad_tpu.parallel.spmd import stack_for_ranks
+
+    topo = Ring(N_RANKS)
+    model = MLP(hidden=hidden)
+    import optax
+
+    tx = optax.sgd(lr)
+    x, y = synthetic_dataset(
+        N_RANKS * batch * passes, (8, 8, 1), seed=data_seed
+    )
+    xb, yb = batched_epoch(x, y, N_RANKS, batch)
+    state = init_train_state(model, (8, 8, 1), tx, topo, "eventgrad",
+                             event_cfg, seed=seed)
+    state = state.replace(
+        chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
+    )
+    step = make_train_step(model, tx, topo, "eventgrad",
+                           event_cfg=event_cfg, chaos=sched,
+                           chaos_policy=policy)
+    lifted = jax.jit(spmd(step, topo))
+    errs, silences = [], []
+    for s in range(passes):
+        state, _ = lifted(
+            state, (jnp.asarray(xb[:, s % xb.shape[1]]),
+                    jnp.asarray(yb[:, s % yb.shape[1]]))
+        )
+        errs.append(float(chaos_monitor.consensus_error(state.params).max()))
+        silences.append(np.asarray(state.chaos.silence).max(axis=0))
+    return state, topo, np.asarray(errs), np.asarray(silences)
+
+
+def _flaky_recovery_leg(seed):
+    """Blackout window mid-run; latency until consensus error returns to
+    its pre-window level with the forced-sync policy active."""
+    w_start, w_end, passes = 20, 32, 70
+    sched = ChaosSchedule(
+        seed=seed, flaky=(FlakyWindow(w_start, w_end, 1.0),)
+    )
+    _, _, errs, _ = _manual_leg(sched, POLICY, passes, seed=seed)
+    pre = float(errs[w_start - 2])
+    target = max(pre * 1.5, 1e-6)
+    rec_pass = next(
+        (p for p in range(w_end, passes) if errs[p] <= target), None
+    )
+    return {
+        "schedule": sched.to_dict(),
+        "policy": POLICY.to_dict(),
+        "window": [w_start, w_end],
+        "pre_window_consensus_err": round(pre, 6),
+        "peak_consensus_err": round(float(errs[w_start:w_end + 5].max()), 6),
+        "recovered": rec_pass is not None,
+        "recovery_latency_passes": (
+            rec_pass - w_end if rec_pass is not None else None
+        ),
+    }
+
+
+def _ring_heal_leg(seed):
+    """Kill rank 2 permanently; detect via the silence bound, heal the
+    ring to the 3 survivors, and time the survivor consensus recovery."""
+    death_pass, pre_passes = 15, 40
+    dead_rank = 2
+    sched = ChaosSchedule(seed=seed, death=((dead_rank, death_pass),))
+    # freeze keeps the dead peer's fossil buffer out of the mix while the
+    # death is still undetected; sync keeps survivor edges fresh
+    state, topo, errs, silences = _manual_leg(
+        sched, POLICY, pre_passes, seed=seed
+    )
+    detect_pass = next(
+        (
+            p + 1 for p in range(pre_passes)
+            if chaos_monitor.edge_status(
+                int(silences[p].max()), EVENT_CFG.max_silence
+            ) == "suspect"
+        ),
+        None,
+    )
+    survivors_pre = [r for r in range(N_RANKS) if r != dead_rank]
+    pre_err = float(
+        np.asarray(
+            chaos_monitor.consensus_error(
+                jax.tree.map(
+                    lambda p: p[np.asarray(survivors_pre)], state.params
+                )
+            )
+        ).max()
+    )
+    healed_state, healed_topo, survivors = apply_ring_heal(
+        state, topo, {dead_rank}
+    )
+    # continue on the healed ring (no injected faults remain: the dead
+    # rank is gone from the topology)
+    import optax
+
+    tx = optax.sgd(LR)
+    model = MLP(hidden=32)
+    x, y = synthetic_dataset(len(survivors) * BATCH * 40, (8, 8, 1), seed=4)
+    xb, yb = batched_epoch(x, y, len(survivors), BATCH)
+    step = make_train_step(model, tx, healed_topo, "eventgrad",
+                           event_cfg=EVENT_CFG, chaos=ChaosSchedule(seed=seed),
+                           chaos_policy=POLICY)
+    lifted = jax.jit(spmd(step, healed_topo))
+    heal_errs = []
+    for s in range(40):
+        healed_state, _ = lifted(
+            healed_state, (jnp.asarray(xb[:, s]), jnp.asarray(yb[:, s]))
+        )
+        heal_errs.append(
+            float(chaos_monitor.consensus_error(healed_state.params).max())
+        )
+    target = max(pre_err, 1e-6)
+    rec = next((i + 1 for i, e in enumerate(heal_errs) if e <= target), None)
+    return {
+        "schedule": sched.to_dict(),
+        "policy": POLICY.to_dict(),
+        "dead_rank": dead_rank,
+        "death_pass": death_pass,
+        "detect_pass": detect_pass,
+        "detect_latency_passes": (
+            detect_pass - death_pass if detect_pass else None
+        ),
+        "survivors": list(survivors),
+        "pre_heal_survivor_consensus_err": round(pre_err, 6),
+        "healed_consensus_err_final": round(heal_errs[-1], 6),
+        "recovered": rec is not None,
+        "recovery_latency_passes": rec,
+    }
+
+
+def run_sweep(drops=(0.0, 0.2, 0.5), epochs=6, seed=0, out_path=None,
+              legs=("drop", "flaky", "heal")) -> dict:
+    if len(drops) < 3:
+        raise ValueError(f"need >= 3 drop-rate points, got {drops}")
+    t0 = time.perf_counter()
+    x, y, xt, yt = _data()
+    out = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.devices()[0].platform,
+        "op_point": {
+            "model": "mlp32", "n_ranks": N_RANKS, "batch": BATCH,
+            "epochs": epochs, "lr": LR,
+            "horizon": EVENT_CFG.horizon,
+            "max_silence": EVENT_CFG.max_silence,
+        },
+        "policy": POLICY.to_dict(),
+        "points": [],
+    }
+
+    if "drop" in legs:
+        base_state, base_hist = _train_point(x, y, xt, yt, epochs, seed)
+        out["baseline_test_acc"] = round(base_hist[-1]["test_accuracy"], 2)
+        for p in drops:
+            sched = ChaosSchedule(seed=seed, drop_p=float(p))
+            st, hist = _train_point(
+                x, y, xt, yt, epochs, seed, chaos=sched, policy=POLICY
+            )
+            point = {
+                "drop_p": float(p),
+                "schedule": sched.to_dict(),
+                "test_acc": round(hist[-1]["test_accuracy"], 2),
+                "final_loss": round(hist[-1]["loss"], 4),
+                "msgs_saved_pct": round(hist[-1]["msgs_saved_pct"], 2),
+                "edge_silence_max": hist[-1]["edge_silence_max"],
+                "edge_status": hist[-1]["edge_status"],
+                "chaos_drops": hist[-1]["chaos_drops"],
+                "consensus_err_max": round(
+                    hist[-1]["consensus_err_max"], 6
+                ),
+            }
+            if p == 0.0:
+                # the regression guard: zero injected loss must be the
+                # unmodified trajectory, bit for bit
+                point["bitwise_identical_to_baseline"] = (
+                    _params_equal_bitwise(base_state.params, st.params)
+                )
+            out["points"].append(point)
+
+    if "flaky" in legs:
+        out["flaky_recovery"] = _flaky_recovery_leg(seed)
+    if "heal" in legs:
+        out["ring_heal"] = _ring_heal_leg(seed)
+
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    if out_path:
+        tmp = out_path + ".tmp"
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, out_path)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--drops", default="0,0.2,0.5",
+                    help="comma-separated drop rates (>= 3 points)")
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="drop curve only (skip the latency legs)")
+    args = ap.parse_args(argv)
+    drops = tuple(float(d) for d in args.drops.split(","))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = args.out or os.path.join(
+        repo, "artifacts",
+        f"chaos_sweep_{jax.devices()[0].platform}.json",
+    )
+    legs = ("drop",) if args.quick else ("drop", "flaky", "heal")
+    out = run_sweep(drops, args.epochs, args.seed, out_path, legs)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
