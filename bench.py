@@ -405,6 +405,17 @@ def main() -> None:
     # ships one f32 scale per FIRED leaf (steps.py wire accounting);
     # fired_frac approximates the fired leaf count for the derivation.
     sent = float(hist[-1]["sent_bytes_per_step_per_chip"])
+    # the SPMD wire truth riding next to the accounting model: bytes the
+    # exchange collective ACTUALLY moved per step (identical to the dense
+    # payload on the masked path — the whole point of the compact gossip
+    # wire is to pull this number down to the accounting one; see
+    # docs/compaction.md and the gossip_wire micro-bench in bench_kernels)
+    sent_real = float(
+        hist[-1].get("sent_bytes_wire_real_per_step_per_chip", 0.0)
+    )
+    sent_real_d = float(
+        hist_d[-1].get("sent_bytes_wire_real_per_step_per_chip", 0.0)
+    )
     # 4.0 = steps.py's native-wire bytes/elem (the reference's f32 MPI
     # wire), deliberately NOT the param dtype's itemsize — sent_bytes was
     # measured against that constant, so the derivation must divide by it
@@ -543,6 +554,8 @@ def main() -> None:
                 "chip_peak_flops": peak or None,
                 "param_dtype_bytes": param_bytes,
                 "sent_bytes_per_step_per_chip": round(sent, 1),
+                "sent_bytes_wire_real": round(sent_real, 1),
+                "sent_bytes_wire_real_dpsgd": round(sent_real_d, 1),
                 "sent_bytes_wire": {
                     k: round(v, 1) for k, v in wire_bytes.items()
                 },

@@ -14,7 +14,15 @@ Prints one JSON line per measurement (flushed immediately — a flaky device
 tunnel can wedge mid-run and the completed measurements must survive):
 {"kernel", "config", "pallas_ms", "xla_ms", "speedup", "max_err"}.
 
-Usage: python bench_kernels.py [attn|fused|all|tune] [--seqs 512,1024,...]
+  * the gossip wire leg: one full neighbor exchange (pack -> ppermute ->
+    scatter/apply) for dense / masked / compact x {f32, bf16, int8} at the
+    MLP and flagship-ResNet parameter geometries, plus a masked-vs-compact
+    whole-train-step comparison — real wire bytes next to measured ms,
+    written to artifacts/gossip_wire_{platform}.json (the TPU artifact
+    lands via tools/tpu_flagship.py running this same selector on-chip).
+
+Usage: python bench_kernels.py [attn|fused|gossip|all|tune]
+       [--seqs 512,1024,...]
        [--out FILE]   (appends each line to FILE as well as stdout)
 
 `tune` sweeps flash block sizes (128/256/512) per sequence length and mode
@@ -186,6 +194,156 @@ def bench_fused_update():
         _emit({"tuned": path, "tree_speedup": tree_speedup})
 
 
+def bench_gossip_wire():
+    """Time one full gossip exchange per (mode, wire) and record the REAL
+    per-neighbor wire bytes each mode moves. The compact leg's claim: it
+    transfers <= capacity/n_params of the dense value lanes (plus the
+    L-byte fire vector and, on int8, the L-scale vector) and is no slower
+    than the masked exchange it replaces. Fire pattern: leaves admitted in
+    leaf order until ~30%% of the payload bytes are lit; capacity sized
+    like the train-loop autotuner (observed fired peak, 1.25x headroom,
+    floor = largest leaf). On the small reference models one dense kernel
+    dominates the parameter count, so the floor pins capacity near
+    n_params — the ResNet geometry (86 leaves, largest ~21% of the model)
+    is where the byte ratio shows."""
+    import os
+
+    from eventgrad_tpu.models import MLP, ResNet18
+    from eventgrad_tpu.parallel import collectives
+    from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
+    from eventgrad_tpu.parallel.topology import Ring
+
+    topo = Ring(4)
+    results = []
+
+    def _fire_bits(sizes, frac):
+        total = sum(sizes)
+        fired, acc = [], 0
+        for s in sizes:
+            take = acc + s <= frac * total
+            fired.append(take)
+            if take:
+                acc += s
+        if not any(fired):  # a degenerate tree: light the first leaf
+            fired[0] = True
+        return fired, acc
+
+    def _exchange_case(name, params):
+        leaves, treedef = jax.tree.flatten(params)
+        sizes = [int(l.size) for l in leaves]
+        n = sum(sizes)
+        fired_bits, fired_elems = _fire_bits(sizes, 0.30)
+        fire = treedef.unflatten([jnp.asarray(b) for b in fired_bits])
+        fire_st = stack_for_ranks(fire, topo)  # per-rank bits for the lift
+        cap = collectives.choose_capacity(
+            n, fired_elems, collectives.compact_capacity_floor(sizes)
+        )
+        stacked = stack_for_ranks(params, topo)
+        last = jax.tree.map(jnp.zeros_like, stacked)
+        for wire in (None, "bf16", "int8"):
+            wire_name = {None: "f32", "bf16": "bf16", "int8": "int8"}[wire]
+            dense = jax.jit(spmd(
+                lambda t: collectives.neighbor_vals(t, topo, wire), topo))
+            masked = jax.jit(spmd(
+                lambda p, f, l: collectives.masked_neighbor_vals(
+                    p, f, (l, l), topo, wire), topo))
+            compact = jax.jit(spmd(
+                lambda p, f, l: collectives.compact_neighbor_vals(
+                    p, f, (l, l), topo, cap, wire), topo))
+            tm = dict(iters=2, repeats=2) if n > 1e6 else dict(iters=10,
+                                                              repeats=3)
+            ms = {
+                "dense": _time(dense, stacked, **tm),
+                "masked": _time(masked, stacked, fire_st, last, **tm),
+                "compact": _time(compact, stacked, fire_st, last, **tm),
+            }
+            for mode, t in ms.items():
+                real = collectives.wire_real_bytes_per_neighbor(
+                    n, len(sizes), wire,
+                    compact_capacity=cap if mode == "compact" else None,
+                    fire_bits=mode != "dense",
+                )
+                rec = {
+                    "kernel": "gossip_exchange", "config": name,
+                    "mode": mode, "wire": wire_name, "ms": round(t, 3),
+                    "wire_bytes_per_neighbor": real,
+                    "n_params": n, "n_leaves": len(sizes),
+                    "fired_elems": fired_elems, "capacity": cap,
+                }
+                _emit(rec)
+                results.append(rec)
+        return ms
+
+    key = jax.random.PRNGKey(0)
+    mlp = MLP().init(key, jnp.zeros((1, 28, 28, 1)))["params"]
+    _exchange_case("mlp", mlp)
+    resnet = ResNet18(dtype=jnp.float32).init(
+        key, jnp.zeros((1, 32, 32, 3)))["params"]
+    _exchange_case("resnet18", resnet)
+
+    # whole-train-step leg: compact must be no slower than the masked step
+    # it replaces (it strictly removes work when capacity < n_params: no
+    # full-model mask materialization, a [C]-sized shift instead of [N])
+    import optax
+
+    from eventgrad_tpu.data.datasets import synthetic_dataset
+    from eventgrad_tpu.models import MODEL_REGISTRY
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.train.state import init_train_state
+    from eventgrad_tpu.train.steps import make_train_step
+
+    for model_name, in_shape, batch in (("cnn2", (28, 28, 1), 64),
+                                        ("resnet18", (32, 32, 3), 4)):
+        model = MODEL_REGISTRY[model_name]()
+        tx = optax.sgd(0.05)
+        cfg = EventConfig(adaptive=True, horizon=1.05, warmup_passes=2,
+                          max_silence=50)
+        state = init_train_state(model, in_shape, tx, topo, "eventgrad", cfg)
+        leaves = jax.tree.leaves(state.params)
+        sizes = [int(np.prod(l.shape[1:])) or 1 for l in leaves]
+        n = sum(sizes)
+        fired_bits, fired_elems = _fire_bits(sizes, 0.30)
+        cap = collectives.choose_capacity(
+            n, max(fired_elems, 1),
+            collectives.compact_capacity_floor(sizes))
+        x, y = synthetic_dataset(batch * topo.n_ranks, in_shape, seed=3)
+        xb = jnp.asarray(x.reshape((topo.n_ranks, batch) + in_shape))
+        yb = jnp.asarray(y.reshape((topo.n_ranks, batch)))
+        step_ms = {}
+        for mode in ("dense", "compact"):
+            step = make_train_step(
+                model, tx, topo, "eventgrad", event_cfg=cfg,
+                gossip_wire=mode,
+                compact_capacity=cap if mode == "compact" else None,
+            )
+            lifted = jax.jit(spmd(step, topo))
+            st = jax.tree.map(lambda v: v, state)  # fresh copy per mode
+            ms = _time(lambda s, b: lifted(s, b), st, (xb, yb),
+                       iters=2, repeats=2)
+            step_ms[mode] = ms
+            rec = {"kernel": "gossip_step", "config": model_name,
+                   "mode": "masked" if mode == "dense" else "compact",
+                   "ms": round(ms, 3), "n_params": n, "capacity": cap}
+            _emit(rec)
+            results.append(rec)
+        _emit({"kernel": "gossip_step", "config": f"{model_name}:ratio",
+               "compact_over_masked": round(
+                   step_ms["compact"] / step_ms["dense"], 3)})
+
+    platform = jax.devices()[0].platform
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", f"gossip_wire_{platform}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"platform": platform,
+                   "device_kind": jax.devices()[0].device_kind,
+                   "entries": results}, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    _emit({"artifact": path, "n_entries": len(results)})
+
+
 def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
     """Per-shape block sweep -> eventgrad_tpu/ops/flash_tuning.json."""
     import os
@@ -300,8 +458,10 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = args[0] if args and not args[0].startswith("--") else "all"
-    if which not in ("attn", "fused", "all", "tune"):
-        raise SystemExit(f"unknown selector {which!r}: attn | fused | all | tune")
+    if which not in ("attn", "fused", "gossip", "all", "tune"):
+        raise SystemExit(
+            f"unknown selector {which!r}: attn | fused | gossip | all | tune"
+        )
     seqs = (512, 1024, 2048, 4096)
     for i, a in enumerate(args):
         if a in ("--seqs", "--out") and i + 1 >= len(args):
@@ -318,3 +478,5 @@ if __name__ == "__main__":
         bench_attention(seqs)
     if which in ("fused", "all"):
         bench_fused_update()
+    if which in ("gossip", "all"):
+        bench_gossip_wire()

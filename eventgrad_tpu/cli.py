@@ -198,6 +198,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(gossip algos only)")
     p.add_argument("--wire-bf16", action="store_true",
                    help="shorthand for --wire bf16")
+    p.add_argument("--gossip-wire", choices=["dense", "compact"],
+                   default="dense",
+                   help="compact = budgeted compacted exchange (eventgrad "
+                        "only): only fired leaves' elements ride the "
+                        "interconnect, through a static buffer autotuned "
+                        "from the post-warmup fire rate; fired leaves "
+                        "beyond the budget defer to the next pass "
+                        "(max_silence-overdue leaves get priority). Turns "
+                        "msgs_saved_%% into real wire bytes — see "
+                        "docs/compaction.md. dense = the masked full-"
+                        "payload exchange (default)")
+    p.add_argument("--compact-frac", type=float, default=None,
+                   metavar="F",
+                   help="explicit compact buffer capacity as a fraction "
+                        "of the parameter count (0 < F <= 1); default: "
+                        "autotune from the observed fire rate (requires "
+                        "--gossip-wire compact)")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
                         "(gossip algorithms; plain/momentum SGD only). "
@@ -302,6 +319,20 @@ def main(argv=None) -> int:
             "--wire applies to gossip exchanges; allreduce gradients "
             "keep full precision"
         )
+    if args.gossip_wire == "compact" and args.algo != "eventgrad":
+        raise SystemExit(
+            "--gossip-wire compact rides the event fire bits of the "
+            f"masked exchange (--algo eventgrad); --algo {args.algo} "
+            "has no compactable payload (sp_eventgrad's top-k wire is "
+            "already physically sparse)"
+        )
+    if args.compact_frac is not None:
+        if args.gossip_wire != "compact":
+            raise SystemExit("--compact-frac requires --gossip-wire compact")
+        if not (0.0 < args.compact_frac <= 1.0):
+            raise SystemExit(
+                f"--compact-frac must be in (0, 1], got {args.compact_frac}"
+            )
     if args.max_silence < 0:
         raise SystemExit(
             "--max-silence must be >= 0 (0 disables the bound; a "
@@ -442,6 +473,7 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
             resume=args.resume, trace_file=args.trace_file,
             wire=args.wire, staleness=args.staleness,
+            gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
             fused_update=args.fused, fault_inject=args.fault_inject,
             chaos=chaos_sched, chaos_policy=chaos_policy,
             on_epoch=logger.log,  # records stream as epochs finish: live

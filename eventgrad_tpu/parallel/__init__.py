@@ -1,7 +1,14 @@
 from eventgrad_tpu.parallel.topology import Ring, Torus, Topology, NeighborSpec
 from eventgrad_tpu.parallel.spmd import spmd, build_mesh, stack_for_ranks, rank_index
 from eventgrad_tpu.parallel import collectives
-from eventgrad_tpu.parallel.events import EventConfig, EventState, decide_and_update
+from eventgrad_tpu.parallel.events import (
+    EventConfig,
+    EventState,
+    capacity_gate,
+    commit,
+    decide_and_update,
+    propose,
+)
 from eventgrad_tpu.parallel.sparsify import (
     SparseConfig,
     SparseState,

@@ -9,11 +9,16 @@ The reference uses three MPI paradigms; each maps to one function here:
      -> `neighbor_vals` (jax.lax.ppermute ring shift)
   * one-sided event-triggered `MPI_Put` into an RMA window
     (/root/reference/dmnist/event/event.cpp:346-360)
-     -> `masked_neighbor_vals`: ppermute of (fire-bit, zero-masked payload);
-        the receiver keeps its previous buffer when the bit is off. This is
-        the SPMD-legal form of "maybe send": the collective always runs, the
-        *bytes that matter* are counted by the metrics layer, and true wire
-        savings materialize via sparsification (sparsify.py) or DCN paths.
+     -> two SPMD-legal forms of "maybe send":
+        `masked_neighbor_vals`: ppermute of (fire-bit, zero-masked payload);
+        the receiver keeps its previous buffer when the bit is off. The
+        collective still moves the FULL dense payload — its savings are an
+        accounting metric, not wire bytes.
+        `compact_neighbor_vals`: ppermute of a fixed-capacity compacted
+        buffer holding only the fired leaves' elements — event sparsity as
+        real ICI/DCN bytes (see docs/compaction.md). True wire savings
+        materialize here, via sparsification (sparsify.py), or through the
+        compressed wire dtypes (bf16/int8).
 
 All functions operate on pytrees and work identically under `jax.shard_map`
 (real mesh) and `jax.vmap(axis_name=...)` (single-chip simulation).
@@ -21,10 +26,12 @@ All functions operate on pytrees and work identically under `jax.shard_map`
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+import math
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
 
@@ -62,6 +69,12 @@ def _packable(tree: Any) -> bool:
 #: "int8" = per-leaf absmax-scaled int8 transfer (1 B/elem + one f32
 #: scale per leaf). Local state always stays full precision.
 WIRE_MODES = (None, "bf16", "int8")
+
+#: wire bytes per payload element (the reference's f32 MPI wire is the
+#: 4-byte baseline — deliberately a constant, not the param dtype's
+#: itemsize, so accounting and wire-real numbers stay comparable across
+#: models; see train/steps.py)
+WIRE_VAL_BYTES = {None: 4.0, "bf16": 2.0, "int8": 1.0}
 
 
 def _wire_out(x: Any, wire) -> Any:
@@ -119,30 +132,50 @@ def _int8_decode(got_q: Any, got_s: Any, scale_def, like: Any) -> Any:
     return _int8_dequant(got_q, got_scales, like)
 
 
-def _recv_packed(tree: Any, topo: Topology, nb: NeighborSpec, wire=None) -> Any:
-    """recv_from through one contiguous buffer: a model is one ICI transfer
-    per neighbor, not one per parameter tensor. The reference pays the
-    per-tensor cost (86 x 2 MPI_Puts per step on its ResNet,
-    dcifar10/event/event.cpp:282,320-332); packing amortizes every
-    per-message overhead and gives the ICI DMA one large contiguous op.
-    `wire` ("bf16"/"int8") compresses the buffer for the transfer and
-    restores full precision on receipt — 2x/4x fewer ICI/DCN bytes for
-    float32 models."""
-    if wire == "int8":
-        q, scale_vec, scale_def = _int8_encode(tree)
-        if _packable(q):
-            flatq, unravel_q = ravel_pytree(q)
-            got_q, got_s = recv_from((flatq, scale_vec), topo, nb)
-            got_tree = unravel_q(got_q)
-        else:
-            got_tree, got_s = recv_from((q, scale_vec), topo, nb)
-        return _int8_decode(got_tree, got_s, scale_def, tree)
-    if not _packable(tree):
-        got = recv_from(_wire_out(tree, wire), topo, nb)
-        return _wire_in(got, tree)
-    flat, unravel = ravel_pytree(tree)
-    got = recv_from(_wire_out(flat, wire), topo, nb)
-    return unravel(got.astype(flat.dtype))
+# ---------------------------------------------------------------------------
+# flat-segment helpers: leaf-major views of the packed (raveled) model
+
+def _leaf_meta(tree: Any) -> Tuple[Tuple[int, ...], Tuple[int, ...], int]:
+    """Static leaf-major metadata: (sizes, flat start offsets, total
+    elements), in the canonical flatten order `ravel_pytree` uses."""
+    leaves = jax.tree.leaves(tree)
+    sizes = tuple(int(l.size) for l in leaves)
+    starts = tuple(int(s) for s in np.cumsum((0,) + sizes[:-1]))
+    return sizes, starts, int(sum(sizes))
+
+
+def _segment_ids(sizes: Tuple[int, ...], n: int) -> jnp.ndarray:
+    """[n] int32 mapping each flat position to its leaf index. Computed
+    from the [L] static ends with one searchsorted (loop-invariant under
+    scan) instead of embedding an [n]-sized constant in the program."""
+    ends = jnp.asarray(np.cumsum(sizes), jnp.int32)
+    return jnp.searchsorted(ends, jnp.arange(n, dtype=jnp.int32), side="right")
+
+
+def _leaf_absmax(leaves) -> jnp.ndarray:
+    """[L] per-leaf absmax — stacked per-leaf reductions (cheaper than a
+    flat segment reduction on every backend, and max is exact so the bits
+    match either way)."""
+    return jnp.stack([jnp.max(jnp.abs(l)) for l in leaves])
+
+
+def _masked_scales(absmax_vec: jnp.ndarray, fire_vec: jnp.ndarray):
+    """Per-leaf int8 wire scales with non-fired leaves bottomed out —
+    bitwise what `_int8_scales` computes on the zero-masked pytree (a
+    masked leaf's absmax is the raw absmax when fired, 0 when not). ONE
+    definition shared by the masked and compact paths so their wires stay
+    bit-identical."""
+    return jnp.maximum(jnp.where(fire_vec, absmax_vec, 0.0), 1e-30) / 127.0
+
+
+def _int8_encode_flat(masked_flat: jnp.ndarray, scale_vec: jnp.ndarray,
+                      seg: jnp.ndarray):
+    """Quantize the raveled masked buffer against [L] per-leaf scales:
+    bitwise the same values as `_int8_quant` of the equivalent pytree (the
+    elementwise quantize divides by the identical per-leaf scalar)."""
+    return jnp.clip(
+        jnp.round(masked_flat / scale_vec[seg]), -127, 127
+    ).astype(jnp.int8)
 
 
 def neighbor_vals(tree: Any, topo: Topology, wire=None) -> Tuple[Any, ...]:
@@ -150,12 +183,43 @@ def neighbor_vals(tree: Any, topo: Topology, wire=None) -> Tuple[Any, ...]:
 
     Ring: returns (from_left, from_right) — the payloads of
     decent.cpp:200-205's two blocking receives, with no lockstep deadlock
-    risk because ppermute is a collective. Packed: one wire buffer per
-    neighbor regardless of how many parameter tensors the model has.
+    risk because ppermute is a collective. Packed: one contiguous wire
+    buffer per neighbor regardless of how many parameter tensors the model
+    has — the reference pays the per-tensor cost (86 x 2 MPI_Puts per step
+    on its ResNet, dcifar10/event/event.cpp:282,320-332); packing amortizes
+    every per-message overhead and gives the ICI DMA one large contiguous
+    op. The ravel/encode work happens ONCE and is reused for every
+    neighbor (2 shifts on a ring, 4 on a torus — the payload is identical,
+    only the permutation differs). `wire` ("bf16"/"int8") compresses the
+    buffer for the transfer and restores full precision on receipt.
     """
-    return tuple(
-        _recv_packed(tree, topo, nb, wire) for nb in topo.neighbors
-    )
+    if wire == "int8":
+        q, scale_vec, scale_def = _int8_encode(tree)
+        if _packable(q):
+            flatq, unravel_q = ravel_pytree(q)
+
+            def one(nb):
+                got_q, got_s = recv_from((flatq, scale_vec), topo, nb)
+                return _int8_decode(unravel_q(got_q), got_s, scale_def, tree)
+        else:
+
+            def one(nb):
+                got_tree, got_s = recv_from((q, scale_vec), topo, nb)
+                return _int8_decode(got_tree, got_s, scale_def, tree)
+    elif _packable(tree):
+        flat, unravel = ravel_pytree(tree)
+        wire_buf = _wire_out(flat, wire)
+
+        def one(nb):
+            got = recv_from(wire_buf, topo, nb)
+            return unravel(got.astype(flat.dtype))
+    else:
+        wire_tree = _wire_out(tree, wire)
+
+        def one(nb):
+            return _wire_in(recv_from(wire_tree, topo, nb), tree)
+
+    return tuple(one(nb) for nb in topo.neighbors)
 
 
 def masked_neighbor_vals(
@@ -178,7 +242,11 @@ def masked_neighbor_vals(
     Non-fired payloads are zero-masked before the shift so the wire content
     is well-defined (and compressible); receivers never read torn data,
     unlike the reference's MPI_LOCK_SHARED races (event.cpp:348-360 vs
-    :399-438) — staleness is explicit carried state instead.
+    :399-438) — staleness is explicit carried state instead. The masking
+    happens directly on the raveled wire buffer (one segment-wise `where`)
+    rather than on the pytree, so the step materializes ONE full-model
+    buffer, not two. NOTE the dense payload still ships whole: for wire
+    bytes that shrink with the fire rate, see `compact_neighbor_vals`.
 
     `deliver` (chaos.inject): optional bool [n_neighbors] of per-edge
     delivered bits — a False edge keeps its stale buffer even when the
@@ -187,9 +255,6 @@ def masked_neighbor_vals(
     (what was on the wire), so callers can count injected drops as
     `sent & ~delivered`.
     """
-    masked = jax.tree.map(
-        lambda p, f: jnp.where(f, p, jnp.zeros_like(p)), payload, fire
-    )
     fire_leaves, fire_def = jax.tree.flatten(fire)
     fire_vec = jnp.stack(fire_leaves)
 
@@ -198,41 +263,57 @@ def masked_neighbor_vals(
             fire_def, [got_vec[i] for i in range(len(fire_leaves))]
         )
 
-    if wire == "int8":
-        # quantized wire: int8 payload + one f32 scale per leaf (non-fired
-        # leaves are all-zero, so their scale bottoms out and decodes to 0)
-        q, scale_vec, scale_def = _int8_encode(masked)
-        flatq, unravel_q = ravel_pytree(q) if _packable(q) else (None, None)
+    if _packable(payload):
+        # one wire buffer (+ one fire-bit vector) per neighbor: the whole
+        # model rides a single ICI transfer instead of one per tensor
+        flat, unravel = ravel_pytree(payload)
+        sizes, _, _ = _leaf_meta(payload)
+        seg = _segment_ids(sizes, flat.size)
+        masked_flat = jnp.where(fire_vec[seg], flat, jnp.zeros_like(flat))
+        if wire == "int8":
+            # quantized wire: int8 buffer + one f32 scale per leaf
+            # (non-fired leaves are all-zero, so their scale bottoms out
+            # and decodes to 0)
+            scale_vec = _masked_scales(
+                _leaf_absmax(jax.tree.leaves(payload)), fire_vec
+            )
+            q = _int8_encode_flat(masked_flat, scale_vec, seg)
 
-        def receive(nb):
-            if flatq is not None:
+            def receive(nb):
                 got_q, got_s, got_vec = recv_from(
-                    (flatq, scale_vec, fire_vec), topo, nb
+                    (q, scale_vec, fire_vec), topo, nb
                 )
-                got_tree = unravel_q(got_q)
-            else:
+                deq = got_q.astype(flat.dtype) * got_s[seg].astype(flat.dtype)
+                return unravel(deq), _unflat_fire(got_vec)
+        else:
+            wire_buf = _wire_out(masked_flat, wire)
+
+            def receive(nb):
+                got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
+                return (
+                    unravel(got_flat.astype(flat.dtype)),
+                    _unflat_fire(got_vec),
+                )
+    else:
+        masked = jax.tree.map(
+            lambda p, f: jnp.where(f, p, jnp.zeros_like(p)), payload, fire
+        )
+        if wire == "int8":
+            q, scale_vec, scale_def = _int8_encode(masked)
+
+            def receive(nb):
                 got_tree, got_s, got_vec = recv_from(
                     (q, scale_vec, fire_vec), topo, nb
                 )
-            return _int8_decode(got_tree, got_s, scale_def, masked), (
-                _unflat_fire(got_vec)
-            )
-    elif _packable(masked):
-        # one wire buffer (+ one fire-bit vector) per neighbor: the whole
-        # model rides a single ICI transfer instead of one per tensor
-        packed, unravel = ravel_pytree(masked)
-        wire_buf = _wire_out(packed, wire)
+                return _int8_decode(got_tree, got_s, scale_def, masked), (
+                    _unflat_fire(got_vec)
+                )
+        else:
+            wire_tree = _wire_out(masked, wire)
 
-        def receive(nb):
-            got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
-            return unravel(got_flat.astype(packed.dtype)), _unflat_fire(got_vec)
-    else:
-
-        def receive(nb):
-            got_p, got_f = recv_from(
-                (_wire_out(masked, wire), fire), topo, nb
-            )
-            return _wire_in(got_p, masked), got_f
+            def receive(nb):
+                got_p, got_f = recv_from((wire_tree, fire), topo, nb)
+                return _wire_in(got_p, masked), got_f
 
     new_bufs, recv_fires = [], []
     for i, (nb, last) in enumerate(zip(topo.neighbors, last_bufs)):
@@ -250,16 +331,209 @@ def masked_neighbor_vals(
     return tuple(new_bufs), tuple(recv_fires)
 
 
+# ---------------------------------------------------------------------------
+# budgeted compacted exchange: event sparsity as real wire bytes
+
+def compact_capacity_floor(sizes) -> int:
+    """Smallest legal compact capacity: the largest leaf must fit whole —
+    a leaf bigger than the buffer could never ship and would starve."""
+    return max(int(s) for s in sizes)
+
+
+def choose_capacity(
+    n_params: int,
+    max_fired_elems: float,
+    floor: int,
+    headroom: float = 1.25,
+    granularity: int = 8192,
+) -> int:
+    """Static compact-buffer capacity from an observed post-warmup fired
+    peak. Bucketed (rounded up to `granularity` elements) so nearby
+    observations map to the IDENTICAL capacity — one jit program, no
+    recompile churn across dispatches. `headroom` absorbs fire-rate drift;
+    underestimates are safe anyway (overflow defers, bounded by
+    max_silence). Clamped to [floor, n_params]."""
+    want = int(math.ceil(float(max_fired_elems) * float(headroom)))
+    c = max(int(floor), want, 1)
+    c = ((c + granularity - 1) // granularity) * granularity
+    return int(min(int(n_params), c))
+
+
+def _compact_pack(
+    flat: jnp.ndarray,
+    fire_vec: jnp.ndarray,
+    sizes: Tuple[int, ...],
+    starts: Tuple[int, ...],
+    capacity: int,
+):
+    """Gather the fired leaves' elements into a [capacity] wire buffer.
+
+    Offsets are the exclusive cumsum of fired leaf sizes in leaf order
+    (jnp.cumsum — static shapes throughout); each packed position finds
+    its source leaf with one searchsorted over the fired ends, then a
+    single static-shape gather pulls the values. The caller guarantees
+    (events.capacity_gate) that the fired total fits. Returns
+    (packed [capacity], leaf_id [capacity] — the per-position source leaf,
+    reused by the int8 codec for per-position scales)."""
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    starts_arr = jnp.asarray(starts, jnp.int32)
+    fired_sizes = jnp.where(fire_vec, sizes_arr, 0)
+    ends = jnp.cumsum(fired_sizes)
+    offsets = ends - fired_sizes
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    leaf_id = jnp.minimum(
+        jnp.searchsorted(ends, j, side="right"), len(sizes) - 1
+    )
+    src = starts_arr[leaf_id] + (j - offsets[leaf_id])
+    valid = j < ends[-1]
+    packed = jnp.where(
+        valid,
+        flat[jnp.clip(src, 0, flat.size - 1)],
+        jnp.zeros((), flat.dtype),
+    )
+    return packed, leaf_id
+
+
+def compact_neighbor_vals(
+    payload: Any,
+    fire: Any,
+    last_bufs: Tuple[Any, ...],
+    topo: Topology,
+    capacity: int,
+    wire=None,
+    deliver: "Optional[Any]" = None,
+) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+    """Event-triggered exchange through a fixed-capacity compacted buffer:
+    non-fired leaves never touch the interconnect.
+
+    Wire format per neighbor: `(fire_vec [L] bool, packed [capacity])`
+    (+ `scales [L] f32` on the int8 wire). The conceptual `offsets` lane
+    is implicit — both sides recompute it as the exclusive cumsum of fired
+    leaf sizes from the fire bits, bit-identically, so it costs zero wire
+    bytes. Receivers slice each fired leaf back out at its offset and
+    scatter it into the stale buffer (`where(fire, new, stale)` per leaf);
+    semantics are EXACTLY `masked_neighbor_vals` whenever every fired leaf
+    fits the budget — proven bitwise in tests/test_compact.py. The caller
+    must gate `fire` through `events.capacity_gate(capacity=...)` first;
+    a fired total beyond `capacity` would silently truncate.
+
+    `capacity` is static (jit-shape); pick it with `choose_capacity` from
+    the observed post-warmup fire rate. Requires a single parameter dtype
+    and `capacity >= max leaf size` (a bigger leaf could never ship).
+    `deliver` has the masked-path chaos semantics. See docs/compaction.md.
+    """
+    leaves, treedef = jax.tree.flatten(payload)
+    if len(leaves) < 1:
+        raise ValueError("compact exchange needs a non-empty payload")
+    dt = leaves[0].dtype
+    if any(l.dtype != dt for l in leaves):
+        raise ValueError(
+            "compact wire packs one contiguous buffer and needs a single "
+            f"parameter dtype; got {set(str(l.dtype) for l in leaves)}"
+        )
+    sizes, starts, n_total = _leaf_meta(payload)
+    capacity = int(capacity)
+    if capacity < compact_capacity_floor(sizes):
+        raise ValueError(
+            f"compact capacity {capacity} is below the largest leaf "
+            f"({compact_capacity_floor(sizes)} elements): that leaf could "
+            "never ship and would starve"
+        )
+
+    fire_leaves, fire_def = jax.tree.flatten(fire)
+    fire_vec = jnp.stack(fire_leaves)
+
+    def _unflat_fire(got_vec):
+        return jax.tree.unflatten(
+            fire_def, [got_vec[i] for i in range(len(fire_leaves))]
+        )
+
+    flat, _ = ravel_pytree(payload)
+    packed, leaf_id = _compact_pack(flat, fire_vec, sizes, starts, capacity)
+    if wire == "int8":
+        # per-leaf scales match the masked path bitwise (_masked_scales:
+        # a masked leaf's absmax is the raw absmax when fired, bottomed
+        # out when not) — without materializing the masked full model
+        scale_vec = _masked_scales(_leaf_absmax(leaves), fire_vec)
+        # same codec call as the masked wire — the bit-identity guarantee
+        # rests on the two sites sharing one quantize
+        wire_packed = _int8_encode_flat(packed, scale_vec, leaf_id)
+
+        def ship(nb):
+            return recv_from((wire_packed, scale_vec, fire_vec), topo, nb)
+    else:
+        wire_packed = _wire_out(packed, wire)
+
+        def ship(nb):
+            got_packed, got_vec = recv_from((wire_packed, fire_vec), topo, nb)
+            return got_packed, None, got_vec
+
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    new_bufs, recv_fires = [], []
+    for i, (nb, last) in enumerate(zip(topo.neighbors, last_bufs)):
+        got_packed, got_scales, got_vec = ship(nb)
+        # offsets recomputed from the received fire bits (implicit lane)
+        got_fired = jnp.where(got_vec, sizes_arr, 0)
+        got_offsets = jnp.cumsum(got_fired) - got_fired
+        eff_vec = got_vec
+        if deliver is not None:
+            eff_vec = got_vec & deliver[i]
+        stale_leaves, last_def = jax.tree.flatten(last)
+        out = []
+        for k, stale in enumerate(stale_leaves):
+            data = lax.dynamic_slice(got_packed, (got_offsets[k],), (sizes[k],))
+            if got_scales is not None:
+                val = data.astype(stale.dtype) * got_scales[k].astype(stale.dtype)
+            else:
+                val = data.astype(stale.dtype)
+            out.append(jnp.where(eff_vec[k], val.reshape(stale.shape), stale))
+        new_bufs.append(jax.tree.unflatten(last_def, out))
+        recv_fires.append(_unflat_fire(got_vec))
+    return tuple(new_bufs), tuple(recv_fires)
+
+
+def wire_real_bytes_per_neighbor(
+    n_params: int,
+    n_leaves: int,
+    wire=None,
+    compact_capacity: "Optional[int]" = None,
+    fire_bits: bool = False,
+) -> float:
+    """Bytes ONE neighbor exchange actually moves through the collective —
+    the SPMD wire truth, as opposed to the reference-MPI accounting model
+    of train/steps.py (which charges only fired payloads). Dense/masked
+    exchanges ship `n_params` value lanes regardless of fire bits; the
+    compacted exchange ships `compact_capacity`. `fire_bits` adds the
+    [n_leaves] bool vector of the event paths; the int8 wire always ships
+    its [n_leaves] f32 scale vector. Value lanes use the same 4/2/1-byte
+    constants as the accounting (WIRE_VAL_BYTES) so the two numbers are
+    directly comparable."""
+    elems = n_params if compact_capacity is None else int(compact_capacity)
+    b = WIRE_VAL_BYTES[wire] * float(elems)
+    if fire_bits:
+        b += 1.0 * n_leaves
+    if wire == "int8":
+        b += 4.0 * n_leaves
+    return b
+
+
 def mix(params: Any, bufs: Tuple[Any, ...], topo: Topology) -> Any:
     """Uniform gossip averaging with neighbor buffers:
     p <- (p + sum(bufs)) / (1 + n_neighbors)   (event.cpp:469-471: /3 on a
     ring; /5 on a 2D torus). Stale or zero-initialized buffers participate
-    exactly as in the reference (event.cpp:177-179)."""
+    exactly as in the reference (event.cpp:177-179). One fused tree pass:
+    per element the adds run in the same left-to-right order as the old
+    per-buffer accumulation loop, so the result is bitwise-unchanged while
+    XLA sees a single traversal instead of n_neighbors+1."""
     w = topo.mix_weight
-    acc = params
-    for buf in bufs:
-        acc = jax.tree.map(jnp.add, acc, buf)
-    return jax.tree.map(lambda x: x * w, acc)
+
+    def leaf(p, *bs):
+        acc = p
+        for b in bs:
+            acc = jnp.add(acc, b)
+        return acc * w
+
+    return jax.tree.map(leaf, params, *bufs)
 
 
 def mix_weighted(params: Any, bufs: Tuple[Any, ...], gate: Any) -> Any:
@@ -269,16 +543,20 @@ def mix_weighted(params: Any, bufs: Tuple[Any, ...], gate: Any) -> Any:
     `gate` is bool [n_neighbors] (chaos.policy.alive_mask and the lossy
     D-PSGD path): a gated-off edge leaves the mix entirely and the weight
     renormalizes over the survivors, instead of averaging in a frozen
-    buffer forever. With every gate on this reproduces `mix` bitwise:
+    buffer forever. Fused like `mix` into one weighted tree pass
+    (n_neighbors+1 traversals -> 1) with the per-element add order
+    preserved. With every gate on this reproduces `mix` bitwise:
     where(True, b, 0) == b, the adds run in the same order, and the f32
     reciprocal of a small integer equals the cast Python double (both
-    correctly rounded to the same float32)."""
-    acc = params
-    for i, buf in enumerate(bufs):
-        acc = jax.tree.map(
-            lambda x, b, _g=gate[i]: x + jnp.where(_g, b, jnp.zeros_like(b)),
-            acc, buf,
-        )
+    correctly rounded to the same float32) — guarded by the drop-rate-0
+    chaos regression tests."""
     n_alive = jnp.sum(gate.astype(jnp.float32))
     w = 1.0 / (1.0 + n_alive)
-    return jax.tree.map(lambda x: x * w, acc)
+
+    def leaf(p, *bs):
+        acc = p
+        for i, b in enumerate(bs):
+            acc = acc + jnp.where(gate[i], b, jnp.zeros_like(b))
+        return acc * w
+
+    return jax.tree.map(leaf, params, *bufs)

@@ -203,6 +203,10 @@ class EventState(struct.PyTreeNode):
     slopes: jnp.ndarray
     bufs: Tuple[Any, ...]
     num_events: jnp.ndarray
+    #: leaf-fires proposed by the trigger but deferred by the compact wire
+    #: budget (capacity_gate) — rolled back to re-contend next pass;
+    #: int32 scalar, cumulative like num_events
+    num_deferred: jnp.ndarray = None  # type: ignore[assignment]
 
     @classmethod
     def init(cls, params: Any, topo: Topology, cfg: EventConfig) -> "EventState":
@@ -215,20 +219,37 @@ class EventState(struct.PyTreeNode):
             slopes=jnp.zeros((n, cfg.history), jnp.float32),
             bufs=tuple(trees.tree_zeros_like(params) for _ in topo.neighbors),
             num_events=jnp.zeros((), jnp.int32),
+            num_deferred=jnp.zeros((), jnp.int32),
         )
 
 
-def decide_and_update(
+class EventProposal(struct.PyTreeNode):
+    """Sender state-machine decision for one pass, BEFORE any wire-budget
+    gating: everything `commit` needs to finalize the EventState once the
+    effective fire bits are known. Splitting decide from commit is what
+    makes compact-wire deferral a rollback-free operation — a deferred
+    leaf's state is simply never committed (thres keeps decaying, silence
+    keeps accruing, slopes don't shift), exactly as if the trigger had not
+    fired, so it re-contends next pass and the max_silence bound still
+    sees its true silence."""
+
+    fire_vec: jnp.ndarray    # bool [L] — the un-gated trigger decision
+    curr_norm: jnp.ndarray   # f32 [L]
+    new_slopes: jnp.ndarray  # f32 [L, history]
+    thres: jnp.ndarray       # f32 [L] post-decay, pre-fire threshold
+    iter_diff: jnp.ndarray   # f32 [L] passes since last send
+    pass_f: jnp.ndarray      # f32 [] — this pass, as float
+
+
+def propose(
     params: Any,
     state: EventState,
     pass_num: jnp.ndarray,
     cfg: EventConfig,
-    n_neighbors: int,
     force_fire: "Any" = None,
-) -> Tuple[Any, EventState]:
-    """One pass of the sender state machine for every parameter at once.
+) -> EventProposal:
+    """One pass of the sender trigger for every parameter at once.
 
-    Returns (fire, new_state) where `fire` is a pytree of bools per param.
     `pass_num` is 1-based and already incremented for this pass, matching
     `pass_num++` at the top of the batch loop (event.cpp:273).
 
@@ -242,7 +263,7 @@ def decide_and_update(
 
     # per-leaf L2 norms stacked into the [L] state-vector order; every
     # subsequent state-machine op is one fused vector op, not L scalar ops
-    leaves, treedef = jax.tree.flatten(params)
+    leaves, _ = jax.tree.flatten(params)
     curr_norm = jnp.stack(
         [jnp.linalg.norm(l.reshape(-1)) for l in leaves]
     ).astype(jnp.float32)
@@ -266,20 +287,104 @@ def decide_and_update(
     new_slopes = jnp.concatenate(
         [state.slopes[:, 1:], (value_diff / iter_diff)[:, None]], axis=1
     )
-    slope_avg = jnp.mean(new_slopes, axis=1)
+    return EventProposal(
+        fire_vec=fire_vec,
+        curr_norm=curr_norm,
+        new_slopes=new_slopes,
+        thres=thres,
+        iter_diff=iter_diff,
+        pass_f=pass_f,
+    )
 
+
+def commit(
+    state: EventState,
+    prop: EventProposal,
+    fire_vec: jnp.ndarray,
+    cfg: EventConfig,
+    n_neighbors: int,
+) -> EventState:
+    """Apply one pass's state update for the leaves that actually fired.
+
+    `fire_vec` is the EFFECTIVE fire decision — `prop.fire_vec` itself on
+    the dense/masked paths, or its `capacity_gate`d subset on the compact
+    wire. Leaves proposed but not committed count into `num_deferred`;
+    their thres/norm/iter/slopes stay untouched (the rollback), and
+    `num_events` counts effective sends only, so msgs-saved-% keeps
+    matching what the wire really carried.
+    """
+    slope_avg = jnp.mean(prop.new_slopes, axis=1)
     if cfg.adaptive:
         thres_on_fire = slope_avg  # (:376-378)
     else:
-        thres_on_fire = thres
-
-    new_state = state.replace(
-        thres=jnp.where(fire_vec, thres_on_fire, thres),
-        last_sent_norm=jnp.where(fire_vec, curr_norm, state.last_sent_norm),
-        last_sent_iter=jnp.where(fire_vec, pass_f, state.last_sent_iter),
-        slopes=jnp.where(fire_vec[:, None], new_slopes, state.slopes),
+        thres_on_fire = prop.thres
+    deferred = jnp.sum((prop.fire_vec & ~fire_vec).astype(jnp.int32))
+    return state.replace(
+        thres=jnp.where(fire_vec, thres_on_fire, prop.thres),
+        last_sent_norm=jnp.where(fire_vec, prop.curr_norm, state.last_sent_norm),
+        last_sent_iter=jnp.where(fire_vec, prop.pass_f, state.last_sent_iter),
+        slopes=jnp.where(fire_vec[:, None], prop.new_slopes, state.slopes),
         num_events=state.num_events
         + n_neighbors * jnp.sum(fire_vec.astype(jnp.int32)),
+        num_deferred=state.num_deferred + deferred,
     )
-    fire = jax.tree.unflatten(treedef, [fire_vec[i] for i in range(len(leaves))])
+
+
+def capacity_gate(
+    fire_vec: jnp.ndarray,
+    sizes,
+    capacity: int,
+    priority: "Any" = None,
+) -> jnp.ndarray:
+    """Admit fired leaves into a static wire budget; defer the overflow.
+
+    Greedy prefix admission over the cumulative fired sizes (one cumsum +
+    compare — static shapes) in a stable priority order: leaves flagged in
+    `priority` (overdue per max_silence, chaos forced syncs) claim budget
+    first, then everything else in leaf order. Returns the effective fire
+    bits, always a subset of `fire_vec`; the caller commits the event
+    state with them (see `commit`) so a deferred leaf re-contends next
+    pass. Greedy means a mid-list overflow can also defer later fired
+    leaves that would still have fit — the slack is deliberate: offsets
+    must be a pure function of the admitted bits (the receiver recomputes
+    them from the wire's fire_vec), and one pass keeps the gate cheap.
+
+    Liveness: with `capacity >= max leaf size` (enforced by
+    compact_neighbor_vals) a priority leaf is admitted no later than its
+    position in the priority queue drains, so max_silence-overdue leaves
+    cannot be starved by ordinary traffic.
+    """
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    if priority is None:
+        order = jnp.arange(fire_vec.shape[0])
+    else:
+        pri = jnp.broadcast_to(priority, fire_vec.shape)
+        # argsort of the NOT-priority bit is stable: priority-fired leaves
+        # first (in leaf order), then the rest (in leaf order)
+        order = jnp.argsort(~(pri & fire_vec))
+    fire_p = fire_vec[order]
+    ends_p = jnp.cumsum(jnp.where(fire_p, sizes_arr[order], 0))
+    keep_p = fire_p & (ends_p <= capacity)
+    return jnp.zeros_like(fire_vec).at[order].set(keep_p)
+
+
+def decide_and_update(
+    params: Any,
+    state: EventState,
+    pass_num: jnp.ndarray,
+    cfg: EventConfig,
+    n_neighbors: int,
+    force_fire: "Any" = None,
+) -> Tuple[Any, EventState]:
+    """One pass of the sender state machine for every parameter at once:
+    `propose` + `commit` with the un-gated fire bits (the dense/masked
+    exchange paths — no wire budget). Returns (fire, new_state) where
+    `fire` is a pytree of bools per param. Compact-wire callers use the
+    split form directly so `capacity_gate` can sit between the two."""
+    prop = propose(params, state, pass_num, cfg, force_fire=force_fire)
+    new_state = commit(state, prop, prop.fire_vec, cfg, n_neighbors)
+    leaves, treedef = jax.tree.flatten(params)
+    fire = jax.tree.unflatten(
+        treedef, [prop.fire_vec[i] for i in range(len(leaves))]
+    )
     return fire, new_state

@@ -31,7 +31,7 @@ from eventgrad_tpu.chaos import schedule as chaos_schedule
 from eventgrad_tpu.chaos.policy import RecoveryPolicy
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.data.sharding import epoch_index_plan
-from eventgrad_tpu.parallel import multihost
+from eventgrad_tpu.parallel import collectives, multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
@@ -188,6 +188,8 @@ def train(
     fused_update: bool = False,
     wire_bf16: bool = False,
     wire: "Optional[str]" = None,
+    gossip_wire: str = "dense",
+    compact_frac: Optional[float] = None,
     staleness: int = 0,
     fault_inject: Optional[str] = None,
     chaos: Optional[Any] = None,
@@ -222,6 +224,20 @@ def train(
     ends; the first record carries the serialized schedule so the run is
     replayable from its log alone. See docs/chaos.md.
 
+    gossip_wire="compact" (eventgrad only) switches the exchange to the
+    budgeted compacted wire (collectives.compact_neighbor_vals) once
+    warmup is over: the loop runs the dense masked path through the
+    warmup passes (fire-everything would blow any budget), observes the
+    post-warmup fired sizes, picks a STATIC capacity with
+    collectives.choose_capacity (or honors an explicit `compact_frac` of
+    the parameter count), and rebuilds the step once — capacity never
+    changes again, so there is exactly one extra jit compile and zero
+    recompile churn. History records carry `gossip_wire`,
+    `compact_capacity`, and `sent_bytes_wire_real_per_step_per_chip` (the
+    bytes the collective actually moves — see docs/compaction.md). If the
+    observed fire rate leaves nothing to compact (capacity would reach
+    the full model), the run stays dense and says so in the record.
+
     device_data=True uploads the full (cast) dataset to the device ONCE and
     ships only the per-epoch permutation index plan ([n_ranks, steps, batch]
     int32, ~KBs) per dispatch; batches are gathered on-device inside the
@@ -241,6 +257,22 @@ def train(
     boundaries (blocks are split there). fault_inject forces K=1 (the
     fault must land at an exact epoch boundary).
     """
+    if gossip_wire not in ("dense", "compact"):
+        raise ValueError(
+            f"gossip_wire must be 'dense' or 'compact', got {gossip_wire!r}"
+        )
+    if gossip_wire == "compact" and algo != "eventgrad":
+        raise ValueError(
+            "gossip_wire='compact' rides the event fire bits "
+            f"(algo='eventgrad'); got algo={algo!r}"
+        )
+    if compact_frac is not None:
+        if gossip_wire != "compact":
+            raise ValueError("compact_frac needs gossip_wire='compact'")
+        if not (0.0 < float(compact_frac) <= 1.0):
+            raise ValueError(
+                f"compact_frac must be in (0, 1], got {compact_frac}"
+            )
     chaos_sched = chaos_schedule.resolve(chaos) if chaos is not None else None
     fault_mode, fault_epoch = None, -1
     if fault_inject:
@@ -311,25 +343,61 @@ def train(
     if ckpt_path and resume:
         found = checkpoint.latest(ckpt_path)
         if found:
+            import warnings
+
+            def _restore(tmpl_state):
+                """(restored, trace_carry-or-None): a snapshot from before
+                the trace carry existed resumes the training state and
+                lets the carry restart from zeros (loud below — a corrupt
+                carry also lands there and recv traces diverge)."""
+                try:
+                    r = checkpoint.restore(
+                        found,
+                        {"state": tmpl_state, "epoch": np.int64(0),
+                         "trace_carry": trace_carry},
+                    )
+                    return r, r["trace_carry"]
+                except Exception:
+                    return checkpoint.restore(
+                        found, {"state": tmpl_state, "epoch": np.int64(0)}
+                    ), None
+
             try:
-                restored = checkpoint.restore(
+                restored, carry = _restore(state)
+            except Exception:
+                # migration: a snapshot from before a state field existed
+                # (e.g. EventState.num_deferred) fails the exact-structure
+                # restore — graft it onto the template by path; added
+                # fields resume from their init values, loudly
+                restored, missing = checkpoint.restore_with_fill(
                     found,
                     {"state": state, "epoch": np.int64(0),
                      "trace_carry": trace_carry},
                 )
-                trace_carry = restored["trace_carry"]
-            except Exception as e:
-                # snapshot from before the trace carry existed: resume the
-                # training state, let the carry start from zeros (loud — a
-                # corrupt carry also lands here and recv traces diverge)
-                import warnings
-
-                warnings.warn(
-                    f"checkpoint has no restorable trace_carry ({e!r}); "
-                    "recv-trace staleness restarts from zeros"
+                # ONLY known-added fields may fill from init — anything
+                # else missing (opt_state restructured, params renamed,
+                # ...) keeps the exact restore's loud failure instead of
+                # resuming with silently reset state
+                known_added = lambda m: (
+                    m == "state/event/num_deferred"
+                    or m.startswith("trace_carry")
                 )
-                restored = checkpoint.restore(
-                    found, {"state": state, "epoch": np.int64(0)}
+                if not missing or not all(known_added(m) for m in missing):
+                    raise  # not a field-added migration: a real mismatch
+                carry = (
+                    None if any(m.startswith("trace_carry") for m in missing)
+                    else restored["trace_carry"]
+                )
+                warnings.warn(
+                    "snapshot predates state fields "
+                    f"{missing}; they resume from init values"
+                )
+            if carry is not None:
+                trace_carry = carry
+            else:
+                warnings.warn(
+                    "checkpoint has no restorable trace_carry; "
+                    "recv-trace staleness restarts from zeros"
                 )
             state = restored["state"]
             start_epoch = int(restored["epoch"])
@@ -339,15 +407,22 @@ def train(
     start_passes = int(np.asarray(state.pass_num).reshape(-1)[0])
     if mesh is not None:
         state = multihost.put_stacked(state, mesh, topo)
-    step = make_train_step(
-        model, tx, topo, algo,
-        event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
-        sync_bn=sync_bn, trace=trace_file is not None,
-        fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
-        wire_bf16=wire_bf16, wire=wire, staleness=staleness,
-        chaos=chaos_sched, chaos_policy=chaos_policy,
-    )
-    lifted = spmd(step, topo, mesh=mesh)
+    def _build_step(wire_mode: str, capacity: Optional[int] = None):
+        return make_train_step(
+            model, tx, topo, algo,
+            event_cfg=event_cfg, sparse_cfg=sparse_cfg, augment=augment,
+            sync_bn=sync_bn, trace=trace_file is not None,
+            fused_sgd=(learning_rate, momentum) if fused_update and algo != "allreduce" else None,
+            wire_bf16=wire_bf16, wire=wire, staleness=staleness,
+            chaos=chaos_sched, chaos_policy=chaos_policy,
+            gossip_wire=wire_mode, compact_capacity=capacity,
+        )
+
+    # a compact-wire run starts DENSE: warmup fires everything (no budget
+    # could hold it), and the autotuner needs observed post-warmup fired
+    # sizes before it can size the buffer; _maybe_activate_compact below
+    # rebuilds the runners exactly once
+    lifted = spmd(_build_step("dense"), topo, mesh=mesh)
 
     # --- dispatch-mode resolution (device-resident data + K-epoch blocks)
     # eligibility: the single-process vmap/single-mesh path only — hybrid
@@ -399,26 +474,32 @@ def train(
 
     # donate the carried state: the scan updates params/opt/event state in
     # place instead of holding two copies in HBM (batches can't alias — the
-    # steps-major swapaxes relayouts them)
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run_epoch(st, xb, yb):
-        def body(s, batch):
-            return lifted(s, batch)
+    # steps-major swapaxes relayouts them). A factory, because the compact
+    # autotuner swaps the lifted step once capacity is known.
+    def _build_runners(lifted_step):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_epoch(st, xb, yb):
+            def body(s, batch):
+                return lifted_step(s, batch)
 
-        # [n_ranks, steps, ...] -> scan over steps
-        xs = (jnp.swapaxes(xb, 0, 1), jnp.swapaxes(yb, 0, 1))
-        return jax.lax.scan(body, st, xs)
+            # [n_ranks, steps, ...] -> scan over steps
+            xs = (jnp.swapaxes(xb, 0, 1), jnp.swapaxes(yb, 0, 1))
+            return jax.lax.scan(body, st, xs)
 
-    # device-resident variant: batches are gathered on-device from the
-    # resident dataset each scan step — only the index plan crosses the
-    # host->device boundary per dispatch
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run_epoch_idx(st, x_all, y_all, idx):
-        def body(s, ib):
-            return lifted(s, (x_all[ib], y_all[ib]))
+        # device-resident variant: batches are gathered on-device from the
+        # resident dataset each scan step — only the index plan crosses the
+        # host->device boundary per dispatch
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_epoch_idx(st, x_all, y_all, idx):
+            def body(s, ib):
+                return lifted_step(s, (x_all[ib], y_all[ib]))
 
-        # [n_ranks, S, B] -> scan over S; gather yields [n_ranks, B, ...]
-        return jax.lax.scan(body, st, jnp.swapaxes(idx, 0, 1))
+            # [n_ranks, S, B] -> scan over S; gather yields [n_ranks, B, ...]
+            return jax.lax.scan(body, st, jnp.swapaxes(idx, 0, 1))
+
+        return run_epoch, run_epoch_idx
+
+    run_epoch, run_epoch_idx = _build_runners(lifted)
 
     history: List[Dict[str, Any]] = []
 
@@ -454,16 +535,30 @@ def train(
             yield e, be
             e = be + 1
 
+    # compact-wire autotune state: the loop runs dense until warmup is
+    # past and enough post-warmup fired sizes were observed, then picks a
+    # static capacity ONCE and rebuilds the runners (one extra compile,
+    # zero recompile churn afterwards)
+    compact_capacity: Optional[int] = None
+    compact_done = gossip_wire != "compact"
+    compact_note: Optional[Dict[str, Any]] = None
+    compact_fired_peak = 0.0
+    compact_post_steps = 0
+    warmup_passes = (event_cfg or EventConfig()).warmup_passes
+    compact_min_samples = int(os.environ.get("EG_COMPACT_MIN_SAMPLES", "16"))
+
     seen_block_sizes: set = set()
     try:
         for blk_i, (blk_start, blk_end) in enumerate(_blocks()):
             n_e = blk_end - blk_start + 1
-            # first block of each distinct size pays a jit trace+compile
-            # (scan length is part of the shape) — tag its records so
+            # first block of each distinct (size, wire-mode) pays a jit
+            # trace+compile (scan length is part of the shape, and the
+            # compact switch is a new program) — tag its records so
             # steady-state step math can exclude them (the tail-remainder
             # block recompiles too, not just block 0)
-            cold = n_e not in seen_block_sizes
-            seen_block_sizes.add(n_e)
+            mode_now = "compact" if compact_capacity is not None else "dense"
+            cold = (n_e, mode_now) not in seen_block_sizes
+            seen_block_sizes.add((n_e, mode_now))
             label_shape: Tuple[int, ...] = ()
             if device_data:
                 idx_np = np.concatenate(
@@ -534,9 +629,22 @@ def train(
                     "sent_bytes_per_step_per_chip": float(
                         m_e["sent_bytes"][..., 0].mean()
                     ),
+                    # the SPMD wire truth next to the accounting model:
+                    # bytes the collective actually moved (docs/compaction.md)
+                    "sent_bytes_wire_real_per_step_per_chip": float(
+                        m_e["sent_bytes_wire_real"][..., 0].mean()
+                    ),
                     "n_params": n_params,
                 }
+                if gossip_wire == "compact":
+                    rec["gossip_wire"] = mode_now
+                    if compact_capacity is not None:
+                        rec["compact_capacity"] = int(compact_capacity)
+                    if compact_note is not None:
+                        rec.update(compact_note)
+                        compact_note = None
                 if algo in ("eventgrad", "sp_eventgrad"):
+                    rec["num_deferred"] = int(m_e["num_deferred"][-1].sum())
                     # msgs-saved vs D-PSGD: events/(n_neighbors * passes *
                     # sz) fired
                     events_total = int(m_e["num_events"][-1].sum())
@@ -612,6 +720,64 @@ def train(
                 if on_epoch is not None:  # live metrics (liveness signal)
                     on_epoch(rec)
             epoch = blk_end
+            if not compact_done:
+                # collect post-warmup fired sizes from this block; once
+                # enough are in (or warmup is past, with an explicit
+                # compact_frac), size the buffer and switch — exactly once
+                # [n_e*steps, n_ranks]: the capacity is one static number
+                # shared by every rank, so the peak is taken across ranks
+                fe = np.asarray(m["fired_elems"])
+                blk_pass_base = (
+                    start_passes + (blk_start - 1 - start_epoch) * steps
+                )
+                pnums = blk_pass_base + 1 + np.arange(fe.shape[0])
+                # warm is pass_num < warmup_passes (events.propose), so
+                # pass == warmup_passes is already real trigger data
+                post = fe[pnums >= warmup_passes]
+                if post.size:
+                    compact_fired_peak = max(
+                        compact_fired_peak, float(post.max())
+                    )
+                    compact_post_steps += int(post.shape[0])
+                enough = (
+                    compact_post_steps >= compact_min_samples
+                    if compact_frac is None
+                    else bool(pnums.size and pnums[-1] >= warmup_passes)
+                )
+                if enough:
+                    # per-rank leaf sizes (leading axis is the rank stack);
+                    # the floor rule lives with the collective
+                    floor = collectives.compact_capacity_floor(
+                        int(np.prod(l.shape[1:], dtype=np.int64)) or 1
+                        for l in jax.tree.leaves(state.params)
+                    )
+                    if compact_frac is not None:
+                        cap = min(n_params, max(
+                            floor, int(np.ceil(compact_frac * n_params))
+                        ))
+                        autotuned = False
+                    else:
+                        cap = collectives.choose_capacity(
+                            n_params, compact_fired_peak, floor
+                        )
+                        autotuned = True
+                    compact_note = {"compact_autotuned": autotuned}
+                    if autotuned:
+                        compact_note["compact_fired_peak_elems"] = (
+                            compact_fired_peak
+                        )
+                    if autotuned and cap >= n_params:
+                        # fire rate ~1: the budget would be the whole
+                        # model — nothing to compact; stay dense, loudly
+                        compact_note["compact_skipped"] = (
+                            "observed fire rate needs capacity >= n_params"
+                        )
+                    else:
+                        compact_capacity = cap
+                        run_epoch, run_epoch_idx = _build_runners(
+                            spmd(_build_step("compact", cap), topo, mesh=mesh)
+                        )
+                    compact_done = True
             if ckpt_path and (
                 epoch == epochs or (save_every and epoch % save_every == 0)
             ):

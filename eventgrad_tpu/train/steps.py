@@ -42,7 +42,9 @@ from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import collectives
-from eventgrad_tpu.parallel.events import EventConfig, decide_and_update
+from eventgrad_tpu.parallel.events import (
+    EventConfig, capacity_gate, commit, decide_and_update, propose,
+)
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.utils import trees
@@ -75,6 +77,8 @@ def make_train_step(
     staleness: int = 0,
     chaos: Optional[ChaosSchedule] = None,
     chaos_policy: Optional[RecoveryPolicy] = None,
+    gossip_wire: str = "dense",
+    compact_capacity: Optional[int] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -105,6 +109,19 @@ def make_train_step(
     vectors to the metrics — current norm, threshold, fired bit, leaf-major
     order — the reference's `file_write=1` send{r}.txt instrumentation
     (event.cpp:337-339,385-391).
+
+    gossip_wire="compact" (eventgrad only) replaces the masked dense
+    exchange with the budgeted compacted wire
+    (collectives.compact_neighbor_vals): only fired leaves' elements
+    travel, through a static buffer of `compact_capacity` elements; fired
+    leaves beyond the budget are DEFERRED — their fire bit clears and
+    their event state rolls back (events.capacity_gate/commit), with
+    max_silence-overdue leaves claiming budget first. Pick the capacity
+    with collectives.choose_capacity (train/loop.py autotunes it from the
+    observed post-warmup fire rate and keeps the dense path through
+    warmup). The `sent_bytes_wire_real` metric reports the bytes each
+    mode ACTUALLY moves per step; `sent_bytes` stays the reference-MPI
+    accounting model. See docs/compaction.md.
 
     chaos (a chaos.ChaosSchedule) injects deterministic message loss into
     the gossip edges inside this fused step: a dropped message keeps the
@@ -166,6 +183,24 @@ def make_train_step(
         wire = wire or "bf16"
     if wire not in collectives.WIRE_MODES:
         raise ValueError(f"wire must be one of {collectives.WIRE_MODES}")
+    if gossip_wire not in ("dense", "compact"):
+        raise ValueError(
+            f"gossip_wire must be 'dense' or 'compact', got {gossip_wire!r}"
+        )
+    if gossip_wire == "compact":
+        if algo != "eventgrad":
+            raise ValueError(
+                "gossip_wire='compact' rides the event fire bits of the "
+                f"masked exchange (algo='eventgrad'); got algo={algo!r} "
+                "(sp_eventgrad's top-k wire is already physically sparse)"
+            )
+        if compact_capacity is None or int(compact_capacity) < 1:
+            raise ValueError(
+                "gossip_wire='compact' needs a static compact_capacity "
+                "(elements); pick one with collectives.choose_capacity or "
+                "let train(gossip_wire='compact') autotune it"
+            )
+        compact_capacity = int(compact_capacity)
 
     def step(state, batch):
         x, y = batch
@@ -238,14 +273,24 @@ def make_train_step(
         # scale — so the event algorithms count scales per FIRED leaf only;
         # the always-shipped fire-bit/scale vectors of the SPMD ppermute
         # are artifacts with no reference-wire counterpart.
-        val_bytes = {None: 4.0, "bf16": 2.0, "int8": 1.0}[wire]
+        val_bytes = collectives.WIRE_VAL_BYTES[wire]
         scale_bytes_per_leaf = 4.0 if wire == "int8" else 0.0
+        n_params_static = trees.tree_count_params(params)
+        n_leaves_static = trees.tree_num_leaves(params)
         total_bytes = jnp.float32(
-            val_bytes * trees.tree_count_params(params)
-            + scale_bytes_per_leaf * trees.tree_num_leaves(params)
+            val_bytes * n_params_static
+            + scale_bytes_per_leaf * n_leaves_static
         )
         fired_frac = jnp.float32(1.0)
         sent_bytes = jnp.float32(n_nb) * total_bytes
+        # wire truth (vs the accounting model above): bytes the SPMD
+        # collective actually moves per step — dense payloads ship whole
+        # regardless of fire bits; only the compact wire (and sp's top-k
+        # lanes) shrink this number. Constant per step per mode.
+        fired_elems = jnp.float32(n_params_static)
+        wire_real = jnp.float32(n_nb) * collectives.wire_real_bytes_per_neighbor(
+            n_params_static, n_leaves_static, wire
+        )
 
         # chaos: per-edge delivered bits for this pass (deterministic in
         # (seed, pass, rank, edge) — see chaos/inject.py); [n_nb] bool
@@ -264,7 +309,10 @@ def make_train_step(
             # the gossip wire dtype.
             for ax in topo.gossip_axes:
                 grads = lax.pmean(grads, ax)
-            sent_bytes = jnp.float32(4.0 * trees.tree_count_params(params))
+            sent_bytes = jnp.float32(4.0 * n_params_static)
+            # XLA owns the all-reduce schedule; report the logical
+            # full-precision gradient volume as the wire number too
+            wire_real = sent_bytes
 
         elif algo == "dpsgd":
             bufs = collectives.neighbor_vals(params, topo, wire)
@@ -280,13 +328,53 @@ def make_train_step(
                 if (chaos is not None and chaos_policy.sync_after)
                 else None
             )
-            fire, event_state = decide_and_update(
-                params, event_state, pass_num, event_cfg, n_nb,
+            p_leaves, p_def = jax.tree.flatten(params)
+            prop = propose(
+                params, event_state, pass_num, event_cfg,
                 force_fire=force_fire,
             )
-            new_bufs, recv_fires = collectives.masked_neighbor_vals(
-                params, fire, event_state.bufs, topo, wire, deliver=deliver
+            fire_vec = prop.fire_vec
+            if gossip_wire == "compact":
+                # wire-budget admission: overdue leaves (max_silence) and
+                # chaos forced syncs claim capacity first; the overflow is
+                # deferred — commit() below rolls its state back so it
+                # re-contends next pass
+                leaf_sizes = tuple(int(l.size) for l in p_leaves)
+                pri = None
+                if event_cfg.max_silence > 0:
+                    pri = prop.iter_diff >= event_cfg.max_silence
+                if force_fire is not None:
+                    ff = jnp.broadcast_to(force_fire, fire_vec.shape)
+                    pri = ff if pri is None else (pri | ff)
+                fire_vec = capacity_gate(
+                    prop.fire_vec, leaf_sizes, compact_capacity, priority=pri
+                )
+            event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
+            fire = jax.tree.unflatten(
+                p_def, [fire_vec[i] for i in range(len(p_leaves))]
             )
+            if gossip_wire == "compact":
+                new_bufs, recv_fires = collectives.compact_neighbor_vals(
+                    params, fire, event_state.bufs, topo, compact_capacity,
+                    wire, deliver=deliver,
+                )
+                wire_real = jnp.float32(n_nb) * (
+                    collectives.wire_real_bytes_per_neighbor(
+                        n_params_static, n_leaves_static, wire,
+                        compact_capacity=compact_capacity, fire_bits=True,
+                    )
+                )
+            else:
+                new_bufs, recv_fires = collectives.masked_neighbor_vals(
+                    params, fire, event_state.bufs, topo, wire,
+                    deliver=deliver,
+                )
+                wire_real = jnp.float32(n_nb) * (
+                    collectives.wire_real_bytes_per_neighbor(
+                        n_params_static, n_leaves_static, wire,
+                        fire_bits=True,
+                    )
+                )
             if deliver is not None:
                 # recv_fires are the RAW sender bits: sent & delivered
                 # resets silence, sent & ~delivered is an observed
@@ -311,8 +399,9 @@ def make_train_step(
                 (f.astype(jnp.float32), p.size)
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
+            fired_elems = sum(f * n for f, n in fired)
             sent_bytes = jnp.float32(n_nb) * (
-                val_bytes * sum(f * n for f, n in fired)
+                val_bytes * fired_elems
                 + scale_bytes_per_leaf * sum(f for f, _ in fired)
             )
             fired_frac = sum(f for f, _ in fired) / len(fired)
@@ -331,11 +420,21 @@ def make_train_step(
                 for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
             ]
             # values + int32 indices per selected element per neighbor
+            fired_elems = sum(f * k for f, k in fired)
             sent_bytes = jnp.float32(n_nb) * (
-                (val_bytes + 4.0) * sum(f * k for f, k in fired)
+                (val_bytes + 4.0) * fired_elems
                 + scale_bytes_per_leaf * sum(f for f, _ in fired)
             )
             fired_frac = sum(f for f, _ in fired) / len(fired)
+            # the top-k lanes physically ship every pass (masked on
+            # receipt): k values + k int32 indices per leaf per neighbor,
+            # plus the fire bits (and int8 scales)
+            k_total = sum(sparse_cfg.k_for(p.size) for p in jax.tree.leaves(params))
+            wire_real = jnp.float32(n_nb) * (
+                (val_bytes + 4.0) * k_total
+                + 1.0 * n_leaves_static
+                + scale_bytes_per_leaf * n_leaves_static
+            )
 
         use_fused = fused_sgd is not None and algo != "allreduce"
         if use_fused:
@@ -406,8 +505,16 @@ def make_train_step(
             "correct": jnp.sum(jnp.argmax(out, axis=-1) == y).astype(jnp.int32),
             "fired_frac": fired_frac,
             "sent_bytes": sent_bytes,
+            # fired payload elements this step (autotune input for the
+            # compact wire) and the bytes the collective ACTUALLY moved
+            "fired_elems": jnp.asarray(fired_elems, jnp.float32),
+            "sent_bytes_wire_real": jnp.asarray(wire_real, jnp.float32),
             "num_events": (
                 event_state.num_events if event_state is not None else jnp.int32(0)
+            ),
+            "num_deferred": (
+                event_state.num_deferred
+                if event_state is not None else jnp.int32(0)
             ),
         }
         if chaos is not None:

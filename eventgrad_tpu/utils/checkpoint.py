@@ -12,6 +12,7 @@ import shutil
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 
@@ -62,3 +63,53 @@ def restore(path: str, template: Any) -> Any:
     with ocp.PyTreeCheckpointer() as ckptr:
         target = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         return ckptr.restore(path, item=target)
+
+
+def _path_name(keypath) -> str:
+    """'/'-joined leaf path that is stable across container kinds: flax
+    struct fields (GetAttrKey), dicts (DictKey), and tuples vs the lists
+    orbax restores them as (SequenceKey) all reduce to their name/index."""
+    return "/".join(
+        str(getattr(k, "name", getattr(k, "key", getattr(k, "idx", k))))
+        for k in keypath
+    )
+
+
+def restore_with_fill(path: str, template: Any):
+    """Forward-compatible restore: snapshot leaves graft onto `template`
+    BY PATH, and any leaf the snapshot lacks keeps its template (init)
+    value — so a state field added after the snapshot was taken (e.g. a
+    new counter) resumes from its initial value instead of failing the
+    exact-structure match `restore` enforces. Returns (restored,
+    missing_path_names); the caller decides how loud to be about the
+    fills. A snapshot leaf with no template counterpart is ignored."""
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        raw = ckptr.restore(path)
+    raw_map = {
+        _path_name(kp): v
+        for kp, v in jax.tree_util.tree_flatten_with_path(raw)[0]
+    }
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    filled, missing = [], []
+    for kp, tmpl_leaf in flat:
+        name = _path_name(kp)
+        if name in raw_map:
+            # host numpy, like the exact-structure restore returns (the
+            # trace carry is MUTATED by the trace writer; device arrays
+            # would break it)
+            raw_leaf = np.asarray(raw_map[name])
+            tmpl_np = np.asarray(tmpl_leaf)
+            if raw_leaf.shape != tmpl_np.shape:
+                # a path that still exists but changed shape (different
+                # rank count, history depth, ...) is NOT an added-field
+                # migration — grafting it would corrupt state silently
+                raise ValueError(
+                    f"snapshot leaf {name} has shape {raw_leaf.shape}, "
+                    f"template wants {tmpl_np.shape}"
+                )
+            filled.append(raw_leaf.astype(tmpl_np.dtype))
+        else:
+            missing.append(name)
+            filled.append(tmpl_leaf)
+    return jax.tree_util.tree_unflatten(treedef, filled), missing

@@ -1,0 +1,442 @@
+"""Budgeted compacted gossip: wire-format equivalence, deferral, autotune.
+
+The compact exchange's contract (docs/compaction.md): whenever every
+fired leaf fits the budget, `compact_neighbor_vals` is BITWISE
+`masked_neighbor_vals` — on every wire dtype and both lifting paths —
+while moving capacity/n_params of the dense value lanes; overflow defers
+fired leaves (rolled-back event state, max_silence-overdue priority)
+instead of dropping them; the autotuned capacity is a static bucketed
+number so the switched-to step compiles exactly once.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import (
+    EventConfig, EventState, capacity_gate, commit, propose,
+)
+from eventgrad_tpu.parallel.spmd import build_mesh, spmd
+from eventgrad_tpu.parallel.topology import Ring, Torus
+from eventgrad_tpu.train.loop import train
+
+# the mesh lift needs jax.shard_map; some CPU-only environments run a
+# jax without it (the seed's shard_map tests fail there for the same
+# reason) — the equivalence still gets proven on the vmap lift
+BACKENDS = [
+    "vmap",
+    pytest.param("shard_map", marks=pytest.mark.skipif(
+        not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
+    )),
+]
+
+
+def _lift(fn, topo, backend):
+    if backend == "vmap":
+        return spmd(fn, topo)
+    return spmd(fn, topo, mesh=build_mesh(topo))
+
+
+def _tree(rng, n_ranks):
+    return {
+        "a": jnp.asarray(rng.standard_normal((n_ranks, 3, 2)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((n_ranks, 5)), jnp.float32),
+        "c": jnp.asarray(rng.standard_normal((n_ranks, 7)), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("wire", [None, "bf16", "int8"])
+def test_compact_bitwise_matches_masked(backend, wire):
+    """capacity >= fired payload => identical buffers AND identical
+    received fire bits, per wire dtype, per lift."""
+    topo = Ring(4)
+    rng = np.random.default_rng(0)
+    p = _tree(rng, 4)
+    fire = {
+        "a": jnp.array([True, False, True, False]),
+        "b": jnp.array([False, True, True, False]),
+        "c": jnp.array([True, True, False, False]),
+    }
+    last = jax.tree.map(lambda x: jnp.full_like(x, -9.0), p)
+
+    def f_mask(p, f, l):
+        return collectives.masked_neighbor_vals(p, f, (l, l), topo, wire)
+
+    def f_comp(p, f, l):
+        # capacity 18 >= worst-case fired total (a+c = 13, b+c = 12, ...)
+        return collectives.compact_neighbor_vals(
+            p, f, (l, l), topo, 18, wire
+        )
+
+    bm, fm = _lift(f_mask, topo, backend)(p, fire, last)
+    bc, fc = _lift(f_comp, topo, backend)(p, fire, last)
+    for a, b in zip(jax.tree.leaves(bm), jax.tree.leaves(bc)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(fm), jax.tree.leaves(fc)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compact_torus_four_neighbors():
+    """4-neighbor torus: every edge's buffer matches the masked path."""
+    topo = Torus(4, 2)
+    rng = np.random.default_rng(1)
+    p = _tree(rng, 8)
+    fire = {
+        "a": jnp.asarray(rng.random(8) < 0.5),
+        "b": jnp.asarray(rng.random(8) < 0.5),
+        "c": jnp.asarray(rng.random(8) < 0.5),
+    }
+    last = jax.tree.map(lambda x: jnp.full_like(x, -3.0), p)
+    n_nb = topo.n_neighbors
+
+    def f_mask(p, f, l):
+        return collectives.masked_neighbor_vals(
+            p, f, (l,) * n_nb, topo
+        )
+
+    def f_comp(p, f, l):
+        return collectives.compact_neighbor_vals(
+            p, f, (l,) * n_nb, topo, 18
+        )
+
+    bm, _ = spmd(f_mask, topo)(p, fire, last)
+    bc, _ = spmd(f_comp, topo)(p, fire, last)
+    for a, b in zip(jax.tree.leaves(bm), jax.tree.leaves(bc)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compact_deliver_gating_matches_masked():
+    """Chaos per-edge delivery bits gate the compact scatter exactly like
+    the masked where()."""
+    topo = Ring(4)
+    rng = np.random.default_rng(2)
+    p = _tree(rng, 4)
+    fire = jax.tree.map(lambda x: jnp.ones((4,), bool), p)
+    last = jax.tree.map(lambda x: jnp.full_like(x, -1.0), p)
+    deliver = jnp.tile(jnp.array([[True, False]]), (4, 1))  # right edge down
+
+    def f_mask(p, f, l, d):
+        return collectives.masked_neighbor_vals(
+            p, f, (l, l), topo, deliver=d
+        )
+
+    def f_comp(p, f, l, d):
+        return collectives.compact_neighbor_vals(
+            p, f, (l, l), topo, 18, deliver=d
+        )
+
+    bm, fm = spmd(f_mask, topo)(p, fire, last, deliver)
+    bc, fc = spmd(f_comp, topo)(p, fire, last, deliver)
+    for a, b in zip(jax.tree.leaves((bm, fm)), jax.tree.leaves((bc, fc))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the gated edge really kept its stale buffer
+    np.testing.assert_array_equal(np.asarray(bc[1]["a"]), -1.0)
+
+
+def test_compact_capacity_below_largest_leaf_rejected():
+    topo = Ring(4)
+    p = _tree(np.random.default_rng(0), 4)
+    fire = jax.tree.map(lambda x: jnp.ones((4,), bool), p)
+    last = jax.tree.map(jnp.zeros_like, p)
+    with pytest.raises(ValueError, match="largest leaf"):
+        spmd(
+            lambda p, f, l: collectives.compact_neighbor_vals(
+                p, f, (l, l), topo, 5  # < leaf c's 7 elements
+            ),
+            topo,
+        )(p, fire, last)
+
+
+def test_capacity_gate_greedy_and_priority():
+    sizes = (6, 5, 7)
+    fire = jnp.array([True, True, True])
+    # leaf order: a(6)+b(5)=11 fit a 12-budget, c(7) defers
+    np.testing.assert_array_equal(
+        np.asarray(capacity_gate(fire, sizes, 12)), [True, True, False]
+    )
+    # c overdue -> admitted first; a/b no longer fit
+    np.testing.assert_array_equal(
+        np.asarray(capacity_gate(
+            fire, sizes, 12, priority=jnp.array([False, False, True])
+        )),
+        [False, False, True],
+    )
+    # gate output is always a subset of the proposal
+    np.testing.assert_array_equal(
+        np.asarray(capacity_gate(
+            jnp.array([False, True, False]), sizes, 12
+        )),
+        [False, True, False],
+    )
+
+
+def test_deferral_rolls_back_and_silence_bound_holds():
+    """Under a budget that fits one leaf per pass, max_silence-overdue
+    leaves take priority, so no leaf's silence exceeds the bound plus the
+    overdue-queue drain time; deferrals are counted and committed state
+    for deferred leaves is untouched."""
+    topo = Ring(2)
+    cfg = EventConfig(adaptive=False, constant=0.0, warmup_passes=0,
+                      max_silence=3)
+    params = {"a": jnp.zeros(4), "b": jnp.zeros(4), "c": jnp.zeros(4)}
+    sizes = (4, 4, 4)
+    st = EventState.init(params, topo, cfg)
+    max_silence_seen = 0
+    deferred_total = 0
+    for p in range(1, 25):
+        prop = propose(params, st, jnp.int32(p), cfg)
+        # constant-0 threshold: every leaf proposes to fire every pass
+        assert bool(np.all(np.asarray(prop.fire_vec)))
+        overdue = prop.iter_diff >= cfg.max_silence
+        eff = capacity_gate(prop.fire_vec, sizes, 4, priority=overdue)
+        assert int(np.asarray(eff).sum()) == 1  # budget fits one leaf
+        st = commit(st, prop, eff, cfg, topo.n_neighbors)
+        silence = p - np.asarray(st.last_sent_iter)
+        max_silence_seen = max(max_silence_seen, int(silence.max()))
+        deferred_total = int(np.asarray(st.num_deferred))
+    # bound: max_silence + (n_leaves - 1) passes to drain the overdue queue
+    assert max_silence_seen <= cfg.max_silence + len(sizes) - 1
+    assert deferred_total > 0
+    # num_events counts EFFECTIVE sends: one leaf x n_neighbors per pass
+    assert int(np.asarray(st.num_events)) == 24 * topo.n_neighbors
+
+
+def test_choose_capacity_bucketing_and_clamps():
+    # nearby observations land in the SAME bucket: no recompile churn
+    a = collectives.choose_capacity(1_000_000, 30_000, 100)
+    b = collectives.choose_capacity(1_000_000, 30_500, 100)
+    assert a == b
+    assert a % 8192 == 0 and a >= 30_500 * 1.25
+    # floor (largest leaf) and ceiling (whole model) hold
+    assert collectives.choose_capacity(1_000_000, 10, 50_000) >= 50_000
+    assert collectives.choose_capacity(1_000_000, 2_000_000, 100) == 1_000_000
+
+
+def _go(gossip_wire="dense", compact_frac=None, **kw):
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=6)
+    kw.setdefault(
+        "event_cfg", EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    )
+    return train(
+        MLP(), Ring(4), x, y,
+        algo="eventgrad", epochs=4, batch_size=8, learning_rate=0.05,
+        seed=1, log_every_epoch=False, gossip_wire=gossip_wire,
+        compact_frac=compact_frac, **kw,
+    )
+
+
+def test_train_compact_frac1_bitwise_equals_masked():
+    """compact_frac=1.0 (capacity = n_params, nothing defers) must
+    reproduce the masked run bit-for-bit end to end, dense warmup phase
+    and all."""
+    sm, hm = _go()
+    sc, hc = _go(gossip_wire="compact", compact_frac=1.0)
+    for a, b in zip(jax.tree.leaves(sm.params), jax.tree.leaves(sc.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the mode switch really happened after the warmup block...
+    assert [h["gossip_wire"] for h in hc] == [
+        "dense", "compact", "compact", "compact"
+    ]
+    # ...at a constant static capacity (no churn)
+    caps = {h["compact_capacity"] for h in hc if "compact_capacity" in h}
+    assert len(caps) == 1
+    # and events/savings accounting is unchanged by the wire mode
+    assert hm[-1]["num_events"] == hc[-1]["num_events"]
+    assert hc[-1]["num_deferred"] == 0
+
+
+def test_train_wire_real_bytes_reported():
+    """Every mode reports the SPMD wire truth; masked = dense payload +
+    fire bytes regardless of the fire rate."""
+    _, h = _go()
+    n_params, n_leaves, n_nb = 101770, 4, 2
+    np.testing.assert_allclose(
+        h[-1]["sent_bytes_wire_real_per_step_per_chip"],
+        n_nb * (4.0 * n_params + n_leaves),
+    )
+    # the accounting number is far below it at this op-point's fire rate
+    assert (
+        h[-1]["sent_bytes_per_step_per_chip"]
+        < h[-1]["sent_bytes_wire_real_per_step_per_chip"]
+    )
+
+
+def test_train_autotune_declines_when_floor_pins_capacity():
+    """MLP's 98.6%-of-model kernel makes the largest-leaf floor reach
+    n_params: the autotuner must stay dense and say so, not compile a
+    pointless full-capacity program."""
+    os.environ["EG_COMPACT_MIN_SAMPLES"] = "4"
+    try:
+        _, h = _go(gossip_wire="compact")
+    finally:
+        del os.environ["EG_COMPACT_MIN_SAMPLES"]
+    assert all(r["gossip_wire"] == "dense" for r in h)
+    skipped = [r for r in h if "compact_skipped" in r]
+    assert len(skipped) == 1 and skipped[0]["compact_autotuned"]
+
+
+class _ManyLeafMLP:
+    """8 balanced Dense blocks: a geometry where compaction CAN pay
+    (largest leaf ~1/8 of the model), unlike the reference's CNNs."""
+
+    def __new__(cls):
+        import flax.linen as nn
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False, **kw):
+                x = x.reshape((x.shape[0], -1))
+                x = nn.Dense(64)(x)
+                for _ in range(6):
+                    x = nn.relu(nn.Dense(64)(x))
+                return nn.Dense(10)(x)
+
+        return M()
+
+
+def test_train_autotune_activates_on_many_leaf_model():
+    os.environ["EG_COMPACT_MIN_SAMPLES"] = "4"
+    try:
+        x, y = synthetic_dataset(128, (8, 8, 1), seed=6)
+        cfg = EventConfig(adaptive=True, horizon=1.1, warmup_passes=2)
+        _, h = train(
+            _ManyLeafMLP(), Ring(4), x, y,
+            algo="eventgrad", epochs=6, batch_size=8, learning_rate=0.05,
+            seed=1, log_every_epoch=False, gossip_wire="compact",
+            event_cfg=cfg,
+        )
+    finally:
+        del os.environ["EG_COMPACT_MIN_SAMPLES"]
+    modes = [r["gossip_wire"] for r in h]
+    assert modes[0] == "dense" and modes[-1] == "compact", modes
+    compact_recs = [r for r in h if r["gossip_wire"] == "compact"]
+    caps = {r["compact_capacity"] for r in compact_recs}
+    assert len(caps) == 1  # static across dispatches
+    cap = caps.pop()
+    n_params = h[0]["n_params"]
+    assert cap < n_params
+    # wire truth dropped with the switch: compact blocks move fewer bytes
+    dense_real = h[0]["sent_bytes_wire_real_per_step_per_chip"]
+    comp_real = compact_recs[-1]["sent_bytes_wire_real_per_step_per_chip"]
+    n_leaves = 16
+    np.testing.assert_allclose(
+        comp_real, 2 * (4.0 * cap + n_leaves)
+    )
+    assert comp_real < dense_real
+
+
+def test_train_compact_tight_budget_defers_but_trains():
+    """An explicit under-sized budget exercises deferral inside the jitted
+    step: deferrals accumulate, training stays finite, and the guard
+    keeps staleness bounded."""
+    x, y = synthetic_dataset(128, (8, 8, 1), seed=6)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2,
+                      max_silence=4)
+    _, h = train(
+        _ManyLeafMLP(), Ring(4), x, y,
+        algo="eventgrad", epochs=6, batch_size=8, learning_rate=0.05,
+        seed=1, log_every_epoch=False, gossip_wire="compact",
+        compact_frac=0.30, event_cfg=cfg,
+    )
+    assert h[-1]["gossip_wire"] == "compact"
+    assert h[-1]["num_deferred"] > 0
+    assert np.isfinite(h[-1]["loss"])
+
+
+def test_train_compact_rejected_for_non_event_algos():
+    with pytest.raises(ValueError, match="eventgrad"):
+        x, y = synthetic_dataset(64, (28, 28, 1), seed=0)
+        train(MLP(), Ring(4), x, y, algo="dpsgd", epochs=1, batch_size=8,
+              gossip_wire="compact", log_every_epoch=False)
+    with pytest.raises(ValueError, match="compact_frac"):
+        x, y = synthetic_dataset(64, (28, 28, 1), seed=0)
+        train(MLP(), Ring(4), x, y, algo="eventgrad", epochs=1,
+              batch_size=8, compact_frac=0.5, log_every_epoch=False)
+
+
+def test_cli_gossip_wire_validation():
+    from eventgrad_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="eventgrad"):
+        main(["--algo", "dpsgd", "--gossip-wire", "compact"])
+    with pytest.raises(SystemExit, match="compact-frac"):
+        main(["--algo", "eventgrad", "--compact-frac", "0.5"])
+    with pytest.raises(SystemExit, match="0, 1"):
+        main(["--algo", "eventgrad", "--gossip-wire", "compact",
+              "--compact-frac", "1.5"])
+
+
+def test_resume_migrates_pre_compaction_snapshot(tmp_path):
+    """A snapshot saved before EventState.num_deferred existed must still
+    resume: the counter grafts in at zero (checkpoint.restore_with_fill)
+    instead of failing orbax's exact-structure match."""
+    import shutil
+    import warnings
+
+    import orbax.checkpoint as ocp
+
+    from eventgrad_tpu.utils import checkpoint
+
+    d = str(tmp_path)
+    x, y = synthetic_dataset(128, (28, 28, 1), seed=6)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    kw = dict(algo="eventgrad", epochs=2, batch_size=8, learning_rate=0.05,
+              seed=1, log_every_epoch=False, event_cfg=cfg)
+    train(MLP(), Ring(4), x, y, checkpoint_dir=d, **kw)
+
+    # rewrite the snapshot with the PRE-compaction state structure
+    p = os.path.join(d, "ckpt")
+    with ocp.PyTreeCheckpointer() as c:
+        old = c.restore(p)
+    del old["state"]["event"]["num_deferred"]
+    shutil.rmtree(p)
+    checkpoint.save(p, old)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        s2, h2 = train(MLP(), Ring(4), x, y, checkpoint_dir=d, resume=True,
+                       **{**kw, "epochs": 3})
+    assert [r["epoch"] for r in h2] == [3]
+    np.testing.assert_array_equal(np.asarray(s2.event.num_deferred) >= 0,
+                                  True)
+    assert any("num_deferred" in str(x.message) for x in w)
+
+
+def test_mix_weighted_fused_stays_bitwise_vs_reference_loop():
+    """Satellite guard: the single-traversal mix_weighted must equal the
+    old per-edge accumulation bitwise, gates on or off."""
+    rng = np.random.default_rng(3)
+    params = {"a": jnp.asarray(rng.standard_normal((4, 3)), jnp.float32),
+              "b": jnp.asarray(rng.standard_normal(5), jnp.float32)}
+    bufs = tuple(
+        jax.tree.map(
+            lambda x, _i=i: x + np.float32(0.1) * (_i + 1), params
+        )
+        for i in range(3)
+    )
+
+    def reference(params, bufs, gate):
+        acc = params
+        for i, buf in enumerate(bufs):
+            acc = jax.tree.map(
+                lambda x, b, _g=gate[i]: x + jnp.where(
+                    _g, b, jnp.zeros_like(b)
+                ),
+                acc, buf,
+            )
+        w = 1.0 / (1.0 + jnp.sum(gate.astype(jnp.float32)))
+        return jax.tree.map(lambda x: x * w, acc)
+
+    for bits in ([True, True, True], [True, False, True], [False] * 3):
+        gate = jnp.asarray(bits)
+        got = collectives.mix_weighted(params, bufs, gate)
+        want = reference(params, bufs, gate)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
