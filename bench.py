@@ -71,6 +71,12 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       (pick_cifar_epochs, pick_mnist_rung). Manual
                       full-scale run: EG_BENCH_CHILD=1
                       EG_BENCH_ATTEMPT_S=3600 EG_BENCH_TIER=full
+  EG_BENCH_OBS_TRACE  path: export a Chrome-trace/Perfetto span JSON of
+                      the bench's own phases (the obs.Registry spans
+                      around each train/eval leg — docs/OBSERVABILITY.md)
+                      so a bench run can be inspected in chrome://tracing;
+                      unset = spans are still recorded (host-side, ~free)
+                      but nothing is written
   EG_BENCH_CHAOS      chaos mode (robustness instead of savings): run the
                       tools/chaos_sweep.py drop-rate/recovery sweep and
                       emit ITS record as the last JSON line. "1" =
@@ -290,23 +296,37 @@ def main() -> None:
         epochs_per_dispatch=k_disp,
     )
 
+    # host span trace of the bench's own phases (obs.Registry): always
+    # recorded (host-side tuples, ~free), exported only when
+    # EG_BENCH_OBS_TRACE names a path
+    from eventgrad_tpu.obs import Registry
+
+    obs_reg = Registry(run_meta={"tool": "bench", "tier": tier})
+
     t0 = time.perf_counter()
-    state, hist = train(
-        model, topo, x, y, algo="eventgrad", event_cfg=event_cfg, **common
-    )
+    with obs_reg.span("cifar_eventgrad", cat="leg", tier=tier):
+        state, hist = train(
+            model, topo, x, y, algo="eventgrad", event_cfg=event_cfg,
+            registry=obs_reg, **common
+        )
     wall_event = time.perf_counter() - t0
-    cons = consensus_params(state.params)
-    stats0 = rank0_slice(state.batch_stats)
-    test = evaluate(model, cons, stats0, xt, yt)
+    with obs_reg.span("eval_eventgrad", cat="leg"):
+        cons = consensus_params(state.params)
+        stats0 = rank0_slice(state.batch_stats)
+        test = evaluate(model, cons, stats0, xt, yt)
 
     # D-PSGD comparison leg — SAME op-point, every tier (the other half of
     # the reference's claim: comparable accuracy at the savings)
     t0 = time.perf_counter()
-    state_d, hist_d = train(model, topo, x, y, algo="dpsgd", **common)
+    with obs_reg.span("cifar_dpsgd", cat="leg", tier=tier):
+        state_d, hist_d = train(
+            model, topo, x, y, algo="dpsgd", registry=obs_reg, **common
+        )
     wall_dpsgd = time.perf_counter() - t0
-    cons_d = consensus_params(state_d.params)
-    stats_d = rank0_slice(state_d.batch_stats)
-    test_d = evaluate(model, cons_d, stats_d, xt, yt)
+    with obs_reg.span("eval_dpsgd", cat="leg"):
+        cons_d = consensus_params(state_d.params)
+        stats_d = rank0_slice(state_d.batch_stats)
+        test_d = evaluate(model, cons_d, stats_d, xt, yt)
 
     # secondary op-point: MNIST CNN-2, batch 64/rank, lr 0.05, sequential
     # sampler (event.cpp:103,145,227,255) — reference ~70%.
@@ -340,12 +360,13 @@ def main() -> None:
         adaptive=True, horizon=horizon_mnist, warmup_passes=warmup,
         max_silence=mnist_silence,
     )
-    _, hist_m = train(
-        CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=mnist_cfg,
-        epochs=mnist_epochs, batch_size=mnist_batch,
-        learning_rate=0.05, random_sampler=False, log_every_epoch=False,
-        epochs_per_dispatch=k_disp,
-    )
+    with obs_reg.span("mnist_eventgrad", cat="leg", tier=tier):
+        _, hist_m = train(
+            CNN2(), topo, xm, ym, algo="eventgrad", event_cfg=mnist_cfg,
+            epochs=mnist_epochs, batch_size=mnist_batch,
+            learning_rate=0.05, random_sampler=False, log_every_epoch=False,
+            epochs_per_dispatch=k_disp, registry=obs_reg,
+        )
     mnist_saved = hist_m[-1]["msgs_saved_pct"]
 
     # collapse guard (round-3 verdict item 7): a diverged event run must
@@ -573,6 +594,13 @@ def main() -> None:
             }
         )
     )
+
+    trace_path = os.environ.get("EG_BENCH_OBS_TRACE")
+    if trace_path:
+        # bench step timings ride as gauges next to the leg spans
+        obs_reg.gauge("bench_step_ms", 1000 * step_s)
+        obs_reg.gauge("bench_step_ms_dpsgd", 1000 * step_s_d)
+        obs_reg.write_chrome_trace(trace_path)
 
 
 # deadlined-subprocess + executed-jit probe logic is shared with

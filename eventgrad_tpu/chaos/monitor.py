@@ -126,23 +126,30 @@ def edge_status(
     return "suspect" if silence > suspect_factor * max_silence else "healthy"
 
 
-def health_record(silence, drops, max_silence: int) -> Dict[str, object]:
+def health_record(
+    silence, drops, max_silence: int, edges=None,
+) -> Dict[str, object]:
     """Summarize host-fetched PeerHealth counters into JSONL-ready fields:
     per-edge max silence across ranks, its `edge_status` classification,
     and the total injected-drop count. The ONE summarizer behind the
-    epoch records of train() and the sweep artifacts — `silence` is
-    [n_ranks, n_neighbors], `drops` any array of per-rank cumulative
-    counts."""
+    epoch records of train(), the sweep artifacts, and the telemetry
+    registry's per-edge gauges (obs.Registry.observe_health) — `silence`
+    is [n_ranks, n_neighbors], `drops` any array of per-rank cumulative
+    counts. `edges` (neighbor names, topology order) labels the edges in
+    the record; omitted, the lists stay positional as before."""
     import numpy as np
 
     silence = np.asarray(silence)
     per_edge_max = (
         silence.max(axis=0) if silence.size else np.zeros((0,), np.int64)
     )
-    return {
+    rec = {
         "edge_silence_max": [int(v) for v in per_edge_max],
         "edge_status": [
             edge_status(int(v), max_silence) for v in per_edge_max
         ],
         "chaos_drops": int(np.asarray(drops).sum()),
     }
+    if edges is not None:
+        rec["edges"] = list(edges)
+    return rec

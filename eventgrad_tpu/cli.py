@@ -268,6 +268,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler (XPlane/TensorBoard) trace "
                         "of the training run into this directory")
+    p.add_argument("--obs", choices=["off", "block", "epoch"],
+                   default="off",
+                   help="on-device telemetry (docs/OBSERVABILITY.md): "
+                        "per-leaf fire/deferral counts, threshold and "
+                        "drift trajectories, silence histograms, per-edge "
+                        "wire bytes — accumulated in the train scan and "
+                        "flushed to host once per jit-dispatch block "
+                        "(zero per-step host syncs); block = summaries "
+                        "ride block-end epoch records, epoch = every "
+                        "epoch (pins --epochs-per-dispatch behavior to "
+                        "1); off = bit-identical to a telemetry-free run")
+    p.add_argument("--obs-dir", default=None, metavar="DIR",
+                   help="export host observability artifacts into DIR at "
+                        "exit: trace.json (Chrome-trace/Perfetto spans of "
+                        "dispatch blocks, eval, checkpoint, telemetry "
+                        "flushes — open in chrome://tracing) and "
+                        "metrics.prom (Prometheus textfile gauges)")
+    p.add_argument("--log-fsync", action="store_true",
+                   help="fsync the --log-file after every record — "
+                        "crash-safe JSONL artifacts")
     return p
 
 
@@ -290,8 +310,20 @@ def main(argv=None) -> int:
 
     primary = multihost.is_primary()
     logger = JsonlLogger(
-        args.log_file if primary else None, echo=primary
+        args.log_file if primary else None, echo=primary,
+        fsync=args.log_fsync,
     )
+    registry = None
+    if args.obs != "off" or args.obs_dir:
+        from eventgrad_tpu.obs import Registry
+
+        # the registry wraps (not owns) the logger: every record gains
+        # the obs_schema stamp; spans/gauges export at exit via --obs-dir
+        registry = Registry(
+            logger=logger,
+            run_meta={"algo": args.algo, "model": args.model},
+        )
+    emit = registry.record if registry is not None else logger.log
 
     is_lm = args.model in LM_MODELS
     if args.dataset is None:
@@ -462,43 +494,81 @@ def main(argv=None) -> int:
         profiling.trace(args.profile_dir) if args.profile_dir
         else contextlib.nullcontext()
     )
-    with scope:
-        state, hist = train(
-            model, topo, x, y,
-            algo=args.algo, epochs=args.epochs, batch_size=batch,
-            learning_rate=args.lr, momentum=args.momentum,
-            event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
-            augment=args.augment, random_sampler=args.random_sampler,
-            sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
-            checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
-            resume=args.resume, trace_file=args.trace_file,
-            wire=args.wire, staleness=args.staleness,
-            gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
-            fused_update=args.fused, fault_inject=args.fault_inject,
-            chaos=chaos_sched, chaos_policy=chaos_policy,
-            on_epoch=logger.log,  # records stream as epochs finish: live
-            # metrics for the user, a liveness signal for supervise.py
-        )
+    hist = []
+    try:
+        with scope:
+            state, hist = train(
+                model, topo, x, y,
+                algo=args.algo, epochs=args.epochs, batch_size=batch,
+                learning_rate=args.lr, momentum=args.momentum,
+                event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
+                augment=args.augment, random_sampler=args.random_sampler,
+                sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
+                checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
+                resume=args.resume, trace_file=args.trace_file,
+                wire=args.wire, staleness=args.staleness,
+                gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
+                fused_update=args.fused, fault_inject=args.fault_inject,
+                chaos=chaos_sched, chaos_policy=chaos_policy,
+                obs=args.obs, registry=registry,
+                on_epoch=emit,  # records stream as epochs finish: live
+                # metrics for the user, a liveness signal for supervise.py
+            )
 
-    if hybrid:
-        # consensus averaging across sp/tp/pp/ep ranks would mix
-        # differently-sharded parameters; report final train metrics instead
-        # (hist can be empty when resuming from a final-epoch snapshot)
-        if primary:
-            rec = {"final": True, "consensus_eval": False}
-            if hist:
-                rec.update(loss=hist[-1]["loss"], train_acc=hist[-1]["train_acc"])
-            logger.log(rec)
-    else:
-        # allgathers are collective: every process participates...
-        params_host = multihost.to_host(state.params)
-        stats_host = multihost.to_host(state.batch_stats)
-        if primary:  # ...but only the primary spends the eval and logs it
-            cons = consensus_params(params_host)
-            stats0 = rank0_slice(stats_host)
-            final = evaluate(model, cons, stats0, xt, yt)
-            logger.log({"final": True, **final})
-    logger.close()
+        if hybrid:
+            # consensus averaging across sp/tp/pp/ep ranks would mix
+            # differently-sharded parameters; report final train metrics
+            # instead (hist can be empty when resuming from a
+            # final-epoch snapshot)
+            if primary:
+                rec = {"final": True, "consensus_eval": False}
+                if hist:
+                    rec.update(
+                        loss=hist[-1]["loss"], train_acc=hist[-1]["train_acc"]
+                    )
+                emit(rec)
+        else:
+            # allgathers are collective: every process participates...
+            params_host = multihost.to_host(state.params)
+            stats_host = multihost.to_host(state.batch_stats)
+            if primary:  # ...but only the primary spends the eval + log
+                cons = consensus_params(params_host)
+                stats0 = rank0_slice(stats_host)
+                final = evaluate(model, cons, stats0, xt, yt)
+                emit({"final": True, **final})
+    finally:
+        # exporters land even on an exception path — a crashed run's
+        # spans are exactly the ones worth reading — but they are
+        # best-effort: an unwritable --obs-dir must neither mask the
+        # real exception nor skip logger.close()
+        try:
+            if registry is not None and args.obs_dir and primary:
+                # final-state gauges for the textfile collector: the
+                # scrape answers "where did the run end up" without
+                # parsing JSONL
+                if hist:
+                    last = hist[-1]
+                    registry.gauge("epochs_completed", last["epoch"])
+                    for k in (
+                        "loss", "msgs_saved_pct", "test_accuracy",
+                        "sent_bytes_per_step_per_chip",
+                        "sent_bytes_wire_real_per_step_per_chip",
+                    ):
+                        if isinstance(last.get(k), (int, float)):
+                            registry.gauge(f"last_{k}", last[k])
+                os.makedirs(args.obs_dir, exist_ok=True)
+                registry.write_chrome_trace(
+                    os.path.join(args.obs_dir, "trace.json")
+                )
+                registry.write_prometheus(
+                    os.path.join(args.obs_dir, "metrics.prom")
+                )
+        except OSError as e:
+            import warnings
+
+            warnings.warn(f"--obs-dir export failed: {e}", RuntimeWarning)
+        finally:
+            logger.close()
     return 0
 
 

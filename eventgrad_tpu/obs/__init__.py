@@ -1,0 +1,25 @@
+"""Telemetry subsystem: on-device accumulators, host registry, exporters.
+
+See docs/OBSERVABILITY.md. Device side: `TelemetryState` rides the
+train-scan carry and flushes to host once per jit-dispatch block
+(`train(obs="block"|"epoch")`). Host side: `Registry` unifies the JSONL
+metrics stream, chaos peer-health, and profiling latencies behind one
+versioned schema, with Prometheus-textfile and Chrome-trace/Perfetto
+exporters. `obs.report.build_report` (tools/obs_report.py) renders a
+self-contained run report from any history/JSONL.
+"""
+
+from eventgrad_tpu.obs.device import TelemetryState, accumulate
+from eventgrad_tpu.obs.registry import Registry
+from eventgrad_tpu.obs.schema import OBS_SCHEMA_VERSION, SILENCE_BUCKETS
+
+OBS_MODES = ("off", "block", "epoch")
+
+__all__ = [
+    "TelemetryState",
+    "accumulate",
+    "Registry",
+    "OBS_SCHEMA_VERSION",
+    "SILENCE_BUCKETS",
+    "OBS_MODES",
+]

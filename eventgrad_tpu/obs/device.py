@@ -1,0 +1,143 @@
+"""On-device telemetry: a JIT-safe accumulator pytree in the train scan.
+
+The design rule is ZERO added host syncs: every counter lives in the
+scan-carried `TrainState.telemetry` and is CUMULATIVE, so the host reads
+it at most once per jit-dispatch block (train/loop.py flushes at block
+ends and diffs consecutive snapshots — no device-side reset write
+either). Per-pass cost is a handful of fused vector ops on [L] (leaf
+count) and [n_edges] arrays — measured < 3% of a CPU micro-bench step
+(docs/OBSERVABILITY.md).
+
+Field semantics: obs.schema.TELEMETRY_FIELDS (the one versioned
+definition).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+from eventgrad_tpu.obs.schema import SILENCE_BUCKETS
+
+
+class TelemetryState(struct.PyTreeNode):
+    """Per-rank cumulative telemetry counters (see schema.TELEMETRY_FIELDS
+    for units). Stacked over ranks like every other TrainState leaf."""
+
+    steps: jnp.ndarray            # i32 []
+    fire_count: jnp.ndarray       # i32 [L]
+    defer_count: jnp.ndarray      # i32 [L]
+    thres_sum: jnp.ndarray        # f32 [L]
+    drift_sum: jnp.ndarray        # f32 [L]
+    silence_hist: jnp.ndarray     # i32 [SILENCE_BUCKETS]
+    fired_elems_sum: jnp.ndarray  # f32 []
+    fired_elems_peak: jnp.ndarray # f32 []
+    edge_bytes: jnp.ndarray       # f32 [n_edges]
+
+    @classmethod
+    def init(cls, n_leaves: int, n_edges: int) -> "TelemetryState":
+        zl = jnp.zeros((n_leaves,), jnp.float32)
+        return cls(
+            steps=jnp.zeros((), jnp.int32),
+            fire_count=jnp.zeros((n_leaves,), jnp.int32),
+            defer_count=jnp.zeros((n_leaves,), jnp.int32),
+            thres_sum=zl,
+            drift_sum=zl,
+            silence_hist=jnp.zeros((SILENCE_BUCKETS,), jnp.int32),
+            fired_elems_sum=jnp.zeros((), jnp.float32),
+            fired_elems_peak=jnp.zeros((), jnp.float32),
+            edge_bytes=jnp.zeros((n_edges,), jnp.float32),
+        )
+
+
+def silence_bucket(silence: jnp.ndarray) -> jnp.ndarray:
+    """[L] silence (passes since last send) -> log2 bucket index: bucket k
+    counts silence in [2^k, 2^(k+1)); the last bucket absorbs the tail.
+    Silence < 1 (warmup pass 0 edge) clamps into bucket 0."""
+    s = jnp.maximum(silence.astype(jnp.float32), 1.0)
+    return jnp.clip(
+        jnp.floor(jnp.log2(s)).astype(jnp.int32), 0, SILENCE_BUCKETS - 1
+    )
+
+
+def accumulate(
+    tel: TelemetryState,
+    *,
+    fire_vec: Optional[jnp.ndarray] = None,      # bool [L] effective fires
+    defer_vec: Optional[jnp.ndarray] = None,     # bool [L] gated-out fires
+    thres: Optional[jnp.ndarray] = None,         # f32 [L] post-decay
+    drift: Optional[jnp.ndarray] = None,         # f32 [L] |norm - last_sent|
+    silence: Optional[jnp.ndarray] = None,       # f32/i32 [L] passes quiet
+    fired_elems: Optional[jnp.ndarray] = None,   # f32 [] admitted elements
+    edge_bytes: Optional[jnp.ndarray] = None,    # f32 [n_edges] this pass
+) -> TelemetryState:
+    """One pass of counter updates; omitted (None) quantities leave their
+    counters untouched (the non-event algorithms pass only edge_bytes).
+    Pure elementwise/scatter-add vector ops — fuses into the step under
+    jit with no extra HBM round trips."""
+    upd = {"steps": tel.steps + 1}
+    if fire_vec is not None:
+        upd["fire_count"] = tel.fire_count + fire_vec.astype(jnp.int32)
+    if defer_vec is not None:
+        upd["defer_count"] = tel.defer_count + defer_vec.astype(jnp.int32)
+    if thres is not None:
+        upd["thres_sum"] = tel.thres_sum + thres
+    if drift is not None:
+        upd["drift_sum"] = tel.drift_sum + drift
+    if silence is not None:
+        upd["silence_hist"] = tel.silence_hist.at[
+            silence_bucket(silence)
+        ].add(1)
+    if fired_elems is not None:
+        fe = jnp.asarray(fired_elems, jnp.float32)
+        upd["fired_elems_sum"] = tel.fired_elems_sum + fe
+        upd["fired_elems_peak"] = jnp.maximum(tel.fired_elems_peak, fe)
+    if edge_bytes is not None:
+        upd["edge_bytes"] = tel.edge_bytes + edge_bytes
+    return tel.replace(**upd)
+
+
+def window_record(cur, prev=None):
+    """Host-side flush: diff two cumulative stacked snapshots (leading
+    axis = ranks, numpy or device arrays) into one flush-window `obs`
+    dict — the schema.RECORD_FIELDS shape the history records carry.
+    `prev=None` means "since init" (the first flush). Counts sum over
+    ranks; means average over ranks; the fired-elements peak is the max
+    over ranks of the CUMULATIVE running peak (a running max cannot be
+    windowed)."""
+    import numpy as np
+
+    from eventgrad_tpu.obs.schema import OBS_SCHEMA_VERSION
+
+    def d(field):
+        c = np.asarray(getattr(cur, field), np.float64)
+        if prev is None:
+            return c
+        return c - np.asarray(getattr(prev, field), np.float64)
+
+    steps = int(d("steps").reshape(-1)[0])
+    denom = max(1, steps)
+    return {
+        "schema": OBS_SCHEMA_VERSION,
+        "steps": steps,
+        "fire_count": [int(v) for v in d("fire_count").sum(axis=0)],
+        "defer_count": [int(v) for v in d("defer_count").sum(axis=0)],
+        "thres_mean": [
+            round(float(v), 6) for v in d("thres_sum").mean(axis=0) / denom
+        ],
+        "drift_mean": [
+            round(float(v), 6) for v in d("drift_sum").mean(axis=0) / denom
+        ],
+        "silence_hist": [int(v) for v in d("silence_hist").sum(axis=0)],
+        "fired_elems_mean": round(
+            float(d("fired_elems_sum").mean()) / denom, 2
+        ),
+        "fired_elems_peak": float(
+            np.asarray(cur.fired_elems_peak, np.float64).max()
+        ),
+        "edge_bytes_per_step": [
+            round(float(v), 2) for v in d("edge_bytes").mean(axis=0) / denom
+        ],
+    }

@@ -1,0 +1,171 @@
+"""The ONE versioned schema behind every observability surface.
+
+Before this package, the repo had three disconnected observability
+fragments — `utils.metrics.JsonlLogger` records, `chaos.monitor` per-edge
+health counters, and `utils.profiling.timed_steps` latencies — each with
+its own ad-hoc field names. Everything here (the on-device
+`TelemetryState` accumulators, the per-record `obs` block in train()
+history, the Registry's Prometheus gauges) names its fields from the
+tables below, and `docs/OBSERVABILITY.md` mirrors them field-for-field
+(a test keeps the doc honest).
+
+Bump OBS_SCHEMA_VERSION when a field changes meaning or units; adding a
+field is backward compatible (readers must tolerate unknown keys).
+"""
+
+from __future__ import annotations
+
+#: version stamp carried by every Registry record (`obs_schema`) and every
+#: per-block `obs` telemetry dict in train() history
+OBS_SCHEMA_VERSION = 1
+
+#: silence histogram geometry: bucket k counts leaf-passes with silence in
+#: [2^k, 2^(k+1)) passes (bucket 0 = fired on the previous pass); the last
+#: bucket absorbs everything >= 2^(SILENCE_BUCKETS-1)
+SILENCE_BUCKETS = 16
+
+#: Prometheus metric-name prefix for every exported gauge
+PROM_PREFIX = "eventgrad"
+
+#: On-device accumulator fields (obs.device.TelemetryState). All counters
+#: are CUMULATIVE on device — the host diffs consecutive flushes, so a
+#: flush costs one device->host read and zero device writes.
+#: name -> (units, wire modes that populate it, description)
+TELEMETRY_FIELDS = {
+    "steps": (
+        "passes", "all",
+        "passes accumulated since telemetry init",
+    ),
+    "fire_count": (
+        "fires[leaf]", "event algos",
+        "per-leaf EFFECTIVE fires (committed after any capacity gating); "
+        "sum * n_neighbors reconciles with EventState.num_events",
+    ),
+    "defer_count": (
+        "deferrals[leaf]", "compact wire",
+        "per-leaf fires proposed by the trigger but deferred by the "
+        "compact wire budget; sums to EventState.num_deferred",
+    ),
+    "thres_sum": (
+        "threshold-sum[leaf]", "event algos",
+        "per-leaf post-decay threshold sums (mean = /steps): the "
+        "threshold trajectory at block granularity",
+    ),
+    "drift_sum": (
+        "norm-drift-sum[leaf]", "event algos",
+        "per-leaf |  ||p||_2 - last_sent_norm | sums — the trigger's "
+        "drive signal",
+    ),
+    "silence_hist": (
+        "leaf-passes[bucket]", "event algos",
+        "log2-bucketed histogram of per-leaf silence (passes since last "
+        "send) observed at each pass; bucket k = [2^k, 2^(k+1))",
+    ),
+    "fired_elems_sum": (
+        "elements", "event algos",
+        "payload elements admitted to the wire, summed over passes "
+        "(capacity-utilization numerator on the compact wire)",
+    ),
+    "fired_elems_peak": (
+        "elements", "event algos",
+        "max per-pass admitted payload elements since init",
+    ),
+    "edge_bytes": (
+        "bytes[edge]", "gossip algos",
+        "per-edge wire-real bytes accumulated (the SPMD bytes the "
+        "collective actually moved — dense/masked ship the full payload, "
+        "compact ships the static capacity; see docs/compaction.md)",
+    ),
+}
+
+#: Host-side `obs` block attached to block-end history records
+#: (train/loop.py). Every count is the DELTA over the flush window, per
+#: rank summed unless noted. name -> (units, wire modes, description)
+RECORD_FIELDS = {
+    "schema": ("int", "all", "OBS_SCHEMA_VERSION of the writer"),
+    "steps": ("passes", "all", "passes in this flush window"),
+    "fire_count": (
+        "fires[leaf]", "event algos",
+        "per-leaf effective fires, summed over ranks",
+    ),
+    "defer_count": (
+        "deferrals[leaf]", "compact wire",
+        "per-leaf deferrals, summed over ranks",
+    ),
+    "thres_mean": (
+        "threshold[leaf]", "event algos",
+        "per-leaf mean post-decay threshold over the window (rank mean)",
+    ),
+    "drift_mean": (
+        "norm-drift[leaf]", "event algos",
+        "per-leaf mean norm drift over the window (rank mean)",
+    ),
+    "silence_hist": (
+        "leaf-passes[bucket]", "event algos",
+        "silence histogram delta, summed over ranks",
+    ),
+    "fired_elems_mean": (
+        "elements", "event algos",
+        "mean per-pass admitted payload elements (rank mean)",
+    ),
+    "fired_elems_peak": (
+        "elements", "event algos",
+        "peak per-pass admitted payload elements (max over ranks, "
+        "cumulative since init — peaks cannot be windowed from a "
+        "running max)",
+    ),
+    "edge_bytes_per_step": (
+        "bytes[edge]", "gossip algos",
+        "per-edge wire-real bytes per pass (rank mean)",
+    ),
+}
+
+#: keys the first obs-carrying record of a run additionally carries
+RECORD_META_FIELDS = {
+    "leaves": ("names[leaf]", "all", "parameter leaf names, leaf-major"),
+    "edges": ("names[edge]", "all", "gossip edge names (topology order)"),
+    "silence_buckets": (
+        "int", "all", "histogram bucket count (log2 geometry)",
+    ),
+    "n_ranks": ("int", "all", "ranks contributing to summed counts"),
+    "n_neighbors": ("int", "all", "gossip neighbors per rank"),
+    "wire": (
+        "str|null", "all", "gossip wire dtype (null = f32, bf16, int8)",
+    ),
+}
+
+
+#: derived series emitted by obs.report.build_report (tools/obs_report.py)
+REPORT_FIELDS = {
+    "msgs_saved_pct_per_leaf": (
+        "%[leaf] per window", "event algos",
+        "per-leaf messages saved vs D-PSGD "
+        "(utils.metrics.msgs_saved_pct_per_leaf over window fire counts)",
+    ),
+    "fire_rate_heatmap": (
+        "rate[window][leaf]", "event algos",
+        "per-leaf fire rate per flush window (fire_count / (steps * "
+        "n_ranks)) — heatmap rows",
+    ),
+    "thres_heatmap": (
+        "threshold[window][leaf]", "event algos",
+        "per-leaf mean post-decay threshold per flush window",
+    ),
+    "capacity_utilization": (
+        "fraction", "compact wire",
+        "mean admitted payload elements / compact_capacity per window, "
+        "with fired bytes vs the capacity bytes and the deferral rate",
+    ),
+    "consensus_error": (
+        "l2-norm", "all",
+        "||p_i - mean(p)||_2 trajectory at block ends (max/mean over "
+        "ranks)",
+    ),
+}
+
+
+def all_field_names():
+    """Every schema field name, for doc-coverage tests."""
+    names = set(TELEMETRY_FIELDS) | set(RECORD_FIELDS)
+    names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
+    return sorted(names)

@@ -517,6 +517,22 @@ def wire_real_bytes_per_neighbor(
     return b
 
 
+def fired_wire_bytes_per_neighbor(
+    fired_elems: float, fired_leaves: float, wire=None,
+) -> float:
+    """Bytes of USEFUL (fired) payload one neighbor exchange carries —
+    the compact wire's capacity-utilization numerator (vs the
+    `wire_real_bytes_per_neighbor` it actually moves, which is the static
+    capacity). Same per-element/per-leaf constants as the accounting
+    model (WIRE_VAL_BYTES; int8 ships one f32 scale per fired leaf), so
+    `fired / capacity` bytes and elements tell the same story. Consumed
+    by obs.report's capacity-utilization section."""
+    b = WIRE_VAL_BYTES[wire] * float(fired_elems)
+    if wire == "int8":
+        b += 4.0 * float(fired_leaves)
+    return b
+
+
 def mix(params: Any, bufs: Tuple[Any, ...], topo: Topology) -> Any:
     """Uniform gossip averaging with neighbor buffers:
     p <- (p + sum(bufs)) / (1 + n_neighbors)   (event.cpp:469-471: /3 on a

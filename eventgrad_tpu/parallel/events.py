@@ -239,6 +239,9 @@ class EventProposal(struct.PyTreeNode):
     thres: jnp.ndarray       # f32 [L] post-decay, pre-fire threshold
     iter_diff: jnp.ndarray   # f32 [L] passes since last send
     pass_f: jnp.ndarray      # f32 [] — this pass, as float
+    #: f32 [L] |‖p‖₂ − last_sent_norm| — the trigger's drive signal,
+    #: surfaced for the telemetry drift-norm accumulator (obs.device)
+    value_diff: jnp.ndarray = None  # type: ignore[assignment]
 
 
 def propose(
@@ -294,6 +297,7 @@ def propose(
         thres=thres,
         iter_diff=iter_diff,
         pass_f=pass_f,
+        value_diff=value_diff,
     )
 
 

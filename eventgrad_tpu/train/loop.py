@@ -15,6 +15,7 @@ the stacked rank axis — numerically the same reduction.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import os
@@ -29,6 +30,8 @@ import optax
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos import schedule as chaos_schedule
 from eventgrad_tpu.chaos.policy import RecoveryPolicy
+from eventgrad_tpu.obs import OBS_MODES
+from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.data.sharding import epoch_index_plan
 from eventgrad_tpu.parallel import collectives, multihost
@@ -197,6 +200,8 @@ def train(
     on_epoch: Optional[Any] = None,
     device_data: Optional[bool] = None,
     epochs_per_dispatch: int = 1,
+    obs: str = "off",
+    registry: Optional[Any] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -249,6 +254,26 @@ def train(
     ~1.5 GB. Identical trajectories to the host path (same index plans,
     same gather — tests/test_dispatch_modes.py).
 
+    obs ("off" | "block" | "epoch") turns on the on-device telemetry
+    accumulators (obs.device.TelemetryState: per-leaf fire/deferral
+    counts, threshold and drift-norm trajectories, silence histograms,
+    per-edge wire-real bytes). Counters are cumulative in the scan-carried
+    state and flushed to host at most ONCE per jit-dispatch block (the
+    host diffs consecutive snapshots — zero added per-step host syncs and
+    no device-side reset); the flush-window summary rides the block-end
+    history record as `rec["obs"]` (schema: docs/OBSERVABILITY.md).
+    "epoch" additionally pins epochs_per_dispatch to 1 so every epoch IS
+    a block end — per-epoch telemetry at the cost of per-epoch dispatch.
+    "off" is the default and leaves the traced step bit-identical to a
+    telemetry-free build. Block ends also probe the consensus error
+    (single-process, non-hybrid runs), chaos-style.
+
+    registry (an obs.Registry) additionally records host span traces of
+    the loop's own phases — dispatch blocks, eval, checkpoint, telemetry
+    flush — exportable as Chrome-trace/Perfetto JSON
+    (Registry.write_chrome_trace). The loop never closes the registry;
+    the caller owns its lifecycle (cli.py wires --obs-dir).
+
     epochs_per_dispatch=K fuses K consecutive epochs into ONE jit dispatch
     (the scan simply runs K*steps steps), amortizing the per-dispatch host
     and tunnel latency by K. Metrics come back stacked and are split into
@@ -273,6 +298,16 @@ def train(
             raise ValueError(
                 f"compact_frac must be in (0, 1], got {compact_frac}"
             )
+    if obs not in OBS_MODES:
+        raise ValueError(f"obs must be one of {OBS_MODES}, got {obs!r}")
+    obs_on = obs != "off"
+    # span recording is a no-op without a registry (nullcontext) — the
+    # loop's control flow is identical either way
+    def _span(name: str, **args):
+        if registry is None:
+            return contextlib.nullcontext()
+        return registry.span(name, **args)
+
     chaos_sched = chaos_schedule.resolve(chaos) if chaos is not None else None
     fault_mode, fault_epoch = None, -1
     if fault_inject:
@@ -328,6 +363,18 @@ def train(
         state = state.replace(
             chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
         )
+    if obs_on:
+        # cumulative telemetry counters, stacked like chaos health; part
+        # of the snapshot, so a resumed obs run keeps counting where the
+        # interrupted one stopped
+        state = state.replace(
+            telemetry=stack_for_ranks(
+                obs_device.TelemetryState.init(
+                    trees.tree_num_leaves(state.params), topo.n_neighbors
+                ),
+                topo,
+            )
+        )
 
     multi = multihost.is_multiprocess()
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
@@ -380,6 +427,7 @@ def train(
                 # resuming with silently reset state
                 known_added = lambda m: (
                     m == "state/event/num_deferred"
+                    or m.startswith("state/telemetry")
                     or m.startswith("trace_carry")
                 )
                 if not missing or not all(known_added(m) for m in missing):
@@ -416,6 +464,7 @@ def train(
             wire_bf16=wire_bf16, wire=wire, staleness=staleness,
             chaos=chaos_sched, chaos_policy=chaos_policy,
             gossip_wire=wire_mode, compact_capacity=capacity,
+            obs=obs_on,
         )
 
     # a compact-wire run starts DENSE: warmup fires everything (no budget
@@ -446,6 +495,10 @@ def train(
     K = max(1, int(epochs_per_dispatch))
     if fault_mode is not None:
         K = 1  # the fault must land at an exact epoch boundary
+    if obs == "epoch":
+        # per-epoch telemetry wants every epoch to BE a block end; the
+        # flush stays once-per-dispatch — it is the dispatch that shrinks
+        K = 1
     total_epochs = max(0, epochs - start_epoch)
     # keep at least two blocks so a steady-state (post-compile) slice
     # always exists: a single mega-block would smear the jit compile into
@@ -548,7 +601,13 @@ def train(
     compact_min_samples = int(os.environ.get("EG_COMPACT_MIN_SAMPLES", "16"))
 
     seen_block_sizes: set = set()
+    # telemetry flush bookkeeping: previous cumulative host snapshot (the
+    # diff base) and the one-time run metadata rider
+    obs_prev = None
+    obs_meta_pending = obs_on
+    _root_span = contextlib.ExitStack()
     try:
+        _root_span.enter_context(_span("train", cat="run", algo=algo))
         for blk_i, (blk_start, blk_end) in enumerate(_blocks()):
             n_e = blk_end - blk_start + 1
             # first block of each distinct (size, wire-mode) pays a jit
@@ -560,47 +619,86 @@ def train(
             cold = (n_e, mode_now) not in seen_block_sizes
             seen_block_sizes.add((n_e, mode_now))
             label_shape: Tuple[int, ...] = ()
-            if device_data:
-                idx_np = np.concatenate(
-                    [
-                        epoch_index_plan(
-                            len(x_train), n_data, batch_size,
-                            random=random_sampler, seed=seed, epoch=e,
-                        )
-                        for e in range(blk_start, blk_end + 1)
-                    ],
-                    axis=1,
-                ).astype(np.int32)
-                # per-(step, rank) target count: batch plus any trailing
-                # label dims (LM token axes)
-                label_shape = (batch_size,) + tuple(y_dev.shape[1:])
-                t0 = time.perf_counter()
-                state, m = run_epoch_idx(
-                    state, x_dev, y_dev, jnp.asarray(idx_np)
-                )
-            else:
-                parts = [prefetcher.get(e) for e in range(blk_start, blk_end + 1)]
-                xb = (
-                    np.concatenate([p[0] for p in parts], axis=1)
-                    if n_e > 1 else parts[0][0]
-                )
-                yb = (
-                    np.concatenate([p[1] for p in parts], axis=1)
-                    if n_e > 1 else parts[0][1]
-                )
-                del parts
-                if hybrid:
-                    xb, yb = expand_to_mesh(xb, yb, topo)
-                if mesh is not None:  # global placement (spans hosts if any)
-                    xb = multihost.put_stacked(xb, mesh, topo)
-                    yb = multihost.put_stacked(yb, mesh, topo)
+            with _span(
+                "dispatch_block", cat="device",
+                block=blk_i, epochs=n_e, cold=cold, wire=mode_now,
+            ):
+                if device_data:
+                    idx_np = np.concatenate(
+                        [
+                            epoch_index_plan(
+                                len(x_train), n_data, batch_size,
+                                random=random_sampler, seed=seed, epoch=e,
+                            )
+                            for e in range(blk_start, blk_end + 1)
+                        ],
+                        axis=1,
+                    ).astype(np.int32)
+                    # per-(step, rank) target count: batch plus any
+                    # trailing label dims (LM token axes)
+                    label_shape = (batch_size,) + tuple(y_dev.shape[1:])
+                    t0 = time.perf_counter()
+                    state, m = run_epoch_idx(
+                        state, x_dev, y_dev, jnp.asarray(idx_np)
+                    )
                 else:
-                    xb, yb = jnp.asarray(xb), jnp.asarray(yb)
-                label_shape = tuple(yb.shape[2:])
-                t0 = time.perf_counter()
-                state, m = run_epoch(state, xb, yb)
-            jax.block_until_ready(state.params)
-            dt = time.perf_counter() - t0
+                    parts = [
+                        prefetcher.get(e)
+                        for e in range(blk_start, blk_end + 1)
+                    ]
+                    xb = (
+                        np.concatenate([p[0] for p in parts], axis=1)
+                        if n_e > 1 else parts[0][0]
+                    )
+                    yb = (
+                        np.concatenate([p[1] for p in parts], axis=1)
+                        if n_e > 1 else parts[0][1]
+                    )
+                    del parts
+                    if hybrid:
+                        xb, yb = expand_to_mesh(xb, yb, topo)
+                    if mesh is not None:  # global placement (spans hosts)
+                        xb = multihost.put_stacked(xb, mesh, topo)
+                        yb = multihost.put_stacked(yb, mesh, topo)
+                    else:
+                        xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+                    label_shape = tuple(yb.shape[2:])
+                    t0 = time.perf_counter()
+                    state, m = run_epoch(state, xb, yb)
+                jax.block_until_ready(state.params)
+                dt = time.perf_counter() - t0
+
+            # telemetry flush: ONE device->host read of the cumulative
+            # counters per dispatch block, diffed against the previous
+            # snapshot on the host (no device-side reset write)
+            obs_rec = None
+            if obs_on:
+                with _span("obs_flush", cat="obs", block=blk_i):
+                    tel_host = jax.tree.map(
+                        np.asarray, multihost.to_host(state.telemetry)
+                    )
+                    obs_rec = obs_device.window_record(tel_host, obs_prev)
+                    obs_prev = tel_host
+                if obs_meta_pending:
+                    obs_rec["meta"] = {
+                        "leaves": [
+                            "/".join(
+                                str(getattr(p, "key", p)) for p in kp
+                            )
+                            for kp, _ in
+                            jax.tree_util.tree_flatten_with_path(
+                                state.params
+                            )[0]
+                        ],
+                        "edges": [nb.name for nb in topo.neighbors],
+                        "silence_buckets": int(
+                            np.asarray(tel_host.silence_hist).shape[-1]
+                        ),
+                        "n_ranks": topo.n_ranks,
+                        "n_neighbors": topo.n_neighbors,
+                        "wire": wire or ("bf16" if wire_bf16 else None),
+                    }
+                    obs_meta_pending = False
 
             # block metrics are [n_e * steps, n_ranks]; split per epoch
             m = multihost.to_host(m)
@@ -683,14 +781,17 @@ def train(
                                     total_passes - steps, s_i, r, loss_all
                                 )) + "\n")
                 is_block_end = epoch == blk_end
+                if is_block_end and obs_rec is not None:
+                    rec["obs"] = obs_rec
                 if (
-                    chaos_sched is not None and is_block_end
+                    (chaos_sched is not None or obs_on) and is_block_end
                     and not multi and not hybrid
                 ):
                     # periodic consensus-error probe ||p_i - mean(p)||:
                     # the ground-truth drift metric that tells "quiet
                     # because the threshold says so" from "quiet because
-                    # the link is dead" (chaos/monitor.py)
+                    # the link is dead" (chaos/monitor.py) — chaos and
+                    # telemetry runs both log it at block ends
                     cerr = np.asarray(
                         chaos_monitor.consensus_error(state.params)
                     )
@@ -706,16 +807,17 @@ def train(
                     # ranks would mix differently-sharded parameters.
                     # K-epoch blocks evaluate at block ends (every-K
                     # cadence) — the final epoch is always a block end.
-                    cons = consensus_params(state.params)
-                    stats0 = rank0_slice(state.batch_stats)
-                    rec.update(
-                        {
-                            "test_" + k: v
-                            for k, v in evaluate(
-                                model, cons, stats0, x_test, y_test
-                            ).items()
-                        }
-                    )
+                    with _span("eval", cat="host", epoch=epoch):
+                        cons = consensus_params(state.params)
+                        stats0 = rank0_slice(state.batch_stats)
+                        rec.update(
+                            {
+                                "test_" + k: v
+                                for k, v in evaluate(
+                                    model, cons, stats0, x_test, y_test
+                                ).items()
+                            }
+                        )
                 history.append(rec)
                 if on_epoch is not None:  # live metrics (liveness signal)
                     on_epoch(rec)
@@ -784,21 +886,25 @@ def train(
                 # multi-process: allgather the global-mesh state to host;
                 # checkpoint.save coordinates the one-writer snapshot
                 # (checkpoint_dir must be visible to all processes)
-                save_state = multihost.to_host(state) if multi else state
-                checkpoint.save(
-                    ckpt_path,
-                    {
-                        "state": save_state,
-                        "epoch": np.int64(epoch),
-                        "trace_carry": trace_carry,
-                    },
-                )
+                with _span("checkpoint", cat="host", epoch=epoch):
+                    save_state = (
+                        multihost.to_host(state) if multi else state
+                    )
+                    checkpoint.save(
+                        ckpt_path,
+                        {
+                            "state": save_state,
+                            "epoch": np.int64(epoch),
+                            "trace_carry": trace_carry,
+                        },
+                    )
             if epoch == fault_epoch:
                 if fault_mode == "crash":
                     os._exit(13)
                 while True:  # "hang": alive but no progress (no heartbeat)
                     time.sleep(3600)
     finally:
+        _root_span.close()
         if prefetcher is not None:
             prefetcher.close()
 

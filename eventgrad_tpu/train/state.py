@@ -42,6 +42,10 @@ class TrainState(struct.PyTreeNode):
     sparse: Optional[SparseState] = None
     #: chaos.monitor.PeerHealth when fault injection / recovery is on
     chaos: Optional[Any] = None
+    #: obs.device.TelemetryState when train(obs=...) telemetry is on —
+    #: cumulative on-device counters, flushed to host once per dispatch
+    #: block (docs/OBSERVABILITY.md)
+    telemetry: Optional[Any] = None
 
 
 def init_train_state(

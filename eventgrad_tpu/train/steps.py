@@ -37,13 +37,14 @@ from jax import lax
 
 from eventgrad_tpu.chaos import inject as chaos_inject
 from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.chaos.policy import RecoveryPolicy, alive_mask
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.events import (
-    EventConfig, capacity_gate, commit, decide_and_update, propose,
+    EventConfig, capacity_gate, commit, propose,
 )
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
 from eventgrad_tpu.parallel.topology import Topology
@@ -79,6 +80,7 @@ def make_train_step(
     chaos_policy: Optional[RecoveryPolicy] = None,
     gossip_wire: str = "dense",
     compact_capacity: Optional[int] = None,
+    obs: bool = False,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -122,6 +124,15 @@ def make_train_step(
     warmup). The `sent_bytes_wire_real` metric reports the bytes each
     mode ACTUALLY moves per step; `sent_bytes` stays the reference-MPI
     accounting model. See docs/compaction.md.
+
+    obs=True accumulates the on-device telemetry counters
+    (obs.device.TelemetryState — per-leaf fire/deferral counts, threshold
+    and drift-norm sums, silence histogram, per-edge wire-real bytes)
+    into `state.telemetry`, which MUST then be a TelemetryState (the loop
+    initializes it; see train(obs=...)). All updates are fused vector ops
+    carried by the scan — no host syncs, no extra dispatches; with
+    obs=False the traced program is bit-identical to before the telemetry
+    subsystem existed (regression-tested in tests/test_obs.py).
 
     chaos (a chaos.ChaosSchedule) injects deterministic message loss into
     the gossip edges inside this fused step: a dropped message keeps the
@@ -299,6 +310,11 @@ def make_train_step(
         if chaos is not None:
             deliver = chaos_inject.delivery_mask(chaos, topo, pass_num)
 
+        # telemetry inputs captured by the algo branches (obs=True only):
+        # the event proposal and the EFFECTIVE (post-gate) fire vector
+        obs_prop = None
+        obs_fire_vec = None
+
         bufs = ()
         if algo == "allreduce":
             # E1: average gradients over the data-parallel (gossip) axes
@@ -350,6 +366,7 @@ def make_train_step(
                     prop.fire_vec, leaf_sizes, compact_capacity, priority=pri
                 )
             event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
+            obs_prop, obs_fire_vec = prop, fire_vec
             fire = jax.tree.unflatten(
                 p_def, [fire_vec[i] for i in range(len(p_leaves))]
             )
@@ -407,9 +424,17 @@ def make_train_step(
             fired_frac = sum(f for f, _ in fired) / len(fired)
 
         elif algo == "sp_eventgrad":
-            fire, event_state = decide_and_update(
-                params, event_state, pass_num, event_cfg, n_nb
+            # the propose/commit split of decide_and_update, inlined so
+            # the proposal feeds the telemetry accumulators
+            prop = propose(params, event_state, pass_num, event_cfg)
+            event_state = commit(
+                event_state, prop, prop.fire_vec, event_cfg, n_nb
             )
+            p_leaves, p_def = jax.tree.flatten(params)
+            fire = jax.tree.unflatten(
+                p_def, [prop.fire_vec[i] for i in range(len(p_leaves))]
+            )
+            obs_prop, obs_fire_vec = prop, prop.fire_vec
             stale_replicas = sparse_state.replicas
             sparse_state = sparse_exchange(
                 params, fire, sparse_state, topo, sparse_cfg, wire
@@ -490,6 +515,33 @@ def make_train_step(
         if sync_bn and has_bn:
             new_stats = collectives.allreduce_mean(new_stats, topo)
 
+        telemetry = state.telemetry
+        if obs:
+            # per-edge wire-real bytes: the gossip exchange ships the same
+            # payload to every neighbor, so the split is uniform today —
+            # the [n_nb] vector is the schema's shape, not a claim that
+            # it must stay uniform (allreduce has no edges to attribute)
+            per_edge = (
+                jnp.broadcast_to(wire_real / n_nb, (n_nb,))
+                if algo != "allreduce" and n_nb
+                else None
+            )
+            if obs_prop is not None:
+                telemetry = obs_device.accumulate(
+                    telemetry,
+                    fire_vec=obs_fire_vec,
+                    defer_vec=obs_prop.fire_vec & ~obs_fire_vec,
+                    thres=obs_prop.thres,
+                    drift=obs_prop.value_diff,
+                    silence=obs_prop.iter_diff,
+                    fired_elems=fired_elems,
+                    edge_bytes=per_edge,
+                )
+            else:
+                telemetry = obs_device.accumulate(
+                    telemetry, edge_bytes=per_edge
+                )
+
         new_state = state.replace(
             params=params,
             opt_state=opt_state,
@@ -499,6 +551,7 @@ def make_train_step(
             event=event_state,
             sparse=sparse_state,
             chaos=health,
+            telemetry=telemetry,
         )
         metrics = {
             "loss": loss,

@@ -4,7 +4,8 @@ The reference writes per-rank `send{r}.txt`/`recv{r}.txt`/`train{r}.txt`
 plus stdout accuracy (/root/reference/dmnist/event/event.cpp:232-252,
 337-339, 385-391; dcifar10/event/event.cpp:271-273). Here every record is a
 JSON line with the BASELINE metrics first-class: msgs-saved-%,
-grad-sync bytes/step/chip, test-acc vs epoch.
+grad-sync bytes/step/chip, test-acc vs epoch. The obs.Registry wraps
+this stream behind the versioned telemetry schema (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -16,9 +17,20 @@ from typing import Any, Dict, Optional
 
 
 class JsonlLogger:
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    """Append-only JSONL sink; every record is timestamped and flushed.
+
+    Context-manager friendly (`with JsonlLogger(path) as log:`) so the
+    stream closes on exception paths too. `fsync=True` additionally
+    fsyncs after every record — crash-safe artifacts at the cost of one
+    syscall per line (records are per-epoch, so the cost is noise)."""
+
+    def __init__(
+        self, path: Optional[str] = None, echo: bool = True,
+        fsync: bool = False,
+    ):
         self.path = path
         self.echo = echo
+        self.fsync = fsync
         self._fh = open(path, "a") if path else None
 
     def log(self, record: Dict[str, Any]) -> None:
@@ -27,12 +39,21 @@ class JsonlLogger:
         if self._fh:
             self._fh.write(line + "\n")
             self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
         if self.echo:
             print(line)
 
     def close(self) -> None:
         if self._fh:
             self._fh.close()
+            self._fh = None  # idempotent: with-block + explicit close
+
+    def __enter__(self) -> "JsonlLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def msgs_saved_pct(num_events: int, passes: int, n_tensors: int, n_neighbors: int, n_ranks: int) -> float:
@@ -40,6 +61,22 @@ def msgs_saved_pct(num_events: int, passes: int, n_tensors: int, n_neighbors: in
     (events counted per neighbor per tensor per pass, event.cpp:344,527-532)."""
     possible = n_neighbors * passes * n_tensors * n_ranks
     return 100.0 * (1.0 - num_events / possible) if possible else 0.0
+
+
+def msgs_saved_pct_per_leaf(
+    fire_counts, passes: int, n_neighbors: int, n_ranks: int,
+) -> list:
+    """Per-leaf msgs-saved-%: `fire_counts` is per-leaf EFFECTIVE fire
+    counts summed over ranks (obs telemetry `fire_count`); each fire is
+    `n_neighbors` messages, out of `n_neighbors * passes * n_ranks`
+    possible per leaf — so the neighbor factor cancels and the mean over
+    leaves equals the aggregate `msgs_saved_pct` exactly (the oracle
+    cross-check in tests/test_obs.py). Division-guarded like the
+    aggregate: zero possible messages reports 0.0 saved."""
+    possible = passes * n_ranks
+    if not possible or not n_neighbors:
+        return [0.0 for _ in fire_counts]
+    return [100.0 * (1.0 - float(f) / possible) for f in fire_counts]
 
 
 def steady_records(history) -> list:

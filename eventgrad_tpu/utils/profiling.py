@@ -6,8 +6,11 @@ The reference brackets the whole training loop with `MPI_Wtime`
   * `timed_steps` — a block_until_ready step-timing harness giving
     compile time and steady-state per-step latency percentiles.
   * `trace` — a context manager around `jax.profiler` emitting an XPlane
-    trace viewable in TensorBoard/Perfetto (no-op with a warning when the
-    backend can't trace, e.g. over the axon tunnel).
+    trace viewable in TensorBoard/Perfetto (no-op with a `warnings`
+    warning when the backend can't trace, e.g. over the axon tunnel).
+
+`timed_steps` results fold into the unified telemetry surface via
+`obs.Registry.observe_latency` (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -69,11 +72,15 @@ def trace(log_dir: str, python_tracer: bool = False):
         opts.python_tracer_level = 1 if python_tracer else 0
         jax.profiler.start_trace(log_dir, profiler_options=opts)
         started = True
-    except Exception as e:  # pragma: no cover - backend dependent
-        import sys
+    except Exception as e:
+        import warnings
 
-        # stderr: stdout may carry a JSONL metrics stream (cli.py)
-        print(f"[profiling] trace unavailable: {e}", file=sys.stderr)
+        # warnings, not a bare stderr print: capturable in tests/benches
+        # (and still off stdout, which may carry a JSONL metrics stream)
+        warnings.warn(
+            f"[profiling] trace unavailable: {e}", RuntimeWarning,
+            stacklevel=3,
+        )
     try:
         yield
     finally:

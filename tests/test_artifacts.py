@@ -1,0 +1,81 @@
+"""Committed-artifact schema validation (tools/validate_artifacts.py).
+
+Tier-1 by design: a malformed committed artifact — truncated JSON, a
+tool drifting from its documented schema, a hand-edit typo — fails the
+suite instead of silently rotting the repo's evidence chain.
+"""
+
+import importlib.util
+import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "validate_artifacts", os.path.join(_ROOT, "tools", "validate_artifacts.py")
+)
+va = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(va)
+
+
+def test_repo_artifacts_all_valid():
+    out = va.validate_repo(_ROOT)
+    assert out["checked"], "expected committed artifacts to validate"
+    # the families with real schemas must actually be among the checked
+    names = {os.path.basename(p) for p in out["checked"]}
+    assert any(n.startswith("BENCH_r") for n in names)
+    assert any(n.startswith("MULTICHIP_r") for n in names)
+    assert "obs_report_cpu.json" in names
+    assert out["errors"] == []
+
+
+def test_validator_flags_schema_violations():
+    assert va.validate(5, {"type": "string"})  # wrong type
+    assert va.validate(True, {"type": "integer"})  # bool is not integer
+    assert not va.validate(5, {"type": ["string", "integer"]})
+    assert va.validate({}, {"type": "object", "required": ["metric"]})
+    assert va.validate({"v": -1}, {
+        "type": "object", "properties": {"v": {"minimum": 0}},
+    })
+    assert va.validate([1], {"type": "array", "minItems": 2})
+    assert va.validate(["x"], {"type": "array", "items": {"type": "number"}})
+    assert va.validate("bad", {"enum": ["good"]})
+    # nested paths name the offending key
+    errs = va.validate(
+        {"results": {"obs_on": {}}},
+        {"type": "object",
+         "properties": {"results": {
+             "type": "object",
+             "properties": {"obs_on": {
+                 "type": "object", "required": ["step_ms_p50"],
+             }},
+         }}},
+    )
+    assert errs and "obs_on" in errs[0] and "step_ms_p50" in errs[0]
+
+
+def test_validator_flags_malformed_files(tmp_path):
+    bad_json = tmp_path / "bad.json"
+    bad_json.write_text("{truncated")
+    assert va.validate_json_file(str(bad_json), {"type": "object"})
+
+    bad_jsonl = tmp_path / "bad.jsonl"
+    bad_jsonl.write_text(
+        json.dumps({"ok": 1}) + "\n" + "not json\n"
+    )
+    errs = va.validate_jsonl_file(str(bad_jsonl))
+    assert len(errs) == 1 and ":2:" in errs[0]
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps({"ok": 1}) + "\n\n" + json.dumps({"b": 2}) + "\n")
+    assert va.validate_jsonl_file(str(good)) == []
+
+
+def test_repo_validation_catches_planted_corruption(tmp_path):
+    """End-to-end: a repo clone with one corrupted artifact fails."""
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    (art / "some_measurement.json").write_text('{"ok": true}')
+    assert va.validate_repo(str(tmp_path))["errors"] == []
+    (art / "broken.json").write_text('{"ok": ')
+    errs = va.validate_repo(str(tmp_path))["errors"]
+    assert len(errs) == 1 and "broken.json" in errs[0]

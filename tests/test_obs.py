@@ -1,0 +1,448 @@
+"""Telemetry subsystem: off-path bitwise identity, per-leaf oracle,
+span traces, exporters, and the hardened logging/profiling satellites.
+
+The two contracts that matter most (ISSUE acceptance):
+  * obs="off" leaves the traced step bit-identical to a telemetry-free
+    build, and obs="block"/"epoch" never perturbs the training math —
+    only observes it (params bitwise equal across modes);
+  * per-leaf fire counts reconcile EXACTLY with the aggregate
+    num_events counter from a real 4-rank CPU run (the oracle
+    cross-check for msgs_saved_pct_per_leaf).
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.obs import (
+    OBS_SCHEMA_VERSION, Registry, SILENCE_BUCKETS, TelemetryState,
+)
+from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.obs.report import build_report, load_history_jsonl
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.utils.metrics import (
+    JsonlLogger, msgs_saved_pct, msgs_saved_pct_per_leaf,
+)
+
+_KW = dict(
+    algo="eventgrad", epochs=4, batch_size=8, learning_rate=0.1,
+    event_cfg=EventConfig(adaptive=True, horizon=0.95, warmup_passes=3),
+    seed=0, log_every_epoch=False,
+)
+
+
+def _data():
+    return synthetic_dataset(256, (8, 8, 1), seed=1)
+
+
+def test_obs_off_and_on_trajectories_bitwise_identical():
+    """Telemetry observes the run; it must never change it. obs='off'
+    (the current-loop default) and obs='block' produce bitwise-identical
+    parameters and identical core history fields."""
+    x, y = _data()
+    s_off, h_off = train(MLP(hidden=16), Ring(4), x, y, **_KW)
+    s_on, h_on = train(
+        MLP(hidden=16), Ring(4), x, y, obs="block",
+        epochs_per_dispatch=2, **_KW
+    )
+    for a, b in zip(jax.tree.leaves(s_off.params), jax.tree.leaves(s_on.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for r_off, r_on in zip(h_off, h_on):
+        assert r_off["loss"] == r_on["loss"]
+        assert r_off["num_events"] == r_on["num_events"]
+    # off: no telemetry state, no obs blocks anywhere in the history
+    assert s_off.telemetry is None
+    assert not any("obs" in h for h in h_off)
+
+
+def test_obs_per_leaf_oracle_against_num_events():
+    """4-rank CPU run: summed per-leaf fire counts * n_neighbors ==
+    EventState.num_events, and the mean of the per-leaf msgs-saved-%
+    equals the aggregate msgs_saved_pct over the same window."""
+    x, y = _data()
+    state, hist = train(
+        MLP(hidden=16), Ring(4), x, y, obs="block", **_KW
+    )
+    obs_recs = [h["obs"] for h in hist if "obs" in h]
+    assert obs_recs, "block-end records must carry obs telemetry"
+    total_fires = sum(sum(o["fire_count"]) for o in obs_recs)
+    assert total_fires * 2 == int(np.asarray(state.event.num_events).sum())
+    # window oracle: per-leaf mean == aggregate over the SAME passes
+    passes = sum(o["steps"] for o in obs_recs)
+    fire_total = np.sum([o["fire_count"] for o in obs_recs], axis=0)
+    per_leaf = msgs_saved_pct_per_leaf(fire_total, passes, 2, 4)
+    agg = msgs_saved_pct(int(total_fires) * 2, passes, len(fire_total), 2, 4)
+    assert abs(np.mean(per_leaf) - agg) < 1e-9
+    # meta rides the first obs record only
+    assert obs_recs[0]["meta"]["leaves"] == [
+        "Dense_0/bias", "Dense_0/kernel", "Dense_1/bias", "Dense_1/kernel",
+    ]
+    assert obs_recs[0]["meta"]["n_ranks"] == 4
+    assert all("meta" not in o for o in obs_recs[1:])
+    # consensus-error probe lands at block ends on obs runs too
+    assert "consensus_err_max" in hist[-1]
+    # schema stamp and histogram geometry
+    assert obs_recs[0]["schema"] == OBS_SCHEMA_VERSION
+    assert len(obs_recs[0]["silence_hist"]) == SILENCE_BUCKETS
+    # silence histogram counts leaf-passes: one entry per leaf per pass
+    assert sum(obs_recs[0]["silence_hist"]) == obs_recs[0]["steps"] * 4 * 4
+
+
+def test_obs_epoch_granularity_forces_per_epoch_blocks():
+    """obs='epoch' pins the dispatch to one epoch per block, so EVERY
+    epoch record carries telemetry even when the caller asked for fused
+    multi-epoch dispatch."""
+    x, y = _data()
+    _, hist = train(
+        MLP(hidden=16), Ring(4), x, y, obs="epoch",
+        epochs_per_dispatch=4, **_KW
+    )
+    assert len(hist) == _KW["epochs"]
+    assert all("obs" in h for h in hist)
+    assert all(h["obs"]["steps"] == h["steps"] for h in hist)
+
+
+def test_obs_compact_wire_utilization_and_deferrals():
+    """Compact-wire run: deferral counts in the telemetry reconcile with
+    EventState.num_deferred, and admitted elements never exceed the
+    static capacity."""
+    x, y = _data()
+    kw = dict(_KW)
+    kw["event_cfg"] = EventConfig(
+        adaptive=True, horizon=0.95, warmup_passes=2, max_silence=20
+    )
+    state, hist = train(
+        MLP(hidden=16), Ring(4), x, y, obs="block",
+        gossip_wire="compact", compact_frac=0.6, **kw
+    )
+    cap_recs = [h for h in hist if h.get("compact_capacity")]
+    assert cap_recs, "compact_frac run must activate the compact wire"
+    cap = cap_recs[-1]["compact_capacity"]
+    obs_recs = [h["obs"] for h in hist if "obs" in h]
+    defer_total = sum(sum(o["defer_count"]) for o in obs_recs)
+    assert defer_total == int(np.asarray(state.event.num_deferred).sum())
+    # admitted payload is bounded by the budget on every compact window
+    compact_epochs = {h["epoch"] for h in cap_recs}
+    for h in hist:
+        if h["epoch"] in compact_epochs and "obs" in h:
+            assert h["obs"]["fired_elems_mean"] <= cap + 1e-6
+    # report renders the utilization section from this history
+    report = build_report(hist)
+    cu = report["capacity_utilization"]
+    assert cu["compact_capacity"] == cap
+    assert 0.0 <= cu["deferral_rate"] <= 1.0
+    assert cu["per_window"], "per-window utilization series expected"
+    assert report["msgs_saved_pct_per_leaf"]["pct"]
+    assert report["consensus_error"]["max"]
+
+
+def test_loop_spans_nest_under_train_root():
+    """train(registry=...) records dispatch/flush/eval spans nested
+    under one 'train' root span — the structure the Chrome-trace export
+    preserves."""
+    x, y = _data()
+    xt, yt = synthetic_dataset(64, (8, 8, 1), seed=1, split="test")
+    reg = Registry()
+    kw = dict(_KW)
+    kw.pop("log_every_epoch")
+    train(
+        MLP(hidden=16), Ring(4), x, y, obs="block", registry=reg,
+        epochs_per_dispatch=2, x_test=xt, y_test=yt, **kw
+    )
+    by_name = {}
+    for s in reg.spans:
+        by_name.setdefault(s.name, []).append(s)
+    assert len(by_name["train"]) == 1
+    root = by_name["train"][0]
+    assert root.depth == 0
+    # 4 epochs at K=2 -> 2 dispatch blocks, each with one flush
+    assert len(by_name["dispatch_block"]) == 2
+    assert len(by_name["obs_flush"]) == 2
+    assert len(by_name["eval"]) == 2  # block-end evals
+    for s in reg.spans:
+        if s.name == "train":
+            continue
+        assert s.depth == 1
+        # temporal containment inside the root span
+        assert s.ts_us >= root.ts_us - 1
+        assert s.ts_us + s.dur_us <= root.ts_us + root.dur_us + 1
+
+
+def test_chrome_trace_loads_and_keeps_nesting(tmp_path):
+    """The exported JSON is Chrome Trace Event Format: a traceEvents
+    list of complete ('X') events with us timestamps — what Perfetto
+    and chrome://tracing load directly."""
+    reg = Registry(run_meta={"run": "test"})
+    with reg.span("outer", cat="run", block=0):
+        with reg.span("inner_a", cat="device"):
+            pass
+        with reg.span("inner_b", cat="host"):
+            pass
+    path = tmp_path / "trace.json"
+    reg.write_chrome_trace(str(path))
+    trace = json.loads(path.read_text())
+    evs = trace["traceEvents"]
+    assert len(evs) == 3
+    assert {e["ph"] for e in evs} == {"X"}
+    assert all(
+        set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+        for e in evs
+    )
+    assert trace["otherData"]["obs_schema"] == OBS_SCHEMA_VERSION
+    by = {e["name"]: e for e in evs}
+    # nesting: children contained in the parent, deeper depth arg
+    for child in ("inner_a", "inner_b"):
+        assert by[child]["args"]["depth"] == 1
+        assert by[child]["ts"] >= by["outer"]["ts"]
+        assert (
+            by[child]["ts"] + by[child]["dur"]
+            <= by["outer"]["ts"] + by["outer"]["dur"] + 0.2
+        )
+    # events are start-time sorted (the viewers' expectation)
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+    # gauges ride otherData so a trace file is self-contained
+    reg.gauge("bench_step_ms", 12.5)
+    assert reg.chrome_trace()["otherData"]["gauges"] == {
+        "bench_step_ms": 12.5,
+    }
+
+
+def test_registry_prometheus_and_unified_fragments():
+    """The registry folds all three legacy fragments — JSONL records,
+    timed_steps latencies, chaos peer health — behind one schema."""
+    reg = Registry()
+    reg.record({"epoch": 1, "loss": 0.5})
+    assert reg.n_records == 1
+    reg.observe_latency(
+        {"compile_s": 1.5, "step_ms_mean": 2.0, "step_ms_p50": 1.9,
+         "step_ms_p95": 2.5}
+    )
+    rec = reg.observe_health(
+        np.array([[3, 50], [2, 7]]), np.array([4, 1]), max_silence=10,
+        edges=["ring_m1", "ring_p1"],
+    )
+    assert rec["edge_silence_max"] == [3, 50]
+    assert rec["edge_status"] == ["healthy", "suspect"]
+    assert rec["edges"] == ["ring_m1", "ring_p1"]
+    text = reg.prometheus_text()
+    assert "# TYPE eventgrad_step_ms_p50 gauge" in text
+    assert "eventgrad_step_ms_p50 1.9" in text
+    assert 'eventgrad_edge_silence_max{edge="ring_p1"} 50' in text
+    assert "eventgrad_chaos_drops_total 5" in text
+
+
+def test_registry_jsonl_superset_and_ownership(tmp_path):
+    """Records forwarded through the registry are a superset of the raw
+    logger's (same keys + obs_schema); an owned logger closes with the
+    registry, a wrapped one stays open."""
+    path = tmp_path / "log.jsonl"
+    with Registry(jsonl_path=str(path), echo=False) as reg:
+        reg.record({"epoch": 1, "loss": 0.25})
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["obs_schema"] == OBS_SCHEMA_VERSION
+    assert rec["epoch"] == 1 and rec["loss"] == 0.25 and "ts" in rec
+
+    outer = JsonlLogger(str(tmp_path / "outer.jsonl"), echo=False)
+    reg2 = Registry(logger=outer)
+    reg2.record({"epoch": 2})
+    reg2.close()
+    outer.log({"after": True})  # wrapped logger must still be open
+    outer.close()
+    lines = (tmp_path / "outer.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+
+
+def test_jsonl_logger_context_manager_and_fsync(tmp_path):
+    """Satellite: `with JsonlLogger(...)` closes on exception paths, and
+    fsync=True keeps every record durable without an explicit close."""
+    path = tmp_path / "log.jsonl"
+    with pytest.raises(RuntimeError):
+        with JsonlLogger(str(path), echo=False) as log:
+            log.log({"n": 1})
+            raise RuntimeError("boom")
+    assert log._fh is None  # closed despite the exception
+    assert json.loads(path.read_text().splitlines()[0])["n"] == 1
+    # close is idempotent (with-block + explicit close)
+    log.close()
+
+    fpath = tmp_path / "fsync.jsonl"
+    flog = JsonlLogger(str(fpath), echo=False, fsync=True)
+    flog.log({"n": 2})
+    # durable before close: read through a fresh descriptor
+    with open(fpath) as f:
+        assert json.loads(f.read().splitlines()[0])["n"] == 2
+    flog.close()
+
+
+def test_profiling_trace_warns_and_still_yields(monkeypatch):
+    """Satellite: the no-op path emits a capturable `warnings` warning
+    (not a bare stderr print) and the context still runs its body."""
+    from eventgrad_tpu.utils import profiling
+
+    def boom(*a, **k):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    ran = []
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with profiling.trace("/tmp/nonexistent-trace-dir"):
+            ran.append(True)
+    assert ran == [True]
+    assert any("trace unavailable" in str(w.message) for w in caught)
+
+
+def test_msgs_saved_pct_per_leaf_guard_and_values():
+    """Satellite: the per-leaf variant shares the aggregate's division
+    guard (zero possible messages -> 0.0) and its arithmetic."""
+    assert msgs_saved_pct_per_leaf([5, 0], 0, 2, 4) == [0.0, 0.0]
+    assert msgs_saved_pct_per_leaf([5, 0], 10, 0, 4) == [0.0, 0.0]
+    # 10 passes x 4 ranks possible per leaf: 40; 10 fired -> 75% saved
+    assert msgs_saved_pct_per_leaf([10, 0, 40], 10, 2, 4) == [
+        75.0, 100.0, 0.0,
+    ]
+    # the existing aggregate guard (kept under test here too)
+    assert msgs_saved_pct(0, 0, 0, 0, 0) == 0.0
+
+
+def test_window_record_diffs_cumulative_snapshots():
+    """Host flush math: per-window deltas from cumulative stacked
+    counters, counts summed over ranks, means averaged."""
+    def snap(steps, fire, thres, edge):
+        return TelemetryState(
+            steps=np.full((2,), steps, np.int32),
+            fire_count=np.asarray(fire, np.int32),
+            defer_count=np.zeros((2, 2), np.int32),
+            thres_sum=np.asarray(thres, np.float32),
+            drift_sum=np.zeros((2, 2), np.float32),
+            silence_hist=np.zeros((2, SILENCE_BUCKETS), np.int32),
+            fired_elems_sum=np.full((2,), 100.0, np.float32),
+            fired_elems_peak=np.asarray([30.0, 40.0], np.float32),
+            edge_bytes=np.asarray(edge, np.float32),
+        )
+
+    prev = snap(10, [[4, 2], [6, 0]], [[10.0, 0], [30.0, 0]],
+                [[100.0, 100.0], [100.0, 100.0]])
+    cur = snap(14, [[8, 2], [8, 4]], [[18.0, 0], [34.0, 0]],
+               [[180.0, 180.0], [180.0, 180.0]])
+    rec = obs_device.window_record(cur, prev)
+    assert rec["steps"] == 4
+    assert rec["fire_count"] == [6, 4]  # summed over the 2 ranks
+    assert rec["thres_mean"][0] == pytest.approx((8 + 4) / 2 / 4)
+    assert rec["fired_elems_peak"] == 40.0
+    assert rec["edge_bytes_per_step"] == [20.0, 20.0]
+    # first flush: prev=None means "since init"
+    first = obs_device.window_record(prev)
+    assert first["steps"] == 10 and first["fire_count"] == [10, 2]
+
+
+def test_obs_resume_continues_counters(tmp_path):
+    """Telemetry is snapshot state: an interrupted+resumed obs run ends
+    with the same cumulative counters as the uninterrupted one."""
+    x, y = _data()
+    kw = dict(_KW)
+    straight, _ = train(
+        MLP(hidden=16), Ring(4), x, y, obs="block", **kw
+    )
+    ck = str(tmp_path / "ck")
+    kw2 = dict(kw)
+    kw2["epochs"] = 2
+    train(MLP(hidden=16), Ring(4), x, y, obs="block",
+          checkpoint_dir=ck, **kw2)
+    resumed, _ = train(
+        MLP(hidden=16), Ring(4), x, y, obs="block",
+        checkpoint_dir=ck, resume=True, **kw
+    )
+    for a, b in zip(
+        jax.tree.leaves(straight.telemetry), jax.tree.leaves(resumed.telemetry)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_report_from_jsonl_stream(tmp_path):
+    """tools/obs_report.py path: history JSONL -> report with per-leaf
+    savings and consensus series (the committed-artifact pipeline)."""
+    x, y = _data()
+    path = tmp_path / "hist.jsonl"
+    with JsonlLogger(str(path), echo=False) as log:
+        reg = Registry(logger=log)
+        train(
+            MLP(hidden=16), Ring(4), x, y, obs="block",
+            registry=reg, on_epoch=reg.record, **_KW
+        )
+    history = load_history_jsonl(str(path))
+    assert len(history) == _KW["epochs"]
+    report = build_report(history)
+    assert report["obs_schema"] == OBS_SCHEMA_VERSION
+    pls = report["msgs_saved_pct_per_leaf"]
+    assert pls["leaves"] and pls["pct"]
+    assert len(pls["pct"][0]) == len(pls["leaves"])
+    assert report["fire_rate_heatmap"]["rows"]
+    assert report["thres_heatmap"]["rows"]
+    assert report["consensus_error"]["epochs"]
+    assert report["capacity_utilization"] is None  # dense run
+
+
+def test_docs_cover_every_schema_field():
+    """docs/OBSERVABILITY.md mirrors obs/schema.py field-for-field — the
+    doc is the schema's human surface and must not drift."""
+    from eventgrad_tpu.obs import schema
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md",
+    )
+    with open(doc_path) as f:
+        doc = f.read()
+    missing = [n for n in schema.all_field_names() if n not in doc]
+    assert not missing, f"fields undocumented in OBSERVABILITY.md: {missing}"
+
+
+# the mesh lift needs jax.shard_map; some CPU-only environments run a
+# jax without it (the seed's shard_map tests fail there for the same
+# reason) — the vmap lift proves the telemetry math either way
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
+)
+def test_telemetry_matches_across_lifts():
+    """Telemetry counters under the shard_map lift equal the vmap
+    simulation's, like every other state leaf."""
+    import optax
+
+    from eventgrad_tpu.parallel.spmd import build_mesh, spmd, stack_for_ranks
+    from eventgrad_tpu.train.state import init_train_state
+    from eventgrad_tpu.train.steps import make_train_step
+    from eventgrad_tpu.utils import trees
+
+    topo = Ring(4)
+    model = MLP(hidden=8)
+    tx = optax.sgd(0.1)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=1)
+    state = init_train_state(model, (8, 8, 1), tx, topo, "eventgrad", cfg)
+    state = state.replace(telemetry=stack_for_ranks(
+        TelemetryState.init(
+            trees.tree_num_leaves(state.params), topo.n_neighbors
+        ), topo,
+    ))
+    step = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=cfg, obs=True
+    )
+    x, y = synthetic_dataset(32, (8, 8, 1), seed=2)
+    batch = (
+        jnp.asarray(x.reshape(4, 8, 8, 8, 1)), jnp.asarray(y.reshape(4, 8))
+    )
+    out_v, _ = jax.jit(spmd(step, topo))(state, batch)
+    out_s, _ = jax.jit(spmd(step, topo, mesh=build_mesh(topo)))(state, batch)
+    for a, b in zip(
+        jax.tree.leaves(out_v.telemetry), jax.tree.leaves(out_s.telemetry)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

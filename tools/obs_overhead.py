@@ -1,0 +1,130 @@
+"""Telemetry overhead micro-bench: obs on vs off, same step, same data.
+
+The acceptance bar for the telemetry subsystem (docs/OBSERVABILITY.md):
+steady-state per-step overhead of the on-device accumulators < 3% on the
+CPU micro-bench. This tool measures it the same way
+tools/overhead_ablation.py measures the event-trigger overhead — the
+`utils.profiling.timed_steps` harness over the jitted lifted step, CNN-2
+on a 4-rank ring (the reference MNIST op-point's model) — and writes the
+paired numbers as one JSON artifact (committed:
+artifacts/obs_overhead_cpu.json).
+
+Usage: python tools/obs_overhead.py [--steps 40] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from eventgrad_tpu.data.datasets import synthetic_dataset  # noqa: E402
+from eventgrad_tpu.data.sharding import batched_epoch  # noqa: E402
+from eventgrad_tpu.models import CNN2  # noqa: E402
+from eventgrad_tpu.obs import Registry, TelemetryState  # noqa: E402
+from eventgrad_tpu.parallel.events import EventConfig  # noqa: E402
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks  # noqa: E402
+from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
+from eventgrad_tpu.train.state import init_train_state  # noqa: E402
+from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
+from eventgrad_tpu.utils import trees  # noqa: E402
+from eventgrad_tpu.utils.profiling import timed_steps  # noqa: E402
+
+
+def measure(obs: bool, n_steps: int, batch: int = 16) -> dict:
+    topo = Ring(4)
+    model = CNN2()
+    tx = optax.sgd(0.05)
+    cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=5)
+    state = init_train_state(model, (28, 28, 1), tx, topo, "eventgrad", cfg)
+    if obs:
+        state = state.replace(telemetry=stack_for_ranks(
+            TelemetryState.init(
+                trees.tree_num_leaves(state.params), topo.n_neighbors
+            ),
+            topo,
+        ))
+    step = jax.jit(spmd(
+        make_train_step(model, tx, topo, "eventgrad", event_cfg=cfg, obs=obs),
+        topo,
+    ))
+    x, y = synthetic_dataset(4 * batch * n_steps, (28, 28, 1), seed=3)
+    xb, yb = batched_epoch(x, y, 4, batch)
+    batches = [
+        (jnp.asarray(xb[:, s]), jnp.asarray(yb[:, s])) for s in range(n_steps)
+    ]
+    out = timed_steps(step, state, batches, warmup=5)
+    out.pop("state")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved off/on repetitions; per-config "
+                         "result is the min-p50 rep (the least "
+                         "noise-contaminated estimate — single-ordered "
+                         "pairs measured NEGATIVE overhead from process "
+                         "warmup alone)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    reg = Registry()
+    results = {}
+    # interleave the configs so allocator/cache warmup splits evenly
+    # across both instead of gifting the second config a warm process
+    for rep in range(args.reps):
+        for name, obs in (("obs_off", False), ("obs_on", True)):
+            r = measure(obs, args.steps)
+            if (
+                name not in results
+                or r["step_ms_p50"] < results[name]["step_ms_p50"]
+            ):
+                results[name] = r
+    for name in results:
+        reg.observe_latency(results[name], prefix=name)
+    # p50-of-best-rep is the honest center for a CPU micro-bench (means
+    # absorb scheduler hiccups); the mean rides along
+    p50_off = results["obs_off"]["step_ms_p50"]
+    p50_on = results["obs_on"]["step_ms_p50"]
+    rec = {
+        "bench": "obs_overhead",
+        "model": "CNN2",
+        "mesh": "ring:4 (vmap)",
+        "n_timed_steps": args.steps,
+        "reps": args.reps,
+        "platform": jax.devices()[0].platform,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "results": results,
+        "overhead_pct_p50": round(100.0 * (p50_on / p50_off - 1.0), 2),
+        "overhead_pct_mean": round(
+            100.0
+            * (results["obs_on"]["step_ms_mean"]
+               / results["obs_off"]["step_ms_mean"] - 1.0),
+            2,
+        ),
+        "prometheus": reg.prometheus_text(),
+    }
+    print(json.dumps(rec, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

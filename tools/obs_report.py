@@ -1,0 +1,69 @@
+"""Run-report generator: telemetry JSONL/history in, one report JSON out.
+
+Renders the derived observability series (obs.schema.REPORT_FIELDS) from
+any metrics stream a `train(obs=...)` run wrote — the `--log-file` JSONL
+of cli.py, or a history list saved by a tool:
+
+  * per-layer msgs-saved-% vs epoch (the headline metric, finally
+    attributable: WHICH layers save the messages);
+  * threshold / fire-rate heatmap data (when do thresholds go quiet);
+  * compact-wire capacity utilization — fired bytes vs the static C,
+    deferral rate (is the budget actually used);
+  * consensus-error trajectory (quiet-by-threshold vs drifting-apart).
+
+The committed example artifact (artifacts/obs_report_cpu.json) comes from
+a 4-rank CPU EventGraD + compact-wire run:
+
+  python -m eventgrad_tpu.cli --algo eventgrad --mesh ring:4 \
+      --dataset synthetic --model cnn2 --epochs 8 --batch-size 16 \
+      --n-synth 2048 --warmup-passes 5 --max-silence 40 \
+      --gossip-wire compact --obs block --log-file /tmp/obs_hist.jsonl
+  python tools/obs_report.py /tmp/obs_hist.jsonl \
+      --out artifacts/obs_report_cpu.json
+
+Usage: python tools/obs_report.py HISTORY.jsonl [--out PATH] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from eventgrad_tpu.obs.report import (  # noqa: E402
+    build_report, load_history_jsonl, render_text,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("history", help="metrics JSONL (cli.py --log-file)")
+    ap.add_argument("--out", default=None, help="report JSON path")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the text summary on stdout")
+    args = ap.parse_args(argv)
+
+    history = load_history_jsonl(args.history)
+    if not history:
+        print(f"no epoch records in {args.history}", file=sys.stderr)
+        return 1
+    report = build_report(history)
+    report["source"] = os.path.basename(args.history)
+    report["generated_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+    if not args.quiet:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

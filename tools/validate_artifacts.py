@@ -1,0 +1,294 @@
+"""Committed-artifact validation: every JSON the repo ships must parse
+and match its family's schema.
+
+The repo's evidence chain is its committed artifacts — BENCH/MULTICHIP
+driver records, `artifacts/*.json(l)` measurement captures, the obs
+run-report. A malformed artifact (truncated write, hand-edit typo,
+schema drift in a tool) silently rots that chain; this tool makes it a
+tier-1 test failure instead (tests/test_artifacts.py runs
+`validate_repo` on every suite run).
+
+Validation is a dependency-free subset of JSON Schema (the container has
+no `jsonschema` package and the repo adds no deps): type / required /
+properties / items / enum / minimum / maximum / minItems. Schemas are
+deliberately PERMISSIVE — they pin the fields tools and docs rely on
+(readers tolerate unknown keys, mirroring obs.schema's compatibility
+rule), not every field ever written.
+
+Usage: python tools/validate_artifacts.py [--root PATH]
+Exit 0 = all checked files valid; 1 = violations (listed on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def _type_ok(value: Any, t: str) -> bool:
+    if t == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, _TYPES[t])
+
+
+def validate(instance: Any, schema: Dict[str, Any], path: str = "$") -> List[str]:
+    """Errors (empty = valid) of `instance` against the schema subset."""
+    errs: List[str] = []
+    t = schema.get("type")
+    if t is not None:
+        types = t if isinstance(t, list) else [t]
+        if not any(_type_ok(instance, x) for x in types):
+            return [
+                f"{path}: expected type {'|'.join(types)}, got "
+                f"{type(instance).__name__}"
+            ]
+    if "enum" in schema and instance not in schema["enum"]:
+        errs.append(f"{path}: {instance!r} not in enum {schema['enum']}")
+    if isinstance(instance, (int, float)) and not isinstance(instance, bool):
+        if "minimum" in schema and instance < schema["minimum"]:
+            errs.append(f"{path}: {instance} < minimum {schema['minimum']}")
+        if "maximum" in schema and instance > schema["maximum"]:
+            errs.append(f"{path}: {instance} > maximum {schema['maximum']}")
+    if isinstance(instance, dict):
+        for req in schema.get("required", ()):
+            if req not in instance:
+                errs.append(f"{path}: missing required key {req!r}")
+        for key, sub in schema.get("properties", {}).items():
+            if key in instance:
+                errs.extend(validate(instance[key], sub, f"{path}.{key}"))
+    if isinstance(instance, list):
+        if "minItems" in schema and len(instance) < schema["minItems"]:
+            errs.append(
+                f"{path}: {len(instance)} items < minItems "
+                f"{schema['minItems']}"
+            )
+        items = schema.get("items")
+        if items is not None:
+            for i, v in enumerate(instance):
+                errs.extend(validate(v, items, f"{path}[{i}]"))
+    return errs
+
+
+# --- per-family schemas ----------------------------------------------------
+
+_ANY_RECORD = {"type": ["object", "array"]}
+
+BENCH_SCHEMA = {
+    "type": "object",
+    "required": ["n", "rc", "tail"],
+    "properties": {
+        "n": {"type": "integer", "minimum": 0},
+        "rc": {"type": "integer"},
+        "tail": {"type": "string"},
+        "cmd": {"type": "string"},
+    },
+}
+
+MULTICHIP_SCHEMA = {
+    "type": "object",
+    "required": ["n_devices", "ok", "rc", "skipped", "tail"],
+    "properties": {
+        "n_devices": {"type": "integer", "minimum": 0},
+        "ok": {"type": "boolean"},
+        "rc": {"type": "integer"},
+        "skipped": {"type": "boolean"},
+        "tail": {"type": "string"},
+    },
+}
+
+_METRIC_LINE = {
+    "type": "object",
+    "required": ["metric", "value", "unit"],
+    "properties": {
+        "metric": {"type": "string"},
+        "value": {"type": "number"},
+        "unit": {"type": "string"},
+    },
+}
+
+OBS_REPORT_SCHEMA = {
+    "type": "object",
+    "required": [
+        "obs_schema", "epochs", "msgs_saved_pct_per_leaf",
+        "capacity_utilization", "consensus_error",
+    ],
+    "properties": {
+        "obs_schema": {"type": "integer", "minimum": 1},
+        "epochs": {"type": "array", "minItems": 1,
+                   "items": {"type": "integer"}},
+        "msgs_saved_pct_per_leaf": {
+            "type": ["object", "null"],
+            "required": ["epochs", "leaves", "pct"],
+            "properties": {
+                "pct": {"type": "array",
+                        "items": {"type": "array",
+                                  "items": {"type": "number"}}},
+            },
+        },
+        "capacity_utilization": {
+            "type": ["object", "null"],
+            "required": [
+                "compact_capacity", "utilization_mean", "deferral_rate",
+            ],
+            "properties": {
+                "compact_capacity": {"type": "integer", "minimum": 1},
+                # compact-era only: the gate bounds per-pass fires by C
+                "utilization_mean": {"type": ["number", "null"],
+                                     "minimum": 0, "maximum": 1},
+                "deferral_rate": {"type": "number", "minimum": 0,
+                                  "maximum": 1},
+            },
+        },
+        "consensus_error": {
+            "type": ["object", "null"],
+            "required": ["epochs", "max", "mean"],
+        },
+    },
+}
+
+OBS_OVERHEAD_SCHEMA = {
+    "type": "object",
+    "required": ["bench", "results", "overhead_pct_p50"],
+    "properties": {
+        "bench": {"enum": ["obs_overhead"]},
+        "overhead_pct_p50": {"type": "number"},
+        "results": {
+            "type": "object",
+            "required": ["obs_off", "obs_on"],
+            "properties": {
+                "obs_off": {"type": "object",
+                            "required": ["step_ms_p50", "step_ms_mean"]},
+                "obs_on": {"type": "object",
+                           "required": ["step_ms_p50", "step_ms_mean"]},
+            },
+        },
+    },
+}
+
+FLAGSHIP_SCHEMA = {
+    "type": "object",
+    "required": ["captured_at", "platform"],
+    "properties": {
+        "captured_at": {"type": "string"},
+        "platform": {"type": "string"},
+    },
+}
+
+#: artifacts/ families with real schemas (filename prefix match); every
+#: other artifacts/*.json only needs to parse into an object/array
+_ARTIFACT_FAMILIES = (
+    ("obs_report_", OBS_REPORT_SCHEMA),
+    ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
+    ("bench_direct_best_", _METRIC_LINE),
+    ("bench_supervised_", _METRIC_LINE),
+    ("tpu_flagship", FLAGSHIP_SCHEMA),
+)
+
+
+def _schema_for_artifact(name: str) -> Dict[str, Any]:
+    for prefix, schema in _ARTIFACT_FAMILIES:
+        if name.startswith(prefix):
+            return schema
+    return _ANY_RECORD
+
+
+def validate_json_file(path: str, schema: Dict[str, Any]) -> List[str]:
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            instance = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{name}: unreadable/invalid JSON: {e}"]
+    return [f"{name}{e[1:]}" for e in validate(instance, schema)]
+
+
+def validate_jsonl_file(
+    path: str, line_schema: Optional[Dict[str, Any]] = None,
+) -> List[str]:
+    """Every non-empty line must parse as a JSON object (the JsonlLogger
+    contract); `line_schema` tightens per-line checks where a family has
+    one."""
+    errs: List[str] = []
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"{name}: unreadable: {e}"]
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errs.append(f"{name}:{i}: invalid JSON: {e}")
+            continue
+        errs.extend(
+            f"{name}:{i}{e[1:]}"
+            for e in validate(rec, line_schema or {"type": "object"})
+        )
+    return errs
+
+
+def validate_repo(root: str) -> Dict[str, Any]:
+    """Validate every committed JSON/JSONL evidence file under `root`;
+    returns {"checked": [...], "errors": [...]}."""
+    checked: List[str] = []
+    errors: List[str] = []
+
+    def check(path, fn, *a):
+        checked.append(os.path.relpath(path, root))
+        errors.extend(fn(path, *a))
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        check(path, validate_json_file, BENCH_SCHEMA)
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        check(path, validate_json_file, MULTICHIP_SCHEMA)
+    base = os.path.join(root, "BASELINE.json")
+    if os.path.exists(base):
+        check(base, validate_json_file,
+              {"type": "object", "required": ["metric"]})
+    kern = os.path.join(root, "KERNELS_TPU.json")
+    if os.path.exists(kern):  # despite the name, a JSONL stream
+        check(kern, validate_jsonl_file)
+    for path in sorted(glob.glob(os.path.join(root, "artifacts", "*.json"))):
+        check(path, validate_json_file,
+              _schema_for_artifact(os.path.basename(path)))
+    for path in sorted(glob.glob(os.path.join(root, "artifacts", "*.jsonl"))):
+        check(path, validate_jsonl_file)
+    return {"checked": checked, "errors": errors}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    args = ap.parse_args(argv)
+    out = validate_repo(args.root)
+    for e in out["errors"]:
+        print(e, file=sys.stderr)
+    print(
+        f"validated {len(out['checked'])} files, "
+        f"{len(out['errors'])} errors"
+    )
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
