@@ -289,11 +289,16 @@ def main() -> None:
         int(os.environ.get("EG_EPOCHS_PER_DISPATCH", "8"))
         if tier in ("full", "full-rehearsal") else 1
     )
+    # flat-arena hot path (train() auto-enables it; EG_BENCH_ARENA=0
+    # pins the legacy tree path for A/B runs — tools/overhead_ablation.py
+    # measures the same pair in isolation)
+    bench_arena = os.environ.get("EG_BENCH_ARENA", "1") != "0"
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
         random_sampler=True, log_every_epoch=False,
         epochs_per_dispatch=k_disp,
+        arena=bench_arena,
     )
 
     # host span trace of the bench's own phases (obs.Registry): always
@@ -564,6 +569,10 @@ def main() -> None:
                 "step_ms": round(1000 * step_s, 2),
                 "step_ms_dpsgd": round(1000 * step_s_d, 2),
                 "step_overhead_ratio": round(step_s / step_s_d, 4),
+                # both legs ran with the flat-arena hot path? (the
+                # step_overhead_ratio acceptance metric is arena-on;
+                # EG_BENCH_ARENA=0 gives the legacy-tree comparison)
+                "arena": bench_arena,
                 # every block was cold (steady_records fell back): the
                 # step timings above include compile contamination
                 "steady_contaminated": bool(

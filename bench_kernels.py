@@ -19,9 +19,15 @@ tunnel can wedge mid-run and the completed measurements must survive):
     MLP and flagship-ResNet parameter geometries, plus a masked-vs-compact
     whole-train-step comparison — real wire bytes next to measured ms,
     written to artifacts/gossip_wire_{platform}.json (the TPU artifact
-    lands via tools/tpu_flagship.py running this same selector on-chip).
+    lands via tools/tpu_flagship.py running this same selector on-chip);
 
-Usage: python bench_kernels.py [attn|fused|gossip|all|tune]
+  * the flat-arena event-engine leg (`arena`): event_propose_pack vs the
+    legacy flatten/propose/gate/pack chain, and the masked_wire +
+    fused_mix_commit Pallas kernels vs their jnp twins, with max_err
+    asserted 0; on TPU the measured speedups land in
+    eventgrad_tpu/ops/arena_tuning.json (the kernels' dispatch table).
+
+Usage: python bench_kernels.py [attn|fused|gossip|arena|all|tune]
        [--seqs 512,1024,...]
        [--out FILE]   (appends each line to FILE as well as stdout)
 
@@ -344,6 +350,156 @@ def bench_gossip_wire():
     _emit({"artifact": path, "n_entries": len(results)})
 
 
+def bench_arena():
+    """Flat-arena event-engine ops vs their XLA/legacy twins.
+
+    * event_propose_pack — the fused trigger->gate->pack sender pass vs
+      the legacy chain (flatten -> propose -> capacity_gate ->
+      ravel -> _compact_pack), MLP and ResNet18 geometries; max_err
+      covers the packed wire buffer and the gated fire bits (expect 0).
+    * masked_wire — the Pallas masked-wire builder kernel vs the fused
+      jnp mask the flat exchange inlines (interpret mode off-TPU).
+    * fused_mix_commit — the Pallas commit+mix+SGD kernel vs
+      mix_commit_reference (interpret mode off-TPU).
+
+    On TPU the measured speedups are written to
+    eventgrad_tpu/ops/arena_tuning.json — the dispatch table
+    ops/arena_tuning.py consults (kernels only run where they won)."""
+    import os
+
+    from eventgrad_tpu.models import MLP, ResNet18
+    from eventgrad_tpu.ops import arena_update, event_engine
+    from eventgrad_tpu.parallel import arena, collectives
+    from eventgrad_tpu.parallel.events import (
+        EventConfig, EventState, capacity_gate, propose,
+    )
+    from eventgrad_tpu.parallel.topology import Ring
+    from jax.flatten_util import ravel_pytree
+
+    topo = Ring(4)
+    cfg = EventConfig(adaptive=True, horizon=1.05, warmup_passes=1,
+                      max_silence=50)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    speedups = {}
+
+    key = jax.random.PRNGKey(0)
+    geoms = {
+        "mlp": MLP().init(key, jnp.zeros((1, 28, 28, 1)))["params"],
+        "resnet18": ResNet18(dtype=jnp.float32).init(
+            key, jnp.zeros((1, 32, 32, 3)))["params"],
+    }
+    for name, params in geoms.items():
+        spec = arena.arena_spec(params)
+        state = EventState.init(params, topo, cfg)
+        cap = collectives.choose_capacity(
+            spec.n_total, 0.3 * spec.n_total,
+            collectives.compact_capacity_floor(spec.sizes),
+        )
+        pn = jnp.int32(60)
+
+        def chain(p, s):
+            prop = propose(p, s, pn, cfg)
+            pri = prop.iter_diff >= cfg.max_silence
+            sizes, starts, _n = collectives._leaf_meta(p)
+            fire = capacity_gate(prop.fire_vec, sizes, cap, priority=pri)
+            flat, _ = ravel_pytree(p)
+            packed, leaf_id = collectives._compact_pack(
+                flat, fire, sizes, starts, cap
+            )
+            return fire, packed
+
+        def fused(p, s):
+            _prop, fire, packed, _lid = event_engine.event_propose_pack(
+                p, s, pn, cfg, spec, capacity=cap
+            )
+            return fire, packed
+
+        jc, jf = jax.jit(chain), jax.jit(fused)
+        fc, pc = jc(params, state)
+        ff, pf = jf(params, state)
+        err = max(
+            float(jnp.max(jnp.abs(pc - pf))),
+            float(jnp.max(jnp.abs(fc.astype(jnp.int8)
+                                  - ff.astype(jnp.int8)))),
+        )
+        assert err == 0.0, f"event_propose_pack diverges from chain: {err}"
+        ms_f, ms_c = _time(jf, params, state), _time(jc, params, state)
+        _emit({
+            "kernel": "event_propose_pack", "config": name,
+            "pallas_ms": round(ms_f, 3), "xla_ms": round(ms_c, 3),
+            "speedup": round(ms_c / ms_f, 2), "max_err": err,
+            "capacity": cap, "n_params": spec.n_total,
+        })
+
+    # masked_wire kernel (the wire build of the masked flat exchange)
+    params = geoms["resnet18"]
+    spec = arena.arena_spec(params)
+    flat, _ = ravel_pytree(params)
+    seg = spec.seg_expand()
+    fire_vec = jnp.arange(spec.n_leaves) % 3 != 0
+    fire_exp = fire_vec[seg]
+    kern = jax.jit(lambda f, e: event_engine.masked_wire(
+        f, e, interpret=not on_tpu))
+    ref = jax.jit(event_engine.masked_wire_reference)
+    err = _max_err(kern(flat, fire_exp), ref(flat, fire_exp))
+    assert err == 0.0, f"masked_wire diverges from reference: {err}"
+    tm = dict(iters=3, repeats=3) if not on_tpu else {}
+    ms_k = _time(kern, flat, fire_exp, **tm)
+    ms_r = _time(ref, flat, fire_exp, **tm)
+    speedups["masked_wire_speedup"] = round(ms_r / ms_k, 3)
+    _emit({
+        "kernel": "masked_wire", "config": "resnet18",
+        "pallas_ms": round(ms_k, 3), "xla_ms": round(ms_r, 3),
+        "speedup": speedups["masked_wire_speedup"], "max_err": err,
+        "interpret": not on_tpu,
+    })
+
+    # fused_mix_commit kernel vs jnp twin at a lane-aligned size
+    n = 1 << 20
+    k2 = jax.random.PRNGKey(2)
+    p, g, t, c0, c1, l0, l1 = (
+        jax.random.normal(jax.random.fold_in(k2, i), (n,)) for i in range(7)
+    )
+    k0 = jax.random.uniform(jax.random.fold_in(k2, 8), (n,)) > 0.5
+    k1 = jax.random.uniform(jax.random.fold_in(k2, 9), (n,)) > 0.3
+    kern = jax.jit(lambda *a: arena_update.fused_mix_commit(
+        *a, 0.01, 0.9, 1 / 3, interpret=not on_tpu))
+    ref = jax.jit(lambda *a: arena_update.mix_commit_reference(
+        *a, 0.01, 0.9, 1 / 3))
+    ok = kern(p, (c0, c1), (k0, k1), (l0, l1), g, t)
+    orf = ref(p, (c0, c1), (k0, k1), (l0, l1), g, t)
+    err = max(
+        _max_err(a, b)
+        for a, b in zip(jax.tree.leaves(ok), jax.tree.leaves(orf))
+    )
+    assert err == 0.0, f"fused_mix_commit diverges from reference: {err}"
+    tm = dict(iters=3, repeats=3) if not on_tpu else {}
+    ms_k = _time(kern, p, (c0, c1), (k0, k1), (l0, l1), g, t, **tm)
+    ms_r = _time(ref, p, (c0, c1), (k0, k1), (l0, l1), g, t, **tm)
+    speedups["mix_commit_speedup"] = round(ms_r / ms_k, 3)
+    _emit({
+        "kernel": "fused_mix_commit", "config": f"{n/1e6:.1f}M x2 neighbors",
+        "pallas_ms": round(ms_k, 3), "xla_ms": round(ms_r, 3),
+        "speedup": speedups["mix_commit_speedup"], "max_err": err,
+        "interpret": not on_tpu,
+    })
+
+    if on_tpu:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "eventgrad_tpu", "ops", "arena_tuning.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": jax.devices()[0].device_kind,
+                       **speedups}, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        _emit({"tuned": path, **speedups})
+    else:
+        _emit({"tuned": None,
+               "note": "non-TPU platform: arena_tuning.json not written "
+                       "(interpret-mode timings are not dispatch evidence)"})
+
+
 def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
     """Per-shape block sweep -> eventgrad_tpu/ops/flash_tuning.json."""
     import os
@@ -458,9 +614,10 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = args[0] if args and not args[0].startswith("--") else "all"
-    if which not in ("attn", "fused", "gossip", "all", "tune"):
+    if which not in ("attn", "fused", "gossip", "arena", "all", "tune"):
         raise SystemExit(
-            f"unknown selector {which!r}: attn | fused | gossip | all | tune"
+            f"unknown selector {which!r}: attn | fused | gossip | arena | "
+            "all | tune"
         )
     seqs = (512, 1024, 2048, 4096)
     for i, a in enumerate(args):
@@ -478,5 +635,7 @@ if __name__ == "__main__":
         bench_attention(seqs)
     if which in ("fused", "all"):
         bench_fused_update()
+    if which in ("arena", "all"):
+        bench_arena()
     if which in ("gossip", "all"):
         bench_gossip_wire()

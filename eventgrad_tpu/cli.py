@@ -215,6 +215,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the parameter count (0 < F <= 1); default: "
                         "autotune from the observed fire rate (requires "
                         "--gossip-wire compact)")
+    p.add_argument("--arena", choices=["auto", "on", "off"], default="auto",
+                   help="flat parameter arena for the gossip hot path "
+                        "(parallel/arena.py): params, event wire buffers "
+                        "and the mix/SGD tail run over one contiguous "
+                        "per-rank buffer with cached leaf metadata — "
+                        "bitwise-identical training, fewer per-step tree "
+                        "traversals. auto (default) enables it for "
+                        "dpsgd/eventgrad on plain data-parallel "
+                        "topologies; off = legacy tree path (the A/B "
+                        "knob of tools/overhead_ablation.py)")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
                         "(gossip algorithms; plain/momentum SGD only). "
@@ -511,6 +521,7 @@ def main(argv=None) -> int:
                 fused_update=args.fused, fault_inject=args.fault_inject,
                 chaos=chaos_sched, chaos_policy=chaos_policy,
                 obs=args.obs, registry=registry,
+                arena={"auto": None, "on": True, "off": False}[args.arena],
                 on_epoch=emit,  # records stream as epochs finish: live
                 # metrics for the user, a liveness signal for supervise.py
             )

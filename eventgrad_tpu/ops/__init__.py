@@ -4,3 +4,9 @@ from eventgrad_tpu.ops.attention import (
     flash_attention_reference,
 )
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd, mix_sgd_reference
+from eventgrad_tpu.ops.arena_update import fused_mix_commit, mix_commit_reference
+from eventgrad_tpu.ops.event_engine import (
+    event_propose_pack,
+    masked_wire,
+    masked_wire_reference,
+)

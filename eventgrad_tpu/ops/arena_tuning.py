@@ -1,0 +1,56 @@
+"""Measured dispatch policy for the flat-arena Pallas kernels.
+
+Same mechanism as ops/flash_tuning.py and ops/fused_tuning.py: the
+kernels must EARN their place on chip. `bench_kernels.py arena` measures
+them against their XLA twins on the active device and (on TPU) writes
+`arena_tuning.json` next to this module; the train step consults the
+table at build time.
+
+Policies:
+
+  * `masked_wire_ok()` — the masked-wire builder kernel
+    (ops/event_engine.masked_wire). The flat exchange's inline form is
+    already a single fused mask-into-concat pass under XLA, so the
+    kernel only earns a wire-builder slot with a MEASURED win (no
+    table -> False); EG_FORCE_ARENA_PALLAS=1 overrides for manual
+    experiments.
+  * `mix_commit_ok()` — the fused commit+mix+SGD tail
+    (ops/arena_update.fused_mix_commit). The arena hands it the shape
+    the fused family measured best (one big lane-aligned flat buffer —
+    KERNELS_TPU.json's ~1.0x single-leaf case, with the commit pass
+    fused in on top), and it is opt-in via train(fused_update=True)
+    like fused_mix_sgd, so it runs unless a measurement demotes it.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+_TABLE_PATH = os.path.join(os.path.dirname(__file__), "arena_tuning.json")
+
+
+@functools.lru_cache(maxsize=1)
+def _table():
+    try:
+        with open(_TABLE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def masked_wire_ok() -> bool:
+    """Run the Pallas masked-wire builder in the flat exchange?"""
+    if os.environ.get("EG_FORCE_ARENA_PALLAS") == "1":
+        return True
+    ratio = _table().get("masked_wire_speedup")
+    return ratio is not None and float(ratio) >= 1.0
+
+
+def mix_commit_ok() -> bool:
+    """Run the fused commit+mix+SGD kernel in the arena fused tail?"""
+    if os.environ.get("EG_FORCE_ARENA_PALLAS") == "1":
+        return True
+    ratio = _table().get("mix_commit_speedup")
+    return ratio is None or float(ratio) >= 1.0

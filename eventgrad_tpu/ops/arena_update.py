@@ -1,0 +1,188 @@
+"""Pallas TPU kernel: fused buffer-commit + gossip-mix + momentum-SGD.
+
+`ops/fused_update.fused_mix_sgd` fuses the mix + SGD tail into one HBM
+pass but still consumes neighbor buffers that an earlier pass had to
+materialize: the event exchanges first scatter received payloads into
+the stale buffers (`where(fired, new, stale)` — one full read+write of
+every buffer), then the mix reads them again. On the flat arena both
+stages are elementwise over the same [n] positions, so this kernel does
+them together — per element and per neighbor:
+
+    buf_new_i = where(keep_i, candidate_i, stale_i)     # the commit
+    mixed     = (p + sum(buf_mix_*)) * w                # gossip mix
+    trace     = momentum * trace + grad                 # optax sgd trace
+    p_new     = mixed - lr * trace                      # optimizer step
+
+writing (p_new, trace_new, buf_new_0..k) in one guaranteed single
+read/write per element. `mix_stale=True` accumulates the STALE buffers
+into the mix while still committing the new ones — the staleness=1 mode
+of the event step (mix with last step's arrivals, land this step's for
+the next).
+
+The event-STATE commit (events.commit — [L]-sized threshold/slope
+rollback) deliberately stays outside: it is a few hundred bytes, not an
+HBM pass, and fusing it would couple the kernel to the trigger's state
+layout for nothing.
+
+`mix_commit_reference` is the jnp twin (bitwise: same elementwise ops)
+used for tests and as the non-TPU path. Both forms are bitwise-equal to
+the unfused optax tail: `momentum*t + g` == `g + momentum*t` and
+`mixed - lr*t` == `mixed + (-lr)*t` exactly in IEEE arithmetic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces only exist on TPU builds; interpret mode elsewhere
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_LANES = 128
+#: 512x128 f32 = 256 KiB per ref; with 2 neighbors that is 13 refs
+#: (~3.3 MiB of VMEM working set) — comfortably inside a TensorCore's
+#: VMEM while keeping the grid long enough to split across megacores.
+_BLOCK_ROWS = 512
+
+
+def _commit_kernel(*refs, lr, momentum, w, nb, mix_stale):
+    # INVARIANT: strictly elementwise — the partial trailing block
+    # relies on Mosaic masking out-of-bounds stores (ops/fused_update).
+    p_ref, g_ref, t_ref = refs[:3]
+    cands = refs[3 : 3 + nb]
+    keeps = refs[3 + nb : 3 + 2 * nb]
+    lasts = refs[3 + 2 * nb : 3 + 3 * nb]
+    po_ref, to_ref = refs[3 + 3 * nb : 5 + 3 * nb]
+    bufs_out = refs[5 + 3 * nb :]
+
+    acc = p_ref[:]
+    for i in range(nb):
+        new_b = jnp.where(keeps[i][:] > 0, cands[i][:], lasts[i][:])
+        bufs_out[i][:] = new_b
+        acc = acc + (lasts[i][:] if mix_stale else new_b)
+    mixed = acc * w
+    trace = momentum * t_ref[:] + g_ref[:]
+    po_ref[:] = mixed - lr * trace
+    to_ref[:] = trace
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lr", "momentum", "w", "nb", "mix_stale", "interpret"),
+)
+def _fused_commit_flat(
+    p, g, t, cands, keeps, lasts, *, lr, momentum, w, nb, mix_stale,
+    interpret,
+):
+    n = p.size
+    ragged = n % _LANES != 0
+    if ragged:  # pad to a lane-tile multiple (copies; small n only)
+        padded = -(-n // _LANES) * _LANES
+        prep = lambda x: jnp.pad(
+            x.reshape(-1).astype(jnp.float32), (0, padded - n)
+        ).reshape(-1, _LANES)
+    else:  # free reshape: no data movement outside the kernel
+        prep = lambda x: x.reshape(-1, _LANES).astype(jnp.float32)
+
+    args = [prep(p), prep(g), prep(t)]
+    args += [prep(c) for c in cands]
+    args += [prep(k) for k in keeps]
+    args += [prep(l) for l in lasts]
+    rows = args[0].shape[0]
+    grid = (pl.cdiv(rows, _BLOCK_ROWS),)
+    spec = pl.BlockSpec(
+        (_BLOCK_ROWS, _LANES),
+        lambda i: (i, 0),
+        **({"memory_space": _VMEM}
+           if (_VMEM is not None and not interpret) else {}),
+    )
+    extra = {}
+    if not interpret and pltpu is not None:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
+    shape = jax.ShapeDtypeStruct(args[0].shape, jnp.float32)
+    outs = pl.pallas_call(
+        functools.partial(
+            _commit_kernel, lr=lr, momentum=momentum, w=w, nb=nb,
+            mix_stale=mix_stale,
+        ),
+        out_shape=tuple([shape] * (2 + nb)),
+        grid=grid,
+        in_specs=[spec] * len(args),
+        out_specs=tuple([spec] * (2 + nb)),
+        interpret=interpret,
+        **extra,
+    )(*args)
+    # restore each output's input dtype (the kernel computes in f32, like
+    # ops/fused_update.py): p_new/trace/bufs feed scan-carried state whose
+    # dtype must not drift across steps
+    out_dtypes = [p.dtype, t.dtype] + [l.dtype for l in lasts]
+    unpad = lambda x, dt: x.reshape(-1)[:n].astype(dt)
+    return tuple(unpad(o, dt) for o, dt in zip(outs, out_dtypes))
+
+
+def fused_mix_commit(
+    p: jnp.ndarray,
+    cands: Tuple[jnp.ndarray, ...],
+    keeps: Tuple[jnp.ndarray, ...],
+    lasts: Tuple[jnp.ndarray, ...],
+    g: jnp.ndarray,
+    t: jnp.ndarray,
+    lr: float,
+    momentum: float,
+    mix_weight: float,
+    mix_stale: bool = False,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """Fused commit+mix+SGD over flat [n] arenas.
+
+    `cands`/`keeps`/`lasts` are one entry per neighbor: the received
+    candidate values, per-POSITION keep bits (fire bits expanded by the
+    segment map, 0/1 floats or bools), and the stale buffers. Returns
+    (p_new, trace_new, committed_bufs). All f32 in/out.
+    """
+    nb = len(cands)
+    assert len(keeps) == nb and len(lasts) == nb
+    keeps = tuple(k.astype(jnp.float32) for k in keeps)
+    outs = _fused_commit_flat(
+        p, g, t, tuple(cands), keeps, tuple(lasts),
+        lr=float(lr), momentum=float(momentum), w=float(mix_weight),
+        nb=nb, mix_stale=bool(mix_stale), interpret=interpret,
+    )
+    return outs[0], outs[1], tuple(outs[2:])
+
+
+def mix_commit_reference(
+    p: jnp.ndarray,
+    cands: Tuple[jnp.ndarray, ...],
+    keeps: Tuple[jnp.ndarray, ...],
+    lasts: Tuple[jnp.ndarray, ...],
+    g: jnp.ndarray,
+    t: jnp.ndarray,
+    lr: float,
+    momentum: float,
+    mix_weight: float,
+    mix_stale: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """jnp twin of the kernel (also the non-TPU fallback path)."""
+    bufs = tuple(
+        jnp.where(k.astype(jnp.float32) > 0, c, l)
+        for c, k, l in zip(cands, keeps, lasts)
+    )
+    acc = p
+    for i in range(len(bufs)):
+        acc = acc + (lasts[i] if mix_stale else bufs[i])
+    mixed = acc * mix_weight
+    trace = momentum * t + g
+    return mixed - lr * trace, trace, bufs

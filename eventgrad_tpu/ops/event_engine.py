@@ -1,0 +1,192 @@
+"""Fused flat-arena event engine: propose + gate + pack in one pass.
+
+The tree-path hot chain of the EventGraD step re-derives structure per
+consumer — `jax.tree.flatten(params)` for the norms, a fresh
+`ravel_pytree` + segment-id materialization + separate masking pass for
+the wire, the capacity gate over a rebuilt sizes tuple, and
+`_compact_pack`'s own ravel. `event_propose_pack` runs the whole sender
+side as ONE pass against the lru-cached ArenaSpec (parallel/arena.py):
+
+    per-leaf drift norms -> threshold check / warmup / silence bound
+    (events.propose, unchanged [L]-vector state machine)
+    -> capacity_gate admission (compact wire only)
+    -> compact pack of the admitted leaves' elements straight off the
+       arena-ordered payload (the compact path's single [n] assembly).
+
+The masked-wire builder (`masked_wire`) covers the [n]-sized elementwise
+mask/quantize stage as a Pallas TPU kernel with a jnp twin
+(`masked_wire_reference`) — the twin is bitwise (same `where`/quantize
+elementwise ops) and the flat exchange inlines its per-leaf-fused form
+(collectives.masked_neighbor_vals_flat); the kernel is benched
+Pallas-vs-XLA in bench_kernels.py (`arena` selector) and earns dispatch
+through ops/arena_tuning.py measurements, the same measure-and-demote
+policy as fused_update.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces only exist on TPU builds; interpret mode elsewhere
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from eventgrad_tpu.parallel.arena import ArenaSpec
+from eventgrad_tpu.parallel.collectives import _compact_pack
+from eventgrad_tpu.parallel.events import (
+    EventConfig, EventProposal, EventState, capacity_gate, propose,
+)
+
+_LANES = 128
+_BLOCK_ROWS = 512
+
+
+def event_propose_pack(
+    params: Any,
+    state: EventState,
+    pass_num: jnp.ndarray,
+    cfg: EventConfig,
+    spec: ArenaSpec,
+    capacity: Optional[int] = None,
+    force_fire: Any = None,
+) -> Tuple[EventProposal, jnp.ndarray, Optional[jnp.ndarray],
+           Optional[jnp.ndarray]]:
+    """One fused pass of the sender side: trigger -> gate -> pack.
+
+    Returns (proposal, effective fire bits, packed wire buffer, per-
+    position leaf ids). With `capacity=None` (dense/masked wires) the
+    effective bits are the raw trigger decision and the pack outputs are
+    None; with a compact capacity the bits are the `capacity_gate`d
+    subset (max_silence-overdue and force-fired leaves claim budget
+    first, exactly the tree path's priority rule) and `packed` holds the
+    admitted leaves' elements, gathered straight off the arena-ordered
+    payload — the single [n] assembly of the compact path, subsuming the
+    tree chain's separate flatten -> propose -> gate -> ravel -> pack
+    materializations."""
+    prop = propose(params, state, pass_num, cfg, force_fire=force_fire)
+    fire_vec = prop.fire_vec
+    packed = leaf_id = None
+    if capacity is not None:
+        pri = None
+        if cfg.max_silence > 0:
+            pri = prop.iter_diff >= cfg.max_silence
+        if force_fire is not None:
+            ff = jnp.broadcast_to(force_fire, fire_vec.shape)
+            pri = ff if pri is None else (pri | ff)
+        fire_vec = capacity_gate(
+            prop.fire_vec, spec.sizes, int(capacity), priority=pri
+        )
+        # the pack source: leaves in arena order. The gather touches
+        # FIRED leaves only (plus a masked-out clip lane), so the
+        # unmasked assembly packs bitwise what the masked one would.
+        leaves = spec.treedef.flatten_up_to(params)
+        if len(leaves) == 1:
+            flat_src = leaves[0].reshape(-1)
+        else:
+            flat_src = jnp.concatenate([l.reshape(-1) for l in leaves])
+        packed, leaf_id = _compact_pack(
+            flat_src, fire_vec, spec.sizes, spec.starts, int(capacity)
+        )
+    return prop, fire_vec, packed, leaf_id
+
+
+# ---------------------------------------------------------------------------
+# masked-wire builder kernel: the [n]-sized elementwise stage
+
+def _mask_kernel(f_ref, b_ref, o_ref):
+    # INVARIANT: strictly elementwise (partial trailing block relies on
+    # Mosaic masking OOB stores; see ops/fused_update.py).
+    o_ref[:] = jnp.where(b_ref[:] > 0, f_ref[:], jnp.zeros((), f_ref.dtype))
+
+
+def _mask_quant_kernel(f_ref, b_ref, s_ref, o_ref):
+    masked = jnp.where(b_ref[:] > 0, f_ref[:], jnp.zeros((), f_ref.dtype))
+    o_ref[:] = jnp.clip(jnp.round(masked / s_ref[:]), -127, 127)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _masked_wire_pallas(flat, fire_f32, scale_exp, *, interpret):
+    n = flat.size
+    ragged = n % _LANES != 0
+    if ragged:
+        padded = -(-n // _LANES) * _LANES
+        prep = lambda x: jnp.pad(
+            x.reshape(-1).astype(jnp.float32), (0, padded - n)
+        ).reshape(-1, _LANES)
+    else:
+        prep = lambda x: x.reshape(-1, _LANES).astype(jnp.float32)
+
+    args = [prep(flat), prep(fire_f32)]
+    if scale_exp is not None:
+        # pad scales with 1s: the padded lanes divide by 1, not 0
+        pad_one = (
+            (lambda x: jnp.pad(
+                x.reshape(-1).astype(jnp.float32), (0, padded - n),
+                constant_values=1.0,
+            ).reshape(-1, _LANES))
+            if ragged else prep
+        )
+        args.append(pad_one(scale_exp))
+    rows = args[0].shape[0]
+    grid = (pl.cdiv(rows, _BLOCK_ROWS),)
+    spec = pl.BlockSpec(
+        (_BLOCK_ROWS, _LANES),
+        lambda i: (i, 0),
+        **({"memory_space": _VMEM}
+           if (_VMEM is not None and not interpret) else {}),
+    )
+    extra = {}
+    if not interpret and pltpu is not None:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
+    out = pl.pallas_call(
+        _mask_kernel if scale_exp is None else _mask_quant_kernel,
+        out_shape=jax.ShapeDtypeStruct(args[0].shape, jnp.float32),
+        grid=grid,
+        in_specs=[spec] * len(args),
+        out_specs=spec,
+        interpret=interpret,
+        **extra,
+    )(*args)
+    return out.reshape(-1)[:n]
+
+
+def masked_wire(
+    flat: jnp.ndarray,
+    fire_exp: jnp.ndarray,
+    scale_exp: Optional[jnp.ndarray] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Build the masked wire buffer in one HBM pass: zero the non-fired
+    positions (`fire_exp` = per-position fire bits, i.e. fire_vec[seg]),
+    optionally int8-quantizing against per-position scales in the same
+    pass. Returns f32 (int8 cast happens at the ship site). Pallas TPU
+    kernel; `masked_wire_reference` is the bitwise jnp twin."""
+    out = _masked_wire_pallas(
+        flat, fire_exp.astype(jnp.float32), scale_exp, interpret=interpret
+    )
+    return out.astype(flat.dtype) if scale_exp is None else out
+
+
+def masked_wire_reference(
+    flat: jnp.ndarray,
+    fire_exp: jnp.ndarray,
+    scale_exp: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """jnp twin of `masked_wire` (also the non-TPU path inside the
+    collectives flat exchanges)."""
+    masked = jnp.where(fire_exp, flat, jnp.zeros_like(flat))
+    if scale_exp is None:
+        return masked
+    return jnp.clip(jnp.round(masked / scale_exp), -127, 127)

@@ -1,0 +1,159 @@
+"""Flat parameter arena: cached leaf-major metadata + flat views.
+
+The EventGraD hot path used to re-derive tree structure every step —
+duplicate `jax.tree.flatten(params)` calls in train/steps.py, a fresh
+ravel + segment-id materialization inside `masked_neighbor_vals` /
+`compact_neighbor_vals`, per-neighbor unravels back to pytrees just so
+the next op could flatten again. All of that structure is STATIC: it
+depends only on (treedef, leaf shapes, leaf dtypes), never on values.
+
+`ArenaSpec` computes it once per distinct structure and caches it with
+`lru_cache` (`arena_spec`); the traced step then works on ONE contiguous
+[n_total] buffer per rank ("the arena") with thin `ravel`/`unravel`
+shims at the loop boundary, so models, checkpointing, and obs see the
+same pytrees as before while the hot path is flat segment ops
+(collectives.*_flat, ops/event_engine.py, ops/arena_update.py).
+
+Bitwise contract: `ravel` concatenates leaves in the canonical flatten
+order `jax.flatten_util.ravel_pytree` uses, `unravel` slices them back
+out, and `seg_expand()` maps each flat position to its leaf index with
+the exact integer values `_segment_ids` produced — every flat-path
+consumer is elementwise-identical to its tree twin (tests/test_arena.py
+proves the whole train step bitwise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaSpec:
+    """Static leaf-major layout of one pytree structure.
+
+    Everything here is plain Python — hashable, computed once per
+    (treedef, shapes, dtypes) and cached. Methods that return arrays
+    build them from this static metadata inside the current trace; the
+    builds are loop-invariant, so XLA hoists them out of the scanned
+    step body (they cost trace time, not step time).
+    """
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[str, ...]
+    sizes: Tuple[int, ...]
+    starts: Tuple[int, ...]
+    n_total: int
+    #: smallest legal compact capacity (largest leaf must ship whole)
+    floor: int
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.sizes)
+
+    @property
+    def homogeneous(self) -> bool:
+        """One dtype across leaves — the arena packs one contiguous
+        buffer, so heterogeneous trees stay on the tree path."""
+        return len(set(self.dtypes)) <= 1
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtypes[0])
+
+    def sizes_arr(self) -> jnp.ndarray:
+        return jnp.asarray(self.sizes, jnp.int32)
+
+    def starts_arr(self) -> jnp.ndarray:
+        return jnp.asarray(self.starts, jnp.int32)
+
+    def seg_expand(self) -> jnp.ndarray:
+        """[n_total] int32 leaf index per flat position — the values of
+        collectives._segment_ids, built as one repeat over the static
+        sizes (O(n), loop-invariant under scan)."""
+        return jnp.repeat(
+            jnp.arange(self.n_leaves, dtype=jnp.int32),
+            self.sizes_arr(),
+            total_repeat_length=self.n_total,
+        )
+
+    def ravel(self, tree: Any) -> jnp.ndarray:
+        """One contiguous [n_total] buffer, bitwise what `ravel_pytree`
+        produces for a single-dtype tree.
+
+        NOTE the hot path deliberately does NOT call this per step: an
+        [n]-assembly is a serial dependency chain that cannot overlap
+        the conv/matmul work the way independent per-leaf ops do
+        (measured on CPU XLA: the assembled-arena step formulations ran
+        ~8 ms/step slower at the LeNetCifar ring-8 op point purely from
+        the serialized assembly). The ONE per-step assembly the arena
+        keeps is the wire build, fused with its masking
+        (`collectives.masked_neighbor_vals_flat`); everything else works
+        leaf-parallel against flat-buffer slices."""
+        leaves = self.treedef.flatten_up_to(tree)
+        if len(leaves) == 1:
+            return leaves[0].reshape(-1)
+        return jnp.concatenate(
+            [l.reshape(-1).astype(self.dtype) for l in leaves]
+        )
+
+    def leaf_views(self, flat: jnp.ndarray):
+        """Static per-leaf slices of the arena (no data movement until
+        consumed; the elements are exactly `leaf.reshape(-1)`)."""
+        return [
+            flat[s : s + z] for s, z in zip(self.starts, self.sizes)
+        ]
+
+    def unravel(self, flat: jnp.ndarray) -> Any:
+        """Thin unflatten shim back to the pytree view (loop boundary)."""
+        leaves = [
+            v.reshape(shape).astype(dt)
+            for v, shape, dt in zip(
+                self.leaf_views(flat), self.shapes, self.dtypes
+            )
+        ]
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+@functools.lru_cache(maxsize=256)
+def _spec_cached(
+    treedef, shapes: Tuple[Tuple[int, ...], ...], dtypes: Tuple[str, ...]
+) -> ArenaSpec:
+    sizes = tuple(
+        int(math.prod(s)) if s else 1 for s in shapes
+    )
+    starts = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+    return ArenaSpec(
+        treedef=treedef,
+        shapes=shapes,
+        dtypes=dtypes,
+        sizes=sizes,
+        starts=starts,
+        n_total=int(sum(sizes)),
+        floor=max(sizes) if sizes else 0,
+    )
+
+
+def arena_spec(tree: Any) -> ArenaSpec:
+    """The cached ArenaSpec of `tree`'s structure.
+
+    Safe to call inside a traced step: only static attributes (treedef,
+    shapes, dtypes) form the cache key, and repeated calls on the same
+    structure are cache hits — no caller can re-derive leaf metadata
+    per step (asserted in tests/test_arena.py via cache_info())."""
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = tuple(tuple(int(d) for d in l.shape) for l in leaves)
+    dtypes = tuple(str(jnp.dtype(l.dtype)) for l in leaves)
+    return _spec_cached(treedef, shapes, dtypes)
+
+
+def cache_info():
+    """Hit/miss stats of the spec cache (regression-tested)."""
+    return _spec_cached.cache_info()

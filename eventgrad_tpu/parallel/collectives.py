@@ -26,6 +26,7 @@ All functions operate on pytrees and work identically under `jax.shard_map`
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Optional, Tuple
 
@@ -35,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
 
+from eventgrad_tpu.parallel import arena
 from eventgrad_tpu.parallel.topology import NeighborSpec, Topology
 
 
@@ -137,11 +139,12 @@ def _int8_decode(got_q: Any, got_s: Any, scale_def, like: Any) -> Any:
 
 def _leaf_meta(tree: Any) -> Tuple[Tuple[int, ...], Tuple[int, ...], int]:
     """Static leaf-major metadata: (sizes, flat start offsets, total
-    elements), in the canonical flatten order `ravel_pytree` uses."""
-    leaves = jax.tree.leaves(tree)
-    sizes = tuple(int(l.size) for l in leaves)
-    starts = tuple(int(s) for s in np.cumsum((0,) + sizes[:-1]))
-    return sizes, starts, int(sum(sizes))
+    elements), in the canonical flatten order `ravel_pytree` uses.
+    Served from the lru-cached ArenaSpec (parallel/arena.py) — repeated
+    calls on the same structure are cache hits, never re-derivations
+    inside a traced step."""
+    spec = arena.arena_spec(tree)
+    return spec.sizes, spec.starts, spec.n_total
 
 
 def _segment_ids(sizes: Tuple[int, ...], n: int) -> jnp.ndarray:
@@ -334,10 +337,17 @@ def masked_neighbor_vals(
 # ---------------------------------------------------------------------------
 # budgeted compacted exchange: event sparsity as real wire bytes
 
+@functools.lru_cache(maxsize=256)
+def _capacity_floor_cached(sizes: Tuple[int, ...]) -> int:
+    return max(sizes)
+
+
 def compact_capacity_floor(sizes) -> int:
     """Smallest legal compact capacity: the largest leaf must fit whole —
-    a leaf bigger than the buffer could never ship and would starve."""
-    return max(int(s) for s in sizes)
+    a leaf bigger than the buffer could never ship and would starve.
+    lru-cached per sizes tuple (same no-re-derivation rule as
+    `_leaf_meta`)."""
+    return _capacity_floor_cached(tuple(int(s) for s in sizes))
 
 
 def choose_capacity(
@@ -576,3 +586,277 @@ def mix_weighted(params: Any, bufs: Tuple[Any, ...], gate: Any) -> Any:
         return acc * w
 
     return jax.tree.map(leaf, params, *bufs)
+
+
+# ---------------------------------------------------------------------------
+# flat-arena exchange family: the same wire semantics as the pytree
+# functions above, with the WIRE and the persistent receive buffers in
+# one contiguous [n_total] arena layout (parallel/arena.py) while the
+# compute stays leaf-parallel. Each function is bitwise-identical to its
+# tree twin — same elementwise ops on the same values, only the views
+# differ (proven in tests/test_arena.py).
+#
+# Formulation notes (measured on CPU XLA, LeNetCifar ring-8):
+#   * The ONE per-step assembly is the wire build, and it fuses the
+#     event mask into the concatenation pieces — the tree path pays a
+#     ravel pass AND a separate [n] masking pass.
+#   * Receive-side work is single [n]-wide data-parallel ops (gathers
+#     of [L] vectors by the static segment map, wide selects): they
+#     split across the intra-op thread pool and overlap the model's
+#     conv/matmul thunks. Serial per-leaf region-write chains
+#     (dynamic_update_slice) and extra assemblies measurably do not.
+#   * Candidates and effective-bits are returned separately from the
+#     buffer commit (`commit_bufs_flat`, or the fused
+#     ops/arena_update.fused_mix_commit kernel) so the commit can fuse
+#     into the mix+SGD tail.
+
+def _wire_concat(pieces, dtype):
+    """The arena wire build: one concatenation of per-leaf pieces —
+    bitwise the concatenation of the same values, with any per-leaf
+    masking/quantization already fused into the pieces."""
+    if len(pieces) == 1:
+        return pieces[0].reshape(-1).astype(dtype)
+    return jnp.concatenate([x.reshape(-1).astype(dtype) for x in pieces])
+
+
+def neighbor_vals_flat(
+    payload: Any, topo: Topology, spec: "arena.ArenaSpec", wire=None,
+) -> Tuple[jnp.ndarray, ...]:
+    """D-PSGD exchange on the arena: one flat wire buffer per neighbor,
+    already upcast to the local dtype. `payload` is the parameter
+    pytree; the receiver consumes the buffer flat (no per-neighbor
+    unravel)."""
+    leaves = spec.treedef.flatten_up_to(payload)
+    dt = spec.dtype
+    if wire == "int8":
+        # bitwise _int8_scales: per-leaf absmax/127, zero-safe
+        scale_vec = jnp.maximum(_leaf_absmax(leaves), 1e-30) / 127.0
+        q = _wire_concat(
+            [
+                jnp.clip(jnp.round(l.reshape(-1) / scale_vec[k]), -127, 127)
+                for k, l in enumerate(leaves)
+            ],
+            jnp.int8,
+        )
+        seg = spec.seg_expand()
+
+        def one(nb):
+            got_q, got_s = recv_from((q, scale_vec), topo, nb)
+            return got_q.astype(dt) * got_s[seg].astype(dt)
+    else:
+        wire_buf = _wire_out(_wire_concat(leaves, dt), wire)
+
+        def one(nb):
+            return recv_from(wire_buf, topo, nb).astype(dt)
+
+    return tuple(one(nb) for nb in topo.neighbors)
+
+
+def masked_neighbor_vals_flat(
+    payload: Any,
+    fire_vec: jnp.ndarray,
+    topo: Topology,
+    spec: "arena.ArenaSpec",
+    wire=None,
+    deliver: "Optional[Any]" = None,
+    wire_builder=None,
+) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...],
+           Tuple[jnp.ndarray, ...]]:
+    """Event-triggered masked exchange on the arena.
+
+    The zero-masking of non-fired leaves fuses into the wire build
+    (`where(fire_k, leaf, 0)` per concatenation piece — bitwise the
+    tree path's ravel-then-mask, one pass instead of two). Returns
+    (candidate flat values, effective [L] fire bits, raw [L] sender
+    bits) per neighbor; the caller commits
+    `where(eff, candidate, stale)` — via `commit_bufs_flat` or fused
+    into the update kernel. `deliver` has the tree path's chaos
+    semantics (a dropped edge's eff bits clear; raw bits stay what was
+    on the wire). `wire_builder` — a callable (flat, fire_exp,
+    scale_exp|None) -> f32 wire buffer — swaps in the Pallas
+    masked-wire kernel (ops.event_engine.masked_wire; the step gates it
+    on TPU + a measured ops/arena_tuning.py win): the payload is then
+    assembled raw and masked/quantized by the kernel in its own single
+    HBM pass, bitwise the inline fused form."""
+    leaves = spec.treedef.flatten_up_to(payload)
+    dt = spec.dtype
+    if wire == "int8":
+        scale_vec = _masked_scales(_leaf_absmax(leaves), fire_vec)
+        seg = spec.seg_expand()
+        if wire_builder is not None:
+            q = wire_builder(
+                _wire_concat(leaves, dt), fire_vec[seg], scale_vec[seg]
+            ).astype(jnp.int8)
+        else:
+            # mask + quantize fused into the wire pieces — bitwise
+            # _int8_encode_flat of the zero-masked ravel (within leaf k
+            # every position shares fire_vec[k] and scale_vec[k])
+            q = _wire_concat(
+                [
+                    jnp.clip(
+                        jnp.round(
+                            jnp.where(fire_vec[k], l.reshape(-1),
+                                      jnp.zeros((), dt))
+                            / scale_vec[k]
+                        ),
+                        -127, 127,
+                    )
+                    for k, l in enumerate(leaves)
+                ],
+                jnp.int8,
+            )
+
+        def receive(nb):
+            got_q, got_s, got_vec = recv_from(
+                (q, scale_vec, fire_vec), topo, nb
+            )
+            return got_q.astype(dt) * got_s[seg].astype(dt), got_vec
+    else:
+        if wire_builder is not None:
+            masked = wire_builder(
+                _wire_concat(leaves, dt),
+                fire_vec[spec.seg_expand()], None,
+            ).astype(dt)
+        else:
+            masked = _wire_concat(
+                [
+                    jnp.where(fire_vec[k], l.reshape(-1), jnp.zeros((), dt))
+                    for k, l in enumerate(leaves)
+                ],
+                dt,
+            )
+        wire_buf = _wire_out(masked, wire)
+
+        def receive(nb):
+            got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
+            return got_flat.astype(dt), got_vec
+
+    cands, effs, raws = [], [], []
+    for i, nb in enumerate(topo.neighbors):
+        got_flat, got_vec = receive(nb)
+        eff = got_vec if deliver is None else (got_vec & deliver[i])
+        cands.append(got_flat)
+        effs.append(eff)
+        raws.append(got_vec)
+    return tuple(cands), tuple(effs), tuple(raws)
+
+
+def compact_neighbor_vals_flat(
+    payload: Any,
+    fire_vec: jnp.ndarray,
+    packed: jnp.ndarray,
+    leaf_id: jnp.ndarray,
+    topo: Topology,
+    capacity: int,
+    spec: "arena.ArenaSpec",
+    wire=None,
+    deliver: "Optional[Any]" = None,
+) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...],
+           Tuple[jnp.ndarray, ...]]:
+    """Budgeted compacted exchange on the arena.
+
+    `packed`/`leaf_id` come pre-built from the single-pass
+    `ops.event_engine.event_propose_pack` (fire_vec must already be its
+    capacity-gated output). The receiver replaces the tree path's
+    per-leaf dynamic-slice scatter with ONE [n_total]-wide gather:
+    position i of leaf k reads `got_packed[got_offsets[k] + (i -
+    starts[k])]` — the exact elements `compact_neighbor_vals` slices
+    out, selected by the same `where(eff, new, stale)` rule at commit
+    time. Returns the same (candidates, eff bits, raw bits) triple as
+    the masked flat path."""
+    capacity = int(capacity)
+    if capacity < spec.floor:
+        raise ValueError(
+            f"compact capacity {capacity} is below the largest leaf "
+            f"({spec.floor} elements): that leaf could never ship and "
+            "would starve"
+        )
+    dt = spec.dtype
+    if wire == "int8":
+        scale_vec = _masked_scales(
+            _leaf_absmax(spec.treedef.flatten_up_to(payload)), fire_vec
+        )
+        # same codec as the masked wire (per-position scale is the
+        # packed element's source-leaf scale)
+        wire_packed = _int8_encode_flat(packed, scale_vec, leaf_id)
+
+        def ship(nb):
+            return recv_from((wire_packed, scale_vec, fire_vec), topo, nb)
+    else:
+        wire_packed = _wire_out(packed, wire)
+
+        def ship(nb):
+            got_packed, got_vec = recv_from((wire_packed, fire_vec), topo, nb)
+            return got_packed, None, got_vec
+
+    seg = spec.seg_expand()
+    sizes_arr = spec.sizes_arr()
+    # arena position within its leaf (static; shared by every neighbor)
+    pos_in_leaf = (
+        jnp.arange(spec.n_total, dtype=jnp.int32) - spec.starts_arr()[seg]
+    )
+    cands, effs, raws = [], [], []
+    for i, nb in enumerate(topo.neighbors):
+        got_packed, got_scales, got_vec = ship(nb)
+        # offsets recomputed from the received fire bits (implicit lane)
+        got_fired = jnp.where(got_vec, sizes_arr, 0)
+        got_offsets = jnp.cumsum(got_fired) - got_fired
+        src = got_offsets[seg] + pos_in_leaf
+        data = got_packed[jnp.clip(src, 0, capacity - 1)]
+        val = data.astype(dt)
+        if got_scales is not None:
+            val = val * got_scales[seg].astype(dt)
+        eff = got_vec if deliver is None else (got_vec & deliver[i])
+        cands.append(val)
+        effs.append(eff)
+        raws.append(got_vec)
+    return tuple(cands), tuple(effs), tuple(raws)
+
+
+def commit_bufs_flat(
+    cands: Tuple[jnp.ndarray, ...],
+    effs: Tuple[jnp.ndarray, ...],
+    lasts: Tuple[jnp.ndarray, ...],
+    spec: "arena.ArenaSpec",
+) -> Tuple[jnp.ndarray, ...]:
+    """new_buf_i = where(eff_i per position, candidate_i, stale_i) —
+    the receive-buffer commit of the event exchanges, one wide select
+    per neighbor (bitwise the tree path's per-leaf `where`: within leaf
+    k every position shares eff[k])."""
+    seg = spec.seg_expand()
+    return tuple(
+        jnp.where(e[seg], c, l) for c, e, l in zip(cands, effs, lasts)
+    )
+
+
+def mix_flat_into_tree(
+    params: Any,
+    bufs: Tuple[jnp.ndarray, ...],
+    spec: "arena.ArenaSpec",
+    topo: Topology,
+    gate: "Optional[Any]" = None,
+) -> Any:
+    """Gossip mix of tree-shaped params with FLAT neighbor buffers,
+    emitting the mixed pytree directly: per leaf,
+    `(p_k + buf_0[s:e] + buf_1[s:e] + ...) * w` with the same add order
+    as `mix` — bitwise identical (slices are exact copies), and each
+    leaf is an independent fusion (no assembled intermediate between
+    the mix and the optimizer tail). With `gate` (bool [n_neighbors])
+    this is `mix_weighted`: gated-off edges leave the sum and the
+    weight renormalizes over survivors."""
+    if gate is None:
+        w = topo.mix_weight
+    else:
+        n_alive = jnp.sum(gate.astype(jnp.float32))
+        w = 1.0 / (1.0 + n_alive)
+    leaves = spec.treedef.flatten_up_to(params)
+    out = []
+    for k, (p, s, z) in enumerate(zip(leaves, spec.starts, spec.sizes)):
+        acc = p
+        for i, b in enumerate(bufs):
+            piece = lax.dynamic_slice_in_dim(b, s, z, 0).reshape(p.shape)
+            if gate is not None:
+                piece = jnp.where(gate[i], piece, jnp.zeros_like(piece))
+            acc = jnp.add(acc, piece)
+        out.append(acc * w)
+    return jax.tree.unflatten(spec.treedef, out)

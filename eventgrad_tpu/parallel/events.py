@@ -209,15 +209,42 @@ class EventState(struct.PyTreeNode):
     num_deferred: jnp.ndarray = None  # type: ignore[assignment]
 
     @classmethod
-    def init(cls, params: Any, topo: Topology, cfg: EventConfig) -> "EventState":
+    def init(
+        cls, params: Any, topo: Topology, cfg: EventConfig,
+        arena: bool = False,
+    ) -> "EventState":
+        """`arena=True` stores the per-neighbor receive buffers as flat
+        [n_params] arenas (parallel/arena.py) instead of pytrees — the
+        layout the flat-arena train step carries so no per-step
+        ravel/unravel of stale buffers survives. Zero-initialized either
+        way (event.cpp:177-179); checkpoints restore into whichever
+        layout the run was built with (a cross-layout restore fails
+        loudly, by design)."""
         n = trees.tree_num_leaves(params)
         zeros = jnp.zeros((n,), jnp.float32)
+        if arena:
+            from eventgrad_tpu.parallel.arena import arena_spec
+
+            spec = arena_spec(params)
+            if not spec.homogeneous:
+                # the flat buffers pack ONE dtype; a mismatched layout
+                # here would meet the step's tree-path demotion and die
+                # with an unrelated structure error — name the cause
+                raise ValueError(
+                    "EventState.init(arena=True) needs a single "
+                    f"parameter dtype; got {sorted(set(spec.dtypes))} — "
+                    "use arena=False for heterogeneous models"
+                )
+            buf0 = jnp.zeros((spec.n_total,), spec.dtype)
+        else:
+            buf0 = trees.tree_zeros_like(params)
         return cls(
             thres=zeros,
             last_sent_norm=zeros,
             last_sent_iter=zeros,
             slopes=jnp.zeros((n, cfg.history), jnp.float32),
-            bufs=tuple(trees.tree_zeros_like(params) for _ in topo.neighbors),
+            # the same (immutable) zero leaves may back every neighbor
+            bufs=tuple(buf0 for _ in topo.neighbors),
             num_events=jnp.zeros((), jnp.int32),
             num_deferred=jnp.zeros((), jnp.int32),
         )
@@ -262,14 +289,29 @@ def propose(
     fires update the sender state and event counters like any fire: the
     wire cost of recovery is accounted, not hidden.
     """
-    pass_f = pass_num.astype(jnp.float32)
-
     # per-leaf L2 norms stacked into the [L] state-vector order; every
     # subsequent state-machine op is one fused vector op, not L scalar ops
     leaves, _ = jax.tree.flatten(params)
     curr_norm = jnp.stack(
         [jnp.linalg.norm(l.reshape(-1)) for l in leaves]
     ).astype(jnp.float32)
+    return propose_from_norms(
+        curr_norm, state, pass_num, cfg, force_fire=force_fire
+    )
+
+
+def propose_from_norms(
+    curr_norm: jnp.ndarray,
+    state: EventState,
+    pass_num: jnp.ndarray,
+    cfg: EventConfig,
+    force_fire: "Any" = None,
+) -> EventProposal:
+    """`propose` with the [L] parameter norms precomputed — the shared
+    body of `propose` above, split out as the injection seam for any
+    caller that already holds the norms (e.g. a future fused norm
+    kernel); today both engines reach it through `propose`."""
+    pass_f = pass_num.astype(jnp.float32)
     value_diff = jnp.abs(curr_norm - state.last_sent_norm)
     iter_diff = pass_f - state.last_sent_iter
 

@@ -202,8 +202,26 @@ def train(
     epochs_per_dispatch: int = 1,
     obs: str = "off",
     registry: Optional[Any] = None,
+    arena: Optional[bool] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
+
+    arena (None = auto) routes the gossip hot path through the flat
+    parameter arena (parallel/arena.py + ops/event_engine.py): params,
+    event wire buffers, and the mix/SGD tail run over one contiguous
+    per-rank buffer with cached leaf metadata, bitwise-identical to the
+    tree path (tests/test_arena.py) but without its per-step tree
+    traversals. Auto enables it for dpsgd/eventgrad (the algos whose
+    step consumes it) on plain data-parallel topologies with a single
+    parameter dtype; `False`
+    forces the legacy tree path (the A/B knob of
+    tools/overhead_ablation.py). The EventState receive buffers are
+    carried flat under the arena, so checkpoint layouts differ by mode:
+    in auto mode a resume that hits a tree-layout (pre-arena) snapshot
+    falls back to `arena=False` with a warning — old checkpoints keep
+    working; with an explicit `arena=True` the cross-layout restore
+    raises an actionable error instead of corrupting state. History
+    records carry `rec["arena"]`.
 
     With `checkpoint_dir`, the full gossip TrainState (+ epoch counter) is
     snapshotted every `save_every` epochs (always at the end); `resume=True`
@@ -352,9 +370,56 @@ def train(
         if (topo.sharded_axes or topo.aux_axes)
         else init_train_state
     )
+    # flat-arena resolution BEFORE state init: the EventState buffer
+    # layout must match the step that will consume it. Auto: gossip
+    # algorithms on plain data-parallel topologies; the single-dtype
+    # requirement is probed shape-only (no device work).
+    # the arena serves the algos whose step consumes it: dpsgd and
+    # eventgrad. allreduce has no gossip hot path, and sp_eventgrad's
+    # top-k replicas are tree state (its trigger already reads leaves
+    # leaf-parallel) — flattening its unused receive buffers would only
+    # break existing checkpoints for zero win.
+    _arena_algos = ("dpsgd", "eventgrad")
+    if arena is None:
+        arena_on = algo in _arena_algos
+    else:
+        arena_on = bool(arena)
+        if arena_on and algo not in _arena_algos:
+            raise ValueError(
+                f"arena=True is a no-op for algo={algo!r} — only "
+                f"{_arena_algos} route through the flat arena; use "
+                "arena=None (auto) or False"
+            )
+    if arena_on and (topo.sharded_axes or topo.aux_axes):
+        if arena:
+            raise ValueError(
+                "arena=True is not supported on sharded/aux-axis "
+                "topologies (their initializers need the SPMD context); "
+                "use arena=None/False"
+            )
+        arena_on = False
+    if arena_on:
+        try:
+            _vs = jax.eval_shape(
+                model.init,
+                jax.random.PRNGKey(0),
+                jnp.zeros((1,) + tuple(input_shape), input_dtype),
+            )
+            _homog = len({
+                str(l.dtype) for l in jax.tree.leaves(_vs["params"])
+            }) <= 1
+        except Exception:
+            _homog = False
+        if not _homog:
+            if arena:
+                raise ValueError(
+                    "arena=True packs one contiguous buffer and needs a "
+                    "single parameter dtype"
+                )
+            arena_on = False
     state = init_fn(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
-        input_dtype=input_dtype,
+        input_dtype=input_dtype, arena=arena_on,
     )
     if chaos_sched is not None:
         # per-edge receiver-side health, stacked like every other state
@@ -409,37 +474,76 @@ def train(
                         found, {"state": tmpl_state, "epoch": np.int64(0)}
                     ), None
 
+            def _attempt(tmpl_state):
+                try:
+                    return _restore(tmpl_state)
+                except Exception:
+                    # migration: a snapshot from before a state field
+                    # existed (e.g. EventState.num_deferred) fails the
+                    # exact-structure restore — graft it onto the
+                    # template by path; added fields resume from their
+                    # init values, loudly
+                    restored, missing = checkpoint.restore_with_fill(
+                        found,
+                        {"state": tmpl_state, "epoch": np.int64(0),
+                         "trace_carry": trace_carry},
+                    )
+                    # ONLY known-added fields may fill from init —
+                    # anything else missing (opt_state restructured,
+                    # params renamed, ...) keeps the exact restore's
+                    # loud failure instead of resuming with silently
+                    # reset state
+                    known_added = lambda m: (
+                        m == "state/event/num_deferred"
+                        or m.startswith("state/telemetry")
+                        or m.startswith("trace_carry")
+                    )
+                    if not missing or not all(known_added(m) for m in missing):
+                        raise  # not a field-added migration: real mismatch
+                    carry = (
+                        None
+                        if any(m.startswith("trace_carry") for m in missing)
+                        else restored["trace_carry"]
+                    )
+                    warnings.warn(
+                        "snapshot predates state fields "
+                        f"{missing}; they resume from init values"
+                    )
+                    return restored, carry
+
             try:
-                restored, carry = _restore(state)
-            except Exception:
-                # migration: a snapshot from before a state field existed
-                # (e.g. EventState.num_deferred) fails the exact-structure
-                # restore — graft it onto the template by path; added
-                # fields resume from their init values, loudly
-                restored, missing = checkpoint.restore_with_fill(
-                    found,
-                    {"state": state, "epoch": np.int64(0),
-                     "trace_carry": trace_carry},
+                restored, carry = _attempt(state)
+            except Exception as exc:
+                # the EventState receive buffers changed layout with the
+                # flat arena: a snapshot written by a pre-arena (or
+                # arena=False) run holds tree-shaped bufs and cannot
+                # restore into the flat template. In AUTO mode, fall
+                # back to the tree layout so old checkpoints keep
+                # resuming (loudly); an EXPLICIT arena=True keeps the
+                # hard failure, with the cause named.
+                if not arena_on:
+                    raise
+                if arena is not None:  # explicit request: fail loudly
+                    raise RuntimeError(
+                        "checkpoint restore failed with arena=True; if "
+                        "this snapshot predates the flat arena (tree-"
+                        "shaped EventState.bufs), resume it with "
+                        "arena=False / --arena off"
+                    ) from exc
+                legacy = init_fn(
+                    model, input_shape, tx, topo, algo, event_cfg,
+                    seed=seed, input_dtype=input_dtype, arena=False,
                 )
-                # ONLY known-added fields may fill from init — anything
-                # else missing (opt_state restructured, params renamed,
-                # ...) keeps the exact restore's loud failure instead of
-                # resuming with silently reset state
-                known_added = lambda m: (
-                    m == "state/event/num_deferred"
-                    or m.startswith("state/telemetry")
-                    or m.startswith("trace_carry")
-                )
-                if not missing or not all(known_added(m) for m in missing):
-                    raise  # not a field-added migration: a real mismatch
-                carry = (
-                    None if any(m.startswith("trace_carry") for m in missing)
-                    else restored["trace_carry"]
-                )
+                if chaos_sched is not None:
+                    legacy = legacy.replace(chaos=state.chaos)
+                if obs_on:
+                    legacy = legacy.replace(telemetry=state.telemetry)
+                restored, carry = _attempt(legacy)  # real mismatch: raises
                 warnings.warn(
-                    "snapshot predates state fields "
-                    f"{missing}; they resume from init values"
+                    "checkpoint predates the flat-arena buffer layout; "
+                    "resuming with arena=False (re-snapshot to migrate)"
                 )
+                arena_on = False
             if carry is not None:
                 trace_carry = carry
             else:
@@ -465,6 +569,12 @@ def train(
             chaos=chaos_sched, chaos_policy=chaos_policy,
             gossip_wire=wire_mode, compact_capacity=capacity,
             obs=obs_on,
+            arena=arena_on,
+            # NOTE arena_sgd (the all-flat SGD tail) stays off: it costs
+            # two extra full-model ravels per step, and the measured CPU
+            # ravel price (see ArenaSpec.ravel) makes the unflatten +
+            # per-leaf optax tail strictly cheaper on every backend we
+            # can measure
         )
 
     # a compact-wire run starts DENSE: warmup fires everything (no budget
@@ -733,6 +843,7 @@ def train(
                         m_e["sent_bytes_wire_real"][..., 0].mean()
                     ),
                     "n_params": n_params,
+                    "arena": bool(arena_on),
                 }
                 if gossip_wire == "compact":
                     rec["gossip_wire"] = mode_now

@@ -57,6 +57,7 @@ def init_train_state(
     event_cfg: Optional[EventConfig] = None,
     seed: int = 0,
     input_dtype=jnp.float32,
+    arena: bool = False,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks.
 
@@ -81,7 +82,11 @@ def init_train_state(
         event = None
         sparse = None
         if algo in ("eventgrad", "sp_eventgrad"):
-            event = EventState.init(params, topo, event_cfg or EventConfig())
+            # arena=True stores the neighbor receive buffers flat (the
+            # flat-arena step's layout; see EventState.init)
+            event = EventState.init(
+                params, topo, event_cfg or EventConfig(), arena=arena
+            )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)
 
@@ -111,6 +116,7 @@ def init_train_state_spmd(
     event_cfg: Optional[EventConfig] = None,
     seed: int = 0,
     input_dtype=jnp.float32,
+    arena: bool = False,
 ) -> TrainState:
     """Per-rank initialization inside the SPMD context — required when the
     topology has `sharded_axes` (tensor/expert parallelism): sharded layers
@@ -128,7 +134,9 @@ def init_train_state_spmd(
         event = None
         sparse = None
         if algo in ("eventgrad", "sp_eventgrad"):
-            event = EventState.init(params, topo, event_cfg or EventConfig())
+            event = EventState.init(
+                params, topo, event_cfg or EventConfig(), arena=arena
+            )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)
         return TrainState(

@@ -28,6 +28,7 @@ nll_loss∘log_softmax (cent.cpp:119) and cross_entropy
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -41,7 +42,10 @@ from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.chaos.policy import RecoveryPolicy, alive_mask
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
+from eventgrad_tpu.ops import arena_tuning, event_engine
+from eventgrad_tpu.ops.arena_update import fused_mix_commit, mix_commit_reference
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
+from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.events import (
     EventConfig, capacity_gate, commit, propose,
@@ -51,6 +55,20 @@ from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.utils import trees
 
 ALGOS = ("allreduce", "dpsgd", "eventgrad", "sp_eventgrad")
+
+
+def _fired_accounting(fire_vec: jnp.ndarray, sizes) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(fired payload elements, fired leaf count) as f32 scalars, summed
+    in int32 — exact to 2^31 elements, where the old per-leaf f32 add
+    chain started rounding past 2^24 fired elements (the flagship
+    ResNet's 17.4M-param full-fire case). ONE definition shared by the
+    tree and arena event branches so their metrics stay bitwise."""
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    fired_elems = jnp.sum(
+        jnp.where(fire_vec, sizes_arr, 0)
+    ).astype(jnp.float32)
+    fired_leaves = jnp.sum(fire_vec.astype(jnp.int32)).astype(jnp.float32)
+    return fired_elems, fired_leaves
 
 
 def _xent(output: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -81,8 +99,25 @@ def make_train_step(
     gossip_wire: str = "dense",
     compact_capacity: Optional[int] = None,
     obs: bool = False,
+    arena: bool = False,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
+
+    arena=True routes the gossip hot path through the flat parameter
+    arena (parallel/arena.py): the wire ships as ONE contiguous
+    [n_params] buffer with the event mask fused into its assembly,
+    stale neighbor buffers are carried flat in EventState.bufs (the
+    state MUST then come from EventState.init(..., arena=True) — the
+    loop handles this), the trigger/gate/pack sender side runs as one
+    fused pass (ops/event_engine.event_propose_pack) over lru-cached
+    leaf metadata, and the receive commit + mix read the flat buffers
+    through wide selects and per-leaf views feeding the optimizer tail
+    directly. Training is BITWISE the tree path (tests/test_arena.py);
+    models, checkpoint pytrees, and obs schemas are untouched. Requires
+    a single parameter dtype — heterogeneous trees silently keep the
+    tree path. With fused_sgd, the arena tail is the fused_mix_commit
+    kernel (ops/arena_update.py): buffer commit + mix + SGD in ONE
+    pass instead of fused_mix_sgd's separate scatter.
 
     fused_sgd=(lr, momentum): replace the mix + optax tail of gossip
     algorithms with the Pallas fused_mix_sgd kernel (ops/fused_update.py) —
@@ -315,6 +350,32 @@ def make_train_step(
         obs_prop = None
         obs_fire_vec = None
 
+        # flat-arena lift (static, trace-time decision): one contiguous
+        # [n_params] buffer per rank carries the gossip hot path; the
+        # arena needs a single parameter dtype, and allreduce has no
+        # gossip hot path to flatten
+        spec = arena_lib.arena_spec(params) if arena else None
+        use_arena = bool(
+            spec is not None and spec.homogeneous and spec.n_leaves
+            and algo in ("dpsgd", "eventgrad")  # the consuming algos
+        )
+        arena_bufs = None    # flat neighbor buffers for the flat mix/tail
+        arena_pending = None # (cands, effs, lasts) awaiting the fused commit
+        arena_fire_vec = None
+        # the fused-tail decision is needed inside the event branch (the
+        # buffer commit defers into the fused kernel); static either way
+        use_fused = fused_sgd is not None and algo != "allreduce"
+        if use_fused and not use_arena:
+            # measured dispatch policy (ops/fused_tuning.py): the chip
+            # capture showed the many-launch tree case losing to XLA's
+            # fused chains (0.87x on the 86-leaf ResNet) — auto-demote to
+            # the optax tail there; EG_FORCE_FUSED=1 overrides. The arena
+            # is exempt: it hands the kernel ONE lane-aligned flat launch
+            # (the measured ~1.0x best case), not 86.
+            from eventgrad_tpu.ops.fused_tuning import tree_fused_ok
+
+            use_fused = tree_fused_ok(trees.tree_num_leaves(params))
+
         bufs = ()
         if algo == "allreduce":
             # E1: average gradients over the data-parallel (gossip) axes
@@ -331,12 +392,99 @@ def make_train_step(
             wire_real = sent_bytes
 
         elif algo == "dpsgd":
-            bufs = collectives.neighbor_vals(params, topo, wire)
+            if use_arena:
+                arena_bufs = collectives.neighbor_vals_flat(
+                    params, topo, spec, wire
+                )
+            else:
+                bufs = collectives.neighbor_vals(params, topo, wire)
             if deliver is not None:
                 # lossy D-PSGD has no stale buffer to fall back to: a
                 # dropped edge leaves this pass's mix and the weight
                 # renormalizes (mix_weighted below)
                 health = chaos_monitor.update(health, deliver, ~deliver)
+
+        elif algo == "eventgrad" and use_arena:
+            force_fire = (
+                health.sync_req
+                if (chaos is not None and chaos_policy.sync_after)
+                else None
+            )
+            # ONE fused sender pass: trigger -> gate -> pack
+            # (ops/event_engine.py), replacing the tree path's flatten /
+            # propose / capacity_gate / _compact_pack chain below
+            prop, fire_vec, packed, leaf_id = event_engine.event_propose_pack(
+                params, event_state, pass_num, event_cfg, spec,
+                capacity=(
+                    compact_capacity if gossip_wire == "compact" else None
+                ),
+                force_fire=force_fire,
+            )
+            event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
+            obs_prop, obs_fire_vec = prop, fire_vec
+            arena_fire_vec = fire_vec
+            if gossip_wire == "compact":
+                cands, effs, raws = collectives.compact_neighbor_vals_flat(
+                    params, fire_vec, packed, leaf_id, topo,
+                    compact_capacity, spec, wire, deliver=deliver,
+                )
+                wire_real = jnp.float32(n_nb) * (
+                    collectives.wire_real_bytes_per_neighbor(
+                        n_params_static, n_leaves_static, wire,
+                        compact_capacity=compact_capacity, fire_bits=True,
+                    )
+                )
+            else:
+                # the Pallas masked-wire builder runs only where the
+                # chip measured a win (ops/arena_tuning.py, written by
+                # bench_kernels.py arena); the inline fused-concat form
+                # is bitwise and is what every other backend runs
+                wb = None
+                if not fused_interpret and arena_tuning.masked_wire_ok():
+                    wb = lambda f, fe, se: event_engine.masked_wire(
+                        f, fe, se, interpret=False
+                    )
+                cands, effs, raws = collectives.masked_neighbor_vals_flat(
+                    params, fire_vec, topo, spec, wire, deliver=deliver,
+                    wire_builder=wb,
+                )
+                wire_real = jnp.float32(n_nb) * (
+                    collectives.wire_real_bytes_per_neighbor(
+                        n_params_static, n_leaves_static, wire,
+                        fire_bits=True,
+                    )
+                )
+            if deliver is not None:
+                # raws are the RAW sender bits (what was on the wire)
+                sent_any = jnp.stack([jnp.any(rv) for rv in raws])
+                health = chaos_monitor.update(
+                    health, sent_any & deliver, sent_any & ~deliver
+                )
+                if chaos_policy.sync_after:
+                    need = health.silence >= chaos_policy.sync_after
+                    health = health.replace(
+                        sync_req=chaos_monitor.sync_requests(need, topo)
+                    )
+            lasts = event_state.bufs
+            if use_fused:
+                # receive-commit fuses into the mix+SGD kernel below
+                # (fused_mix_commit): the stale buffers are read once
+                arena_pending = (cands, effs, lasts)
+            else:
+                new_bufs = collectives.commit_bufs_flat(
+                    cands, effs, lasts, spec
+                )
+                # staleness=1: mix with what had arrived as of the
+                # PREVIOUS step; this step's exchange lands for the next
+                arena_bufs = lasts if staleness else new_bufs
+                event_state = event_state.replace(bufs=new_bufs)
+            fired_elems, fired_leaves = _fired_accounting(
+                fire_vec, spec.sizes
+            )
+            sent_bytes = jnp.float32(n_nb) * (
+                val_bytes * fired_elems + scale_bytes_per_leaf * fired_leaves
+            )
+            fired_frac = fired_leaves / spec.n_leaves
 
         elif algo == "eventgrad":
             force_fire = (
@@ -412,20 +560,20 @@ def make_train_step(
             # step; this step's exchange lands for the next one
             bufs = event_state.bufs if staleness else new_bufs
             event_state = event_state.replace(bufs=new_bufs)
-            fired = [
-                (f.astype(jnp.float32), p.size)
-                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
-            ]
-            fired_elems = sum(f * n for f, n in fired)
-            sent_bytes = jnp.float32(n_nb) * (
-                val_bytes * fired_elems
-                + scale_bytes_per_leaf * sum(f for f, _ in fired)
+            fired_elems, fired_leaves = _fired_accounting(
+                fire_vec, tuple(int(l.size) for l in p_leaves)
             )
-            fired_frac = sum(f for f, _ in fired) / len(fired)
+            sent_bytes = jnp.float32(n_nb) * (
+                val_bytes * fired_elems + scale_bytes_per_leaf * fired_leaves
+            )
+            fired_frac = fired_leaves / len(p_leaves)
 
         elif algo == "sp_eventgrad":
             # the propose/commit split of decide_and_update, inlined so
-            # the proposal feeds the telemetry accumulators
+            # the proposal feeds the telemetry accumulators. (The arena
+            # lift leaves sp alone: its top-k scatter replicas are
+            # tree-shaped state, and the trigger already reads leaves
+            # leaf-parallel.)
             prop = propose(params, event_state, pass_num, event_cfg)
             event_state = commit(
                 event_state, prop, prop.fire_vec, event_cfg, n_nb
@@ -440,17 +588,16 @@ def make_train_step(
                 params, fire, sparse_state, topo, sparse_cfg, wire
             )
             bufs = stale_replicas if staleness else sparse_state.replicas
-            fired = [
-                (f.astype(jnp.float32), sparse_cfg.k_for(p.size))
-                for f, p in zip(jax.tree.leaves(fire), jax.tree.leaves(params))
-            ]
+            ks = tuple(
+                sparse_cfg.k_for(p.size) for p in jax.tree.leaves(params)
+            )
             # values + int32 indices per selected element per neighbor
-            fired_elems = sum(f * k for f, k in fired)
+            fired_elems, fired_leaves = _fired_accounting(prop.fire_vec, ks)
             sent_bytes = jnp.float32(n_nb) * (
                 (val_bytes + 4.0) * fired_elems
-                + scale_bytes_per_leaf * sum(f for f, _ in fired)
+                + scale_bytes_per_leaf * fired_leaves
             )
-            fired_frac = sum(f for f, _ in fired) / len(fired)
+            fired_frac = fired_leaves / len(ks)
             # the top-k lanes physically ship every pass (masked on
             # receipt): k values + k int32 indices per leaf per neighbor,
             # plus the fire bits (and int8 scales)
@@ -461,16 +608,51 @@ def make_train_step(
                 + scale_bytes_per_leaf * n_leaves_static
             )
 
-        use_fused = fused_sgd is not None and algo != "allreduce"
-        if use_fused:
-            # measured dispatch policy (ops/fused_tuning.py): the chip
-            # capture showed the many-launch tree case losing to XLA's
-            # fused chains (0.87x on the 86-leaf ResNet) — auto-demote to
-            # the optax tail there; EG_FORCE_FUSED=1 overrides
-            from eventgrad_tpu.ops.fused_tuning import tree_fused_ok
-
-            use_fused = tree_fused_ok(trees.tree_num_leaves(params))
-        if use_fused:
+        if use_fused and (arena_pending is not None or arena_bufs is not None):
+            # arena fused tail: buffer commit + mix + momentum-SGD in one
+            # flat pass (ops/arena_update.fused_mix_commit); dpsgd has no
+            # commit, so it rides fused_mix_sgd on the single flat leaf
+            lr_f, mom_f = fused_sgd
+            flat = spec.ravel(params)
+            g_flat = spec.ravel(grads)
+            if mom_f:
+                t_flat = spec.ravel(state.opt_state[0].trace)
+            else:
+                t_flat = jnp.zeros_like(flat)
+            if arena_pending is not None:
+                cands, effs, lasts = arena_pending
+                seg = spec.seg_expand()  # [n] keeps for the kernel only
+                keeps = tuple(e[seg] for e in effs)
+                tail_fn = (
+                    functools.partial(
+                        fused_mix_commit, interpret=fused_interpret
+                    )
+                    if arena_tuning.mix_commit_ok() else mix_commit_reference
+                )
+                p_flat, new_t_flat, new_bufs = tail_fn(
+                    flat, cands, keeps, lasts, g_flat, t_flat,
+                    float(lr_f), float(mom_f), topo.mix_weight,
+                    mix_stale=bool(staleness),
+                )
+                event_state = event_state.replace(bufs=new_bufs)
+            else:
+                buf_sum = jnp.zeros_like(flat)
+                for b in arena_bufs:
+                    buf_sum = jnp.add(buf_sum, b)
+                p_flat, new_t_flat = fused_mix_sgd(
+                    flat, buf_sum, g_flat, t_flat, lr_f, mom_f,
+                    topo.mix_weight, interpret=fused_interpret,
+                )
+            params = spec.unravel(p_flat)
+            if mom_f:
+                opt_state = (
+                    state.opt_state[0]._replace(
+                        trace=spec.unravel(new_t_flat)
+                    ),
+                ) + tuple(state.opt_state[1:])
+            else:
+                opt_state = state.opt_state
+        elif use_fused:
             # Pallas fused tail: mix + momentum-SGD in one HBM pass.
             lr_f, mom_f = fused_sgd
             buf_sum = trees.tree_zeros_like(params)
@@ -490,6 +672,28 @@ def make_train_step(
                 )
             else:
                 opt_state = state.opt_state
+        elif arena_bufs is not None:
+            # arena mix + SGD tail: the mix reads the FLAT neighbor
+            # buffers through per-leaf slices and emits the mixed pytree
+            # directly (mix_flat_into_tree) — each leaf is an
+            # independent fusion feeding the optax tail, bitwise the
+            # tree mix, with no assembled intermediate on the critical
+            # path. Chaos gate semantics identical to the tree branch.
+            gate = None
+            if deliver is not None and arena_bufs:
+                alive = alive_mask(health.silence, chaos_policy)
+                if algo == "dpsgd":
+                    gate = deliver if alive is None else deliver & alive
+                elif alive is not None:
+                    gate = alive
+            if arena_bufs:
+                mixed = collectives.mix_flat_into_tree(
+                    params, arena_bufs, spec, topo, gate=gate
+                )
+            else:
+                mixed = params
+            updates, opt_state = tx.update(grads, state.opt_state, mixed)
+            params = optax.apply_updates(mixed, updates)
         else:
             # chaos edge gating of the mix: dpsgd drops leave this pass's
             # average (no stale buffer exists); a frozen edge (silence >=
@@ -580,8 +784,12 @@ def make_train_step(
                 jax.tree.leaves(trees.tree_norm(state.params))
             )
             metrics["trace_thres"] = event_state.thres  # already [L]-vector
-            metrics["trace_fired"] = jnp.stack(
-                [f.astype(jnp.float32) for f in jax.tree.leaves(fire)]
+            metrics["trace_fired"] = (
+                arena_fire_vec.astype(jnp.float32)
+                if arena_fire_vec is not None
+                else jnp.stack(
+                    [f.astype(jnp.float32) for f in jax.tree.leaves(fire)]
+                )
             )
         return new_state, metrics
 

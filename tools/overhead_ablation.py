@@ -30,6 +30,15 @@ Writes artifacts/overhead_ablation_r4_<platform>.json.
 
 Usage:
   python tools/overhead_ablation.py [n_timed_steps]   micro attribution
+  python tools/overhead_ablation.py arena [n_timed_steps]
+      flat-arena A/B (the --arena on|off leg): times the eventgrad and
+      dpsgd steps at the bench op-point with the arena OFF (legacy tree
+      path) and ON (flat-arena engine, parallel/arena.py), and writes
+      artifacts/arena_ablation_<platform>.json with the
+      step_overhead_ratio (eventgrad/dpsgd) before and after — the
+      acceptance metric of the flat-arena PR (target: <= 1.05 with
+      bitwise-equivalent training, tests/test_arena.py). Validated by
+      tools/validate_artifacts.py.
   python tools/overhead_ablation.py order <ed|de>     in-loop order twin:
       runs the bench op-point's two train() legs in the given order
       (ed = eventgrad first, the bench's order; de = dpsgd first) inside
@@ -132,9 +141,180 @@ def order_experiment(order: str) -> None:
         print(json.dumps(rec), flush=True)
 
 
+def arena_experiment(n_rounds: int = 8) -> None:
+    """A/B the flat-arena engine at the bench op-point (module docstring).
+
+    Measurement protocol: each (algo, arena) variant compiles ONE
+    scan-of-16-steps program (the production dispatch shape train()
+    runs — per-call step timing re-executes loop-invariant work the
+    real scan hoists and is dominated by dispatch jitter), then the
+    four programs run INTERLEAVED for `n_rounds` rounds with the
+    per-round minimum kept — back-to-back interleaving cancels the
+    machine's load drift, which single-leg timing on a shared CPU does
+    not. step_ms is min-of-rounds / 16."""
+    topo = Ring(8)
+    model = LeNetCifar()
+    lr, mom = 1e-2, 0.9
+    tx = optax.sgd(lr, momentum=mom)
+    per_rank = 8
+    K = 16
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    xb, yb = batched_epoch(x, y, topo.n_ranks, per_rank)
+    import numpy as np
+
+    xs = jnp.asarray(np.stack(
+        [xb[:, s % xb.shape[1]] for s in range(K)], 0))
+    ys = jnp.asarray(np.stack(
+        [yb[:, s % yb.shape[1]] for s in range(K)], 0))
+    cfg = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+
+    variants = {}
+    for algo, c in (("dpsgd", None), ("eventgrad", cfg)):
+        for arena_on in (False, True):
+            state = init_train_state(
+                model, x.shape[1:], tx, topo, algo, c, arena=arena_on
+            )
+            lifted = spmd(make_train_step(
+                model, tx, topo, algo, event_cfg=c, arena=arena_on,
+            ), topo)
+
+            def run(s, xs, ys, _l=lifted):
+                return jax.lax.scan(lambda s, b: _l(s, b), s, (xs, ys))
+
+            run = jax.jit(run)
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(out.params)
+            compile_s = time.perf_counter() - t0
+            variants[(algo, arena_on)] = (state, run, compile_s)
+
+    times = {k: [] for k in variants}
+    for _ in range(n_rounds):
+        for k, (state, run, _c) in variants.items():
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(out.params)
+            times[k].append((time.perf_counter() - t0) / K * 1000)
+
+    def _median(v):
+        s = sorted(v)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    results = {}
+    for arena_on in (False, True):
+        leg = {}
+        for algo in ("dpsgd", "eventgrad"):
+            v = times[(algo, arena_on)]
+            leg[algo] = {
+                "compile_s": round(variants[(algo, arena_on)][2], 4),
+                "step_ms_min": round(min(v), 4),
+                "step_ms_p50": round(_median(v), 4),
+            }
+        # PAIRED estimator: the two algos of one round run back-to-back
+        # under the same machine load, so the per-round ratio cancels
+        # load drift that min/median of the individual legs cannot; the
+        # median across rounds is the committed number
+        paired = [
+            e / d
+            for e, d in zip(times[("eventgrad", arena_on)],
+                            times[("dpsgd", arena_on)])
+        ]
+        leg["step_overhead_ratio"] = round(_median(paired), 4)
+        results["arena_on" if arena_on else "arena_off"] = leg
+        print(json.dumps({("arena_on" if arena_on else "arena_off"): leg}),
+              flush=True)
+
+    # secondary leg: per-DISPATCH step timing (one jit call per step, no
+    # scan) — the regime where the r05 1.10x event overhead reproduces
+    # on CPU (loop-invariant work re-executes per call and nothing
+    # amortizes). Recorded so the two regimes can't be conflated.
+    import jax as _jax
+
+    b1 = (xs[0], ys[0])
+    steps1 = {}
+    for (algo, arena_on), (state, _run, _c) in variants.items():
+        c = cfg if algo == "eventgrad" else None
+        step = _jax.jit(spmd(make_train_step(
+            model, tx, topo, algo, event_cfg=c, arena=arena_on,
+        ), topo))
+        s2, _ = step(state, b1)
+        _jax.block_until_ready(s2.params)
+        steps1[(algo, arena_on)] = (state, step)
+    times1 = {k: [] for k in steps1}
+    for _ in range(n_rounds):
+        for k, (state, step) in steps1.items():
+            s = state
+            t0 = time.perf_counter()
+            for _ in range(6):
+                s, _ = step(s, b1)
+            _jax.block_until_ready(s.params)
+            times1[k].append((time.perf_counter() - t0) / 6 * 1000)
+    per_dispatch = {}
+    for arena_on in (False, True):
+        key = "arena_on" if arena_on else "arena_off"
+        paired = [
+            e / d
+            for e, d in zip(times1[("eventgrad", arena_on)],
+                            times1[("dpsgd", arena_on)])
+        ]
+        per_dispatch[key] = {
+            "dpsgd_step_ms_min": round(min(times1[("dpsgd", arena_on)]), 4),
+            "eventgrad_step_ms_min": round(
+                min(times1[("eventgrad", arena_on)]), 4
+            ),
+            "step_overhead_ratio": round(_median(paired), 4),
+        }
+    print(json.dumps({"per_dispatch": per_dispatch}), flush=True)
+
+    d = jax.devices()[0]
+    rec = {
+        "bench": "arena_ablation",
+        "op_point": {
+            "model": "LeNetCifar", "topology": "ring8",
+            "global_batch": topo.n_ranks * per_rank,
+            "scan_steps": K, "rounds": n_rounds, "momentum": mom,
+            "trigger": {"horizon": 1.05, "max_silence": 50, "warmup": 10},
+        },
+        "results": results,
+        "per_dispatch": per_dispatch,
+        "overhead_ratio_before": results["arena_off"]["step_overhead_ratio"],
+        "overhead_ratio_after": results["arena_on"]["step_overhead_ratio"],
+        "note": (
+            "ratios are median paired per-round (eventgrad/dpsgd "
+            "back-to-back under the same load) over scanned "
+            "steady-state runs — the production dispatch shape and "
+            "bench.py's metric. On this shared CPU both land near the "
+            "~1-2% measurement floor; before/after differences inside "
+            "that band are noise, and the acceptance bound is the "
+            "arena-on value. The r05 1.10x overhead reproduces on CPU "
+            "mainly in the per_dispatch regime (also recorded)."
+        ),
+        "eventgrad_step_speedup": round(
+            results["arena_off"]["eventgrad"]["step_ms_min"]
+            / results["arena_on"]["eventgrad"]["step_ms_min"], 4
+        ),
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    out_path = os.path.join(
+        REPO, "artifacts", f"arena_ablation_{d.platform}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "order":
         order_experiment(sys.argv[2] if len(sys.argv) > 2 else "ed")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "arena":
+        arena_experiment(int(sys.argv[2]) if len(sys.argv) > 2 else 24)
         return
     n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 24
     topo = Ring(8)

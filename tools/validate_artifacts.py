@@ -188,11 +188,47 @@ FLAGSHIP_SCHEMA = {
     },
 }
 
+_ARENA_LEG = {
+    "type": "object",
+    "required": ["dpsgd", "eventgrad", "step_overhead_ratio"],
+    "properties": {
+        "step_overhead_ratio": {"type": "number", "minimum": 0},
+        "dpsgd": {"type": "object", "required": ["step_ms_min"]},
+        "eventgrad": {"type": "object", "required": ["step_ms_min"]},
+    },
+}
+
+ARENA_ABLATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "op_point", "results", "overhead_ratio_before",
+        "overhead_ratio_after", "platform",
+    ],
+    "properties": {
+        "bench": {"enum": ["arena_ablation"]},
+        "results": {
+            "type": "object",
+            "required": ["arena_off", "arena_on"],
+            "properties": {
+                "arena_off": _ARENA_LEG,
+                "arena_on": _ARENA_LEG,
+            },
+        },
+        "overhead_ratio_before": {"type": "number", "minimum": 0},
+        # the flat-arena acceptance bound (ISSUE 4): the production-shape
+        # EventGraD/D-PSGD step ratio with the arena on
+        "overhead_ratio_after": {"type": "number", "minimum": 0,
+                                 "maximum": 1.05},
+        "platform": {"type": "string"},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
     ("obs_report_", OBS_REPORT_SCHEMA),
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
+    ("arena_ablation_", ARENA_ABLATION_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
     ("tpu_flagship", FLAGSHIP_SCHEMA),
