@@ -77,6 +77,11 @@ Env contract (single source of truth, mirrored in REPRO.md):
                       so a bench run can be inspected in chrome://tracing;
                       unset = spans are still recorded (host-side, ~free)
                       but nothing is written
+  EG_BENCH_PIPELINE   0 pins the serial dispatch schedule (default on:
+                      the zero-bubble pipeline of train/loop.py —
+                      bitwise-identical training, host work overlapped;
+                      the record carries `pipeline` and the measured
+                      `host_bubble_frac` next to step_ms)
   EG_BENCH_CHAOS      chaos mode (robustness instead of savings): run the
                       tools/chaos_sweep.py drop-rate/recovery sweep and
                       emit ITS record as the last JSON line. "1" =
@@ -293,12 +298,18 @@ def main() -> None:
     # pins the legacy tree path for A/B runs — tools/overhead_ablation.py
     # measures the same pair in isolation)
     bench_arena = os.environ.get("EG_BENCH_ARENA", "1") != "0"
+    # zero-bubble dispatch pipeline (train/loop.py): host work overlaps
+    # device compute; EG_BENCH_PIPELINE=0 pins the serial schedule (the
+    # A/B knob of tools/bubble_decomposition.py). Training is bitwise-
+    # identical either way — only the host schedule moves.
+    bench_pipeline = os.environ.get("EG_BENCH_PIPELINE", "1") != "0"
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
         random_sampler=True, log_every_epoch=False,
         epochs_per_dispatch=k_disp,
         arena=bench_arena,
+        pipeline=bench_pipeline,
     )
 
     # host span trace of the bench's own phases (obs.Registry): always
@@ -401,6 +412,18 @@ def main() -> None:
     # reduced op-point (artifacts/overhead_ablation_r4_cpu.json).
     steady_d = steady_records(hist_d)
     step_s_d = float(np.mean([h["wall_s"] / h["steps"] for h in steady_d]))
+    # host-bubble fraction of the eventgrad leg (wall the device sat idle
+    # between dispatch blocks — the thing the dispatch pipeline deletes),
+    # decomposed from the span trace of the FIRST train() window
+    # (obs.bubble; tools/bubble_decomposition.py is the A/B proof)
+    from eventgrad_tpu.obs import bubble as obs_bubble
+
+    host_bubble_frac = None
+    _windows = obs_bubble.train_windows(obs_reg.spans)
+    if _windows:
+        host_bubble_frac = obs_bubble.decompose(_windows[0])[
+            "host_bubble_frac"
+        ]
     # shape/dtype metadata of the stacked tree — no device dispatch needed
     n_params = trees.tree_count_params(state.params) // topo.n_ranks
     n_leaves = trees.tree_num_leaves(state.params)
@@ -568,6 +591,11 @@ def main() -> None:
                 "warmup_passes": warmup,
                 "step_ms": round(1000 * step_s, 2),
                 "step_ms_dpsgd": round(1000 * step_s_d, 2),
+                # device-idle fraction of the eventgrad leg's wall (span-
+                # trace decomposition; ~0 with the pipeline on, the r05
+                # serialized chain measured ~38% on TPU)
+                "host_bubble_frac": host_bubble_frac,
+                "pipeline": bench_pipeline,
                 "step_overhead_ratio": round(step_s / step_s_d, 4),
                 # both legs ran with the flat-arena hot path? (the
                 # step_overhead_ratio acceptance metric is arena-on;

@@ -225,6 +225,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "dpsgd/eventgrad on plain data-parallel "
                         "topologies; off = legacy tree path (the A/B "
                         "knob of tools/overhead_ablation.py)")
+    p.add_argument("--pipeline", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="zero-bubble dispatch pipeline (train/loop.py): "
+                        "dispatch block B+1 immediately and run block "
+                        "B's host work (telemetry flush, history "
+                        "records, eval readback, checkpoint "
+                        "serialization) while the device computes — "
+                        "training is bitwise-identical either way. auto "
+                        "(default) enables it for single-process runs "
+                        "without --fault-inject; off = the serial "
+                        "block_until_ready chain (the A/B knob of "
+                        "tools/bubble_decomposition.py)")
     p.add_argument("--fused", action="store_true",
                    help="Pallas fused gossip-mix+SGD update tail "
                         "(gossip algorithms; plain/momentum SGD only). "
@@ -430,6 +442,12 @@ def main(argv=None) -> int:
             "--chaos 'drop=0' for recovery monitoring without injected "
             "faults)"
         )
+    if args.pipeline == "on" and args.fault_inject:
+        raise SystemExit(
+            "--pipeline on cannot honor --fault-inject (the fault must "
+            "land at an exact post-snapshot epoch boundary, which needs "
+            "the serial schedule); use --pipeline auto or off"
+        )
     if not is_lm and not args.model.startswith("resnet") and (
         args.num_classes != 10 or args.num_filters != 64
     ):
@@ -522,6 +540,9 @@ def main(argv=None) -> int:
                 chaos=chaos_sched, chaos_policy=chaos_policy,
                 obs=args.obs, registry=registry,
                 arena={"auto": None, "on": True, "off": False}[args.arena],
+                pipeline={
+                    "auto": None, "on": True, "off": False
+                }[args.pipeline],
                 on_epoch=emit,  # records stream as epochs finish: live
                 # metrics for the user, a liveness signal for supervise.py
             )

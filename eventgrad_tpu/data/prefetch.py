@@ -1,4 +1,4 @@
-"""Epoch prefetcher: overlap host batch assembly with device compute.
+"""Epoch/block prefetcher: overlap host batch assembly with device compute.
 
 The reference's data layer is synchronous C++ inside the train loop
 (custom.hpp get() per sample, assembled by the libtorch dataloader between
@@ -11,26 +11,44 @@ identical whether or not the native library built — resume bit-parity
 holds across machines) and the batch gather uses the native memcpy kernels
 (native/dataio.cpp) when available — ctypes calls drop the GIL, so the
 overlap is real.
+
+Block granularity (the dispatch pipeline, train/loop.py): `get_block`
+serves the K-epoch stacked arrays of one jit-dispatch block and
+speculatively assembles the NEXT block on the worker — including the
+optional `transfer` callable (the loop passes the device_put), so block
+B+1's host->device upload also overlaps block B's compute instead of
+sitting on the dispatch critical path. Speculation misses (an access
+order the speculation didn't predict) fall back to synchronous assembly,
+are counted in `.misses`, and logged — a silently cold prefetcher is a
+perf bug, not a correctness one.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from eventgrad_tpu.data import native
 from eventgrad_tpu.data.sharding import epoch_index_plan
 
+_log = logging.getLogger(__name__)
+
 
 class EpochPrefetcher:
-    """Double-buffered epoch batch assembly.
+    """Double-buffered epoch/block batch assembly.
 
     get(epoch) returns (xb, yb) shaped [n_ranks, steps, batch, ...] /
     [n_ranks, steps, batch] — identical layout and shard semantics to
     `sharding.batched_epoch` — and immediately starts assembling
-    epoch+1 in the background.
+    epoch+1 in the background. get_block(first, last, next_span=...)
+    returns the epochs first..last concatenated along the steps axis
+    (what a K-epoch dispatch block consumes) and speculates `next_span`
+    instead. With `transfer` set (e.g. `jnp.asarray` per array), the
+    background thread also runs the device transfer, so the returned
+    block is already on device.
     """
 
     def __init__(
@@ -43,6 +61,7 @@ class EpochPrefetcher:
         random: bool = False,
         seed: int = 0,
         last_epoch: Optional[int] = None,
+        transfer: Optional[Callable[[np.ndarray], object]] = None,
     ):
         # preserve integer inputs (token sequences); images go to float32
         # (one rule with the device-resident path: sharding.input_cast_dtype)
@@ -55,9 +74,14 @@ class EpochPrefetcher:
         self.random = random
         self.seed = seed
         self.last_epoch = last_epoch  # no speculative assembly past this
+        self.transfer = transfer
+        #: speculation misses: a get()/get_block() the pending background
+        #: assembly did not predict (fell back to synchronous assembly)
+        self.misses = 0
         # validates batch/shard sizes too (single source of truth)
         self.steps = epoch_index_plan(len(x), n_ranks, batch_size).shape[1]
-        self._pending: Optional[Tuple[int, threading.Thread, dict]] = None
+        #: ((first, last), thread, box) of the in-flight speculation
+        self._pending: Optional[Tuple[Tuple[int, int], threading.Thread, dict]] = None
 
     def _assemble(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
         idx = epoch_index_plan(
@@ -66,36 +90,112 @@ class EpochPrefetcher:
         )
         return native.gather_batches(self.x, self.y, idx)
 
-    def _start(self, epoch: int):
+    def _assemble_span(self, first: int, last: int):
+        """Assemble epochs first..last stacked [n_ranks, K*steps, B, ...]
+        and apply the transfer (device_put) when configured — the worker
+        thread runs this whole chain, so the H2D upload overlaps too."""
+        parts = [self._assemble(e) for e in range(first, last + 1)]
+        if len(parts) == 1:
+            xb, yb = parts[0]
+        else:
+            xb = np.concatenate([p[0] for p in parts], axis=1)
+            yb = np.concatenate([p[1] for p in parts], axis=1)
+        del parts
+        if self.transfer is not None:
+            return self.transfer(xb), self.transfer(yb)
+        return xb, yb
+
+    def _start(self, span: Tuple[int, int]):
         box: dict = {}
 
         def work():
             try:
-                box["out"] = self._assemble(epoch)
+                box["out"] = self._assemble_span(*span)
             except BaseException as e:  # surfaced by the consuming get()
                 box["err"] = e
 
-        th = threading.Thread(target=work, daemon=True, name=f"eg-prefetch-{epoch}")
+        th = threading.Thread(
+            target=work, daemon=True, name=f"eg-prefetch-{span[0]}-{span[1]}"
+        )
         th.start()
-        return (epoch, th, box)
+        return (span, th, box)
 
-    def get(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
-        out = None
-        if self._pending is not None:
-            ep, th, box = self._pending
-            th.join()  # either our epoch, or stale speculation to retire
-            if ep == epoch:
-                if "err" in box:
-                    raise box["err"]
-                out = box["out"]
-            self._pending = None
-        if out is None:  # miss (first call or out-of-order epoch)
-            out = self._assemble(epoch)
-        if self.last_epoch is None or epoch < self.last_epoch:
-            self._pending = self._start(epoch + 1)
+    def _take(self, span: Tuple[int, int]):
+        """Consume the pending speculation if it matches `span`; None on a
+        miss (counted and logged — the caller assembles synchronously)."""
+        if self._pending is None:
+            return None
+        pspan, th, box = self._pending
+        th.join()  # either our span, or stale speculation to retire
+        self._pending = None
+        if pspan != span:
+            self.misses += 1
+            _log.warning(
+                "prefetch speculation miss #%d: assembled epochs %s, "
+                "requested %s — falling back to synchronous assembly",
+                self.misses, pspan, span,
+            )
+            if "err" in box:
+                # the stale speculation ALSO failed: surface the root
+                # cause next to the miss (the synchronous retry below
+                # will usually re-raise it, but not necessarily — e.g.
+                # a transient I/O fault)
+                _log.warning(
+                    "stale prefetch speculation %s had failed: %r",
+                    pspan, box["err"],
+                )
+            return None
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def _clamp_span(self, span: Optional[Tuple[int, int]]):
+        if span is None:
+            return None
+        first, last = span
+        if self.last_epoch is not None:
+            if first > self.last_epoch:
+                return None
+            last = min(last, self.last_epoch)
+        return (first, last)
+
+    def get_block(
+        self,
+        first: int,
+        last: int,
+        next_span: Optional[Tuple[int, int]] = None,
+    ):
+        """One dispatch block's stacked arrays; speculate `next_span`
+        (the loop's next block bounds) in the background."""
+        out = self._take((first, last))
+        if out is None:  # miss (first call or unpredicted access order)
+            out = self._assemble_span(first, last)
+        nxt = self._clamp_span(next_span)
+        if nxt is not None:
+            self._pending = self._start(nxt)
         return out
 
+    def get(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Single-epoch access (the pre-block API): speculates epoch+1."""
+        nxt = (epoch + 1, epoch + 1)
+        if self.last_epoch is not None and epoch >= self.last_epoch:
+            nxt = None
+        return self.get_block(epoch, epoch, next_span=nxt)
+
     def close(self) -> None:
+        """Idempotent teardown: retire any in-flight speculation WITHOUT
+        raising — a worker error in unconsumed speculative work must not
+        mask the loop's real exception (the loop calls this in its
+        `finally`). Safe to call repeatedly."""
         if self._pending is not None:
-            self._pending[1].join()
+            _, th, box = self._pending
             self._pending = None
+            try:
+                th.join()
+            except Exception:  # pragma: no cover - join never raises
+                pass
+            if "err" in box:
+                _log.warning(
+                    "prefetch worker error discarded at close: %r",
+                    box["err"],
+                )

@@ -6,7 +6,9 @@ train-scan carry and flushes to host once per jit-dispatch block
 metrics stream, chaos peer-health, and profiling latencies behind one
 versioned schema, with Prometheus-textfile and Chrome-trace/Perfetto
 exporters. `obs.report.build_report` (tools/obs_report.py) renders a
-self-contained run report from any history/JSONL.
+self-contained run report from any history/JSONL. `obs.bubble`
+decomposes a span trace into wall = steps + host bubble — the dispatch
+pipeline's acceptance metric (tools/bubble_decomposition.py).
 """
 
 from eventgrad_tpu.obs.device import TelemetryState, accumulate

@@ -1,0 +1,175 @@
+"""Host-bubble decomposition of a training run's span trace.
+
+The dispatch pipeline's acceptance metric (docs/ARCHITECTURE.md "The
+dispatch pipeline") is the HOST BUBBLE: the fraction of the train() wall
+during which the device sits idle because the host is doing serialized
+work between dispatch blocks — telemetry flush, history records, eval,
+checkpoint serialization, input assembly. The r05 TPU flagship put that
+bubble at ~38% of EventGraD's wall (851 s wall vs 531 s of steps) vs
+~22% for D-PSGD; deleting it, not shaving step time, is what closes the
+wall-clock race.
+
+The decomposition reads the `obs.Registry` span trace the loop already
+records (the same spans `--obs-dir`/`EG_BENCH_OBS_TRACE` export as
+Chrome-trace JSON):
+
+  * device-busy intervals: one per dispatch block, from the
+    `dispatch_block` span's start to the block's observed readiness —
+    the span's own end in serial mode (it wraps `block_until_ready`),
+    the matching `block_ready` span's end in pipelined mode (the
+    deferred metrics readback). The UNION of these intervals is
+    `steps_s` (pipelined blocks overlap their host work, not each
+    other — the union handles that).
+  * wall: the `train` root span.
+  * `host_bubble_frac` = 1 - steps_s / wall — everything the device was
+    NOT kept busy.
+  * component sums (`data_s`, `flush_s`, `eval_s`, `checkpoint_s`) are
+    raw span-duration sums; under the pipeline they OVERLAP the busy
+    intervals (that is the point), so they decompose the serial leg's
+    bubble but can exceed the pipelined leg's. `other_s` is the bubble
+    left after the named components (records loop, python glue).
+
+Consumed by `tools/bubble_decomposition.py` (the committed
+`artifacts/pipeline_bubble_cpu.json` proof), `tools/obs_report.py
+--trace`, and bench.py's `host_bubble_frac` field.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: span names summed into each named bubble component
+_COMPONENTS = {
+    "data_s": ("data",),
+    "flush_s": ("obs_flush",),
+    "eval_s": ("eval", "eval_readback"),
+    "checkpoint_s": ("checkpoint", "ckpt_snapshot", "ckpt_write"),
+}
+
+
+def _norm(span: Any) -> Tuple[str, float, float, Dict[str, Any]]:
+    """(name, ts_us, dur_us, args) from an obs.registry.Span OR a
+    Chrome-trace event dict (so a written trace.json replays)."""
+    if isinstance(span, dict):
+        return (
+            span.get("name", ""),
+            float(span.get("ts", 0.0)),
+            float(span.get("dur", 0.0)),
+            dict(span.get("args") or {}),
+        )
+    return span.name, float(span.ts_us), float(span.dur_us), dict(span.args)
+
+
+def _union_s(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered length (seconds) of microsecond intervals."""
+    cur_start: Optional[float] = None
+    cur_end = 0.0
+    total = 0.0
+    for start, end in sorted(intervals):
+        if cur_start is None:
+            cur_start, cur_end = start, end
+        elif start <= cur_end:
+            cur_end = max(cur_end, end)
+        else:
+            total += cur_end - cur_start
+            cur_start, cur_end = start, end
+    if cur_start is not None:
+        total += cur_end - cur_start
+    return total / 1e6
+
+
+def train_windows(spans: Sequence[Any]) -> List[List[Any]]:
+    """Split a span list into per-`train`-root windows (a bench registry
+    records several train() legs back to back); spans are assigned to
+    the root whose [ts, ts+dur] contains them."""
+    normed = [(_norm(s), s) for s in spans]
+    roots = [
+        (n[1], n[1] + n[2]) for n, _ in normed if n[0] == "train"
+    ]
+    out: List[List[Any]] = [[] for _ in roots]
+    for n, s in normed:
+        for i, (lo, hi) in enumerate(roots):
+            if n[0] != "train" and lo - 1 <= n[1] and n[1] + n[2] <= hi + 1:
+                out[i].append(s)
+                break
+            if n[0] == "train" and n[1] == lo:
+                out[i].append(s)
+                break
+    return out
+
+
+def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
+    """wall = steps + bubble; bubble >= data + flush + eval + checkpoint
+    (serial) — returns the seconds of each plus `host_bubble_frac`."""
+    normed = [_norm(s) for s in spans]
+    train = [n for n in normed if n[0] == "train"]
+    if train:
+        wall_us = train[0][2]
+        t_lo = train[0][1]
+    else:  # no root span: fall back to the observed envelope
+        t_lo = min((n[1] for n in normed), default=0.0)
+        wall_us = max((n[1] + n[2] for n in normed), default=0.0) - t_lo
+
+    # device-busy intervals: dispatch start -> observed readiness
+    ready_end = {
+        n[3].get("block"): n[1] + n[2]
+        for n in normed if n[0] == "block_ready"
+    }
+    busy: List[Tuple[float, float]] = []
+    n_blocks = 0
+    pipelined = False
+    for n in normed:
+        if n[0] != "dispatch_block":
+            continue
+        n_blocks += 1
+        blk_piped = bool(n[3].get("pipelined", False))
+        pipelined = pipelined or blk_piped
+        # serial blocks: the dispatch span wraps block_until_ready, so its
+        # own end IS the observed readiness (the later block_ready span is
+        # a no-op recorded after other host work — using it would swallow
+        # that work into "busy"). Pipelined blocks: the dispatch span is
+        # just the enqueue; readiness is the deferred block_ready end.
+        end = n[1] + n[2]
+        if blk_piped:
+            end = max(end, ready_end.get(n[3].get("block"), end))
+        busy.append((n[1], end))
+    steps_s = _union_s(busy)
+
+    comp = {
+        key: sum(n[2] for n in normed if n[0] in names) / 1e6
+        for key, names in _COMPONENTS.items()
+    }
+    wall_s = wall_us / 1e6
+    bubble_s = max(0.0, wall_s - steps_s)
+    other_s = max(0.0, bubble_s - sum(comp.values()))
+    return {
+        "wall_s": round(wall_s, 4),
+        "steps_s": round(steps_s, 4),
+        "bubble_s": round(bubble_s, 4),
+        "host_bubble_frac": round(bubble_s / wall_s, 4) if wall_s else 0.0,
+        **{k: round(v, 4) for k, v in comp.items()},
+        "other_s": round(other_s, 4),
+        "n_blocks": n_blocks,
+        "pipelined": pipelined,
+    }
+
+
+def render_text(d: Dict[str, Any], label: str = "") -> str:
+    """Human-readable one-block summary of a decomposition."""
+    head = f"bubble decomposition{' (' + label + ')' if label else ''}:"
+    lines = [
+        head,
+        f"  wall            {d['wall_s']:9.3f} s",
+        f"  steps (device)  {d['steps_s']:9.3f} s",
+        f"  host bubble     {d['bubble_s']:9.3f} s"
+        f"  ({100 * d['host_bubble_frac']:.1f}% of wall)",
+    ]
+    for key, title in (
+        ("data_s", "data"), ("flush_s", "obs flush"), ("eval_s", "eval"),
+        ("checkpoint_s", "checkpoint"), ("other_s", "other"),
+    ):
+        lines.append(f"    {title:<13} {d[key]:9.3f} s")
+    lines.append(
+        f"  blocks={d['n_blocks']} pipelined={d['pipelined']}"
+    )
+    return "\n".join(lines)

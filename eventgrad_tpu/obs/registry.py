@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -29,9 +30,13 @@ from eventgrad_tpu.utils.metrics import JsonlLogger
 
 
 class Span(tuple):
-    """(name, cat, ts_us, dur_us, depth, args) — depth is the nesting
-    level at open time (0 = top-level), which Chrome trace infers from
-    timestamps but tests assert directly."""
+    """(name, cat, ts_us, dur_us, depth, args, tid) — depth is the
+    nesting level at open time (0 = top-level) on the RECORDING thread,
+    which Chrome trace infers from timestamps but tests assert directly;
+    tid is a small per-registry thread index (0 = the first recording
+    thread, i.e. the loop) so spans recorded from worker threads (the
+    async checkpoint writer) land on their own trace track instead of
+    fake-nesting under main-thread spans."""
 
     __slots__ = ()
     name = property(lambda s: s[0])
@@ -40,6 +45,7 @@ class Span(tuple):
     dur_us = property(lambda s: s[3])
     depth = property(lambda s: s[4])
     args = property(lambda s: s[5])
+    tid = property(lambda s: s[6] if len(s) > 6 else 0)
 
 
 def _prom_escape(v: str) -> str:
@@ -69,7 +75,13 @@ class Registry:
         self._logger = logger
         self._t0 = time.perf_counter()
         self._spans: List[Span] = []
-        self._open: List[Tuple[str, str, float, Dict[str, Any]]] = []
+        self._spans_lock = threading.Lock()
+        # per-thread open stack: spans are recorded from the loop AND from
+        # background workers (the async checkpoint writer) — depth is the
+        # nesting level within the RECORDING thread
+        self._tls = threading.local()
+        #: thread ident -> small stable tid (0 = first recording thread)
+        self._tids: Dict[int, int] = {}
         #: (name, labels-frozenset-or-None) -> (value, labels-dict)
         self._gauges: Dict[Tuple[str, Any], Tuple[float, Optional[Dict]]] = {}
         self.run_meta = dict(run_meta or {})
@@ -86,31 +98,46 @@ class Registry:
             self._logger.log(rec)
 
     # --- spans -----------------------------------------------------------
+    def _open_stack(self) -> List[Tuple[str, str, float, Dict[str, Any]]]:
+        stack = getattr(self._tls, "open", None)
+        if stack is None:
+            stack = self._tls.open = []
+        return stack
+
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "run", **args):
-        """Record one host span; nests (depth = open spans at entry)."""
-        depth = len(self._open)
+        """Record one host span; nests (depth = open spans at entry on the
+        recording thread). Thread-safe: worker threads (e.g. the async
+        checkpoint writer) record flat spans of their own."""
+        stack = self._open_stack()
+        depth = len(stack)
         t0 = time.perf_counter()
-        self._open.append((name, cat, t0, args))
+        stack.append((name, cat, t0, args))
         try:
             yield
         finally:
-            self._open.pop()
+            stack.pop()
             t1 = time.perf_counter()
-            self._spans.append(Span((
-                name, cat,
-                (t0 - self._t0) * 1e6, (t1 - t0) * 1e6,
-                depth, dict(args),
-            )))
+            ident = threading.get_ident()
+            with self._spans_lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+                self._spans.append(Span((
+                    name, cat,
+                    (t0 - self._t0) * 1e6, (t1 - t0) * 1e6,
+                    depth, dict(args), tid,
+                )))
 
     @property
     def spans(self) -> List[Span]:
-        return list(self._spans)
+        with self._spans_lock:
+            return list(self._spans)
 
     def chrome_trace(self) -> Dict[str, Any]:
         """Chrome Trace Event Format (complete "X" events) — loads in
         chrome://tracing and Perfetto. Spans sort by start time; nesting
-        is recovered by the viewer from containment on one tid."""
+        is recovered by the viewer from containment per tid (worker
+        threads — the async checkpoint writer — get their own track, so
+        their spans can overlap the loop's without fake nesting)."""
         events = [
             {
                 "name": s.name,
@@ -119,10 +146,10 @@ class Registry:
                 "ts": round(s.ts_us, 1),
                 "dur": round(s.dur_us, 1),
                 "pid": 0,
-                "tid": 0,
+                "tid": s.tid,
                 "args": {**s.args, "depth": s.depth},
             }
-            for s in sorted(self._spans, key=lambda s: (s.ts_us, -s.dur_us))
+            for s in sorted(self.spans, key=lambda s: (s.ts_us, -s.dur_us))
         ]
         other: Dict[str, Any] = {
             "obs_schema": OBS_SCHEMA_VERSION,

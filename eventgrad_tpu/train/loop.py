@@ -63,6 +63,15 @@ def rank0_slice(tree: Any) -> Any:
     return jax.tree.map(lambda x: x[0], tree)
 
 
+@jax.jit
+def _device_copy(tree: Any) -> Any:
+    """On-device copy into FRESH buffers (one dispatch). The pipelined
+    loop uses it to capture post-block state (telemetry counters) before
+    the next `run_epoch` dispatch donates the originals — an explicit
+    HLO copy, because a jitted identity may alias the input buffers."""
+    return jax.tree.map(jnp.copy, tree)
+
+
 def _loss_record(pass_base: int, s_i: int, r: int,
                  loss_all: np.ndarray) -> Dict[str, Any]:
     """Per-(pass, rank) loss record — the shared schema of the send trace's
@@ -137,30 +146,84 @@ def _write_trace(path: str, m: Dict[str, np.ndarray], pass_base: int,
                     )
 
 
+class DeviceEvaluator:
+    """Rank-0-style test pass (event.cpp:535-586) as ONE jitted device scan.
+
+    The legacy `evaluate` ran a host loop of per-batch forward dispatches
+    with numpy reductions — dozens of dispatch round-trips and a blocking
+    readback per batch, all sitting on the training loop's critical path
+    at block ends. Here the whole test set lives on device (uploaded
+    once) and the pass is a single `lax.scan` over batches returning two
+    scalars (correct count, summed NLL), so the loop can DISPATCH the
+    eval at a block end and read the two scalars back a block later (the
+    dispatch pipeline, docs/ARCHITECTURE.md "The dispatch pipeline").
+    `dispatch()` enqueues and returns futures; `result()` blocks and
+    renders the {"accuracy", "loss"} dict. Serial and pipelined callers
+    share this one implementation, so eval numbers are mode-independent.
+    """
+
+    def __init__(self, model, x, y, batch_size: int = 1000):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        # legacy truncation rule: whole batches only, unless the set is
+        # smaller than one batch (then a single short batch)
+        bs = batch_size if len(x) >= batch_size else len(x)
+        n = (len(x) // bs) * bs
+        s = n // bs
+        self._x = jnp.asarray(
+            np.ascontiguousarray(x[:n]).reshape((s, bs) + x.shape[1:])
+        )
+        self._y = jnp.asarray(
+            np.ascontiguousarray(y[:n]).reshape((s, bs) + y.shape[1:]),
+            dtype=jnp.int32,
+        )
+        # targets: batch elements, or batch x tokens for LM label grids
+        self._n_targets = int(
+            n * int(np.prod(y.shape[1:], dtype=np.int64) or 1)
+        )
+
+        def run(variables, xs, ys):
+            def body(carry, batch):
+                xb, yb = batch
+                out = model.apply(variables, xb, train=False)
+                if out.ndim == 3:  # LM logits [B, T, V]: score per token
+                    out = out.reshape(-1, out.shape[-1])
+                    yb = yb.reshape(-1)
+                logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, yb[:, None], axis=-1
+                ).sum()
+                correct = (out.argmax(-1) == yb).sum().astype(jnp.int32)
+                return (carry[0] + correct, carry[1] + nll), None
+
+            init = (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.float32))
+            (correct, nll), _ = jax.lax.scan(body, init, (xs, ys))
+            return correct, nll
+
+        self._run = jax.jit(run)
+
+    def dispatch(self, params, batch_stats):
+        """Enqueue the test pass; returns (correct, nll) device futures."""
+        variables = {"params": params}
+        if batch_stats is not None and jax.tree.leaves(batch_stats):
+            variables["batch_stats"] = batch_stats
+        return self._run(variables, self._x, self._y)
+
+    def result(self, fut) -> Dict[str, float]:
+        """Blocking readback of a `dispatch` future -> metrics dict."""
+        correct, nll = fut
+        return {
+            "accuracy": 100.0 * int(correct) / self._n_targets,
+            "loss": float(nll) / self._n_targets,
+        }
+
+
 def evaluate(model, params, batch_stats, x, y, batch_size: int = 1000) -> Dict[str, float]:
-    """Rank-0-style test pass (event.cpp:535-586) on a single device."""
-    variables = {"params": params}
-    if batch_stats is not None and jax.tree.leaves(batch_stats):
-        variables["batch_stats"] = batch_stats
-
-    @jax.jit
-    def fwd(xb):
-        return model.apply(variables, xb, train=False)
-
-    n = (len(x) // batch_size) * batch_size or len(x)
-    correct, total, loss_sum = 0, 0, 0.0
-    for i in range(0, n, batch_size):
-        xb = jnp.asarray(x[i : i + batch_size])
-        yb = np.asarray(y[i : i + batch_size])
-        out = np.asarray(fwd(xb))
-        if out.ndim == 3:  # LM logits [B, T, V]: score per token
-            out = out.reshape(-1, out.shape[-1])
-            yb = yb.reshape(-1)
-        logp = out - np.log(np.sum(np.exp(out - out.max(-1, keepdims=True)), -1, keepdims=True)) - out.max(-1, keepdims=True)
-        loss_sum += float(-logp[np.arange(len(yb)), yb].sum())
-        correct += int((out.argmax(-1) == yb).sum())
-        total += len(yb)
-    return {"accuracy": 100.0 * correct / total, "loss": loss_sum / total}
+    """One-shot test pass — builds a `DeviceEvaluator` and runs it
+    synchronously (callers that eval repeatedly should hold the
+    evaluator: the jit and the device-resident test set are reused)."""
+    ev = DeviceEvaluator(model, x, y, batch_size)
+    return ev.result(ev.dispatch(params, batch_stats))
 
 
 def train(
@@ -203,6 +266,7 @@ def train(
     obs: str = "off",
     registry: Optional[Any] = None,
     arena: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -291,6 +355,29 @@ def train(
     flush — exportable as Chrome-trace/Perfetto JSON
     (Registry.write_chrome_trace). The loop never closes the registry;
     the caller owns its lifecycle (cli.py wires --obs-dir).
+
+    pipeline (None = auto) software-pipelines the block loop: block B+1's
+    scan is dispatched IMMEDIATELY after block B's, and block B's host
+    work — telemetry flush, history records, eval readback, checkpoint
+    serialization — runs while the device computes B+1, instead of the
+    serial block_until_ready -> flush -> eval -> checkpoint chain. The
+    eval is dispatched on-device at block end (DeviceEvaluator) with its
+    two-scalar readback deferred one block; checkpoints snapshot
+    device->host eagerly and serialize on a background writer thread
+    (utils/checkpoint.AsyncWriter, join barrier before the next save and
+    on exit). Training state and history metrics are BITWISE-identical
+    with the pipeline on or off (tests/test_dispatch_pipeline.py) — the
+    dispatch order of the training scans is unchanged; only the host
+    schedule moves. wall_s stays meaningful: it measures dispatch (or
+    previous-block readiness) to this block's observed readiness, i.e.
+    back-to-back device time when the pipe is full. Auto enables it for
+    single-process runs without fault_inject (a fault must land at an
+    exact post-snapshot epoch boundary, which requires the serial
+    schedule; multi-process keeps serial collective/checkpoint
+    ordering); explicit True raises on those. During a compact-wire
+    run's dense autotune phase the loop drains eagerly (the capacity
+    decision gates the next dispatch) and pipelining starts once the
+    capacity is fixed. See docs/ARCHITECTURE.md "The dispatch pipeline".
 
     epochs_per_dispatch=K fuses K consecutive epochs into ONE jit dispatch
     (the scan simply runs K*steps steps), amortizing the per-dispatch host
@@ -442,6 +529,25 @@ def train(
         )
 
     multi = multihost.is_multiprocess()
+    # --- dispatch-pipeline resolution (docs/ARCHITECTURE.md): auto = on
+    # wherever the serialized host chain is the only thing it removes
+    if pipeline is None:
+        pipeline_on = not multi and fault_mode is None
+    else:
+        pipeline_on = bool(pipeline)
+        if pipeline_on and multi:
+            raise ValueError(
+                "pipeline=True needs the single-process path — multi-"
+                "process runs keep the serial schedule (collective and "
+                "checkpoint ordering is cross-process); use pipeline="
+                "None/False"
+            )
+        if pipeline_on and fault_mode is not None:
+            raise ValueError(
+                "pipeline=True cannot honor fault_inject (the fault must "
+                "land at an exact post-snapshot epoch boundary, which "
+                "needs the serial schedule); use pipeline=None/False"
+            )
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
     # shape metadata only — never dispatch a device op just to count
     n_params = trees.tree_count_params(state.params) // topo.n_ranks
@@ -621,10 +727,13 @@ def train(
     if not device_data and K > 1:
         # host path: a K-epoch block materializes K stacked epoch copies
         # in host RAM + HBM at once (no resident-dataset dedup) — cap the
-        # block bytes rather than multiply peak memory by K
+        # block bytes rather than multiply peak memory by K. The block
+        # prefetcher DOUBLE-buffers (block B consumed while B+1 is
+        # speculatively assembled, and on the plain path device_put too),
+        # so two blocks are resident at the peak: the cap covers both.
         K = max(1, min(K, int(os.environ.get(
             "EG_HOST_BLOCK_MAX_BYTES", str(1_500_000_000)
-        )) // max(1, data_bytes)))
+        )) // max(1, 2 * data_bytes)))
     if save_every and K > 1:
         # blocks split at save points: keep K a divisor of save_every so
         # block sizes REPEAT across save segments — otherwise every block
@@ -679,9 +788,18 @@ def train(
             len(x_train), n_data, batch_size
         ).shape[1]
     else:
+        # plain single-process path: the prefetcher worker also runs the
+        # device_put, so block B+1's stacked arrays land on device while
+        # block B computes (hybrid/mesh/multihost batches need host-side
+        # expand/placement first and keep the numpy hand-off)
+        transfer = (
+            jnp.asarray if (mesh is None and not hybrid and not multi)
+            else None
+        )
         prefetcher = EpochPrefetcher(
             x_train, y_train, n_data, batch_size,
             random=random_sampler, seed=seed, last_epoch=epochs,
+            transfer=transfer,
         )
         steps_per_epoch = prefetcher.steps
 
@@ -715,10 +833,260 @@ def train(
     # diff base) and the one-time run metadata rider
     obs_prev = None
     obs_meta_pending = obs_on
+    eval_on = (
+        x_test is not None and log_every_epoch and not multi and not hybrid
+    )
+    # multi-process callers evaluate once at the end on allgathered params
+    # (multihost.to_host); hybrid meshes skip consensus eval — averaging
+    # across sp/tp/pp/ep ranks would mix differently-sharded parameters.
+    # One evaluator per run: the jitted scan and the device-resident test
+    # set are reused at every block end.
+    evaluator = DeviceEvaluator(model, x_test, y_test) if eval_on else None
+    probe_on = (chaos_sched is not None or obs_on) and not multi and not hybrid
+    ckpt_writer = (
+        checkpoint.AsyncWriter() if (ckpt_path and pipeline_on) else None
+    )
+    blocks = list(_blocks())
+    # observed-readiness clock for wall_s: dt of a block runs from its
+    # dispatch (or the previous block's observed readiness, whichever is
+    # later) to its own observed readiness — under the full pipe that is
+    # back-to-back device time, and with the pipe empty (serial mode) it
+    # reduces to the old dispatch-to-block_until_ready measurement
+    last_ready_t = float("-inf")
+
+    def _drain(hw: Dict[str, Any]) -> None:
+        """Run one block's host work: metrics readback, telemetry flush,
+        history records + trace stream, eval readback, compact autotune.
+        Serial mode calls this right after the dispatch; pipelined mode
+        one block late, while the device computes the NEXT block. All
+        device values it touches were dispatched before the next block
+        donated the state, so the results are bitwise mode-independent.
+        """
+        nonlocal obs_prev, obs_meta_pending, last_ready_t
+        nonlocal compact_capacity, compact_done, compact_note
+        nonlocal compact_fired_peak, compact_post_steps
+        nonlocal run_epoch, run_epoch_idx
+        blk_i, blk_start, blk_end = hw["blk_i"], hw["blk_start"], hw["blk_end"]
+        n_e = blk_end - blk_start + 1
+        mode_now, cold, label_shape = hw["mode"], hw["cold"], hw["label_shape"]
+        with _span("block_ready", cat="device", block=blk_i):
+            jax.block_until_ready(hw["m"])
+        # stamp readiness BEFORE the metrics D2H copy: wall_s measures
+        # device compute, and the copy (large with --trace-file's
+        # per-leaf vectors) is host work like the rest of the drain
+        t_ready = time.perf_counter()
+        dt = t_ready - max(last_ready_t, hw["t_dispatched"])
+        last_ready_t = t_ready
+        m = multihost.to_host(hw["m"])
+
+        # telemetry flush: ONE device->host read of the cumulative
+        # counters per dispatch block, diffed against the previous
+        # snapshot on the host (no device-side reset write)
+        obs_rec = None
+        if obs_on:
+            with _span("obs_flush", cat="obs", block=blk_i):
+                tel_host = jax.tree.map(
+                    np.asarray, multihost.to_host(hw["tel"])
+                )
+                obs_rec = obs_device.window_record(tel_host, obs_prev)
+                obs_prev = tel_host
+            if obs_meta_pending:
+                obs_rec["meta"] = {
+                    "leaves": [
+                        "/".join(
+                            str(getattr(p, "key", p)) for p in kp
+                        )
+                        for kp, _ in
+                        jax.tree_util.tree_flatten_with_path(
+                            hw["state"].params
+                        )[0]
+                    ],
+                    "edges": [nb.name for nb in topo.neighbors],
+                    "silence_buckets": int(
+                        np.asarray(tel_host.silence_hist).shape[-1]
+                    ),
+                    "n_ranks": topo.n_ranks,
+                    "n_neighbors": topo.n_neighbors,
+                    "wire": wire or ("bf16" if wire_bf16 else None),
+                }
+                obs_meta_pending = False
+
+        # block metrics are [n_e * steps, n_ranks]; split per epoch
+        steps = steps_per_epoch
+        for j, epoch in enumerate(range(blk_start, blk_end + 1)):
+            sl = slice(j * steps, (j + 1) * steps)
+            m_e = {k: np.asarray(v)[sl] for k, v in m.items()}
+            total_passes = start_passes + (epoch - start_epoch) * steps
+            rec = {
+                "epoch": epoch,
+                "algo": algo,
+                "steps": steps,
+                # 0-based jit-dispatch block index; dispatch_cold marks
+                # records from a block that paid a compile (first block
+                # of its size) — steady-state step math drops those
+                # (utils.metrics.steady_records)
+                "dispatch_block": blk_i,
+                "dispatch_cold": cold,
+                "wall_s": dt / n_e,
+                "loss": float(m_e["loss"].mean()),
+                # targets per step per rank: batch for classification,
+                # batch x t_local for LM (correct counts tokens
+                # elementwise)
+                "train_acc": 100.0 * float(m_e["correct"].sum())
+                / (topo.n_ranks * steps * int(np.prod(label_shape) or 1)),
+                "sent_bytes_per_step_per_chip": float(
+                    m_e["sent_bytes"][..., 0].mean()
+                ),
+                # the SPMD wire truth next to the accounting model:
+                # bytes the collective actually moved (docs/compaction.md)
+                "sent_bytes_wire_real_per_step_per_chip": float(
+                    m_e["sent_bytes_wire_real"][..., 0].mean()
+                ),
+                "n_params": n_params,
+                "arena": bool(arena_on),
+            }
+            if gossip_wire == "compact":
+                rec["gossip_wire"] = mode_now
+                if compact_capacity is not None:
+                    rec["compact_capacity"] = int(compact_capacity)
+                if compact_note is not None:
+                    rec.update(compact_note)
+                    compact_note = None
+            if algo in ("eventgrad", "sp_eventgrad"):
+                rec["num_deferred"] = int(m_e["num_deferred"][-1].sum())
+                # msgs-saved vs D-PSGD: events/(n_neighbors * passes *
+                # sz) fired
+                events_total = int(m_e["num_events"][-1].sum())
+                rec["num_events"] = events_total
+                rec["msgs_saved_pct"] = msgs_saved_pct(
+                    events_total, total_passes, sz, topo.n_neighbors,
+                    topo.n_ranks,
+                )
+                rec["fired_frac"] = float(m_e["fired_frac"].mean())
+            if chaos_sched is not None:
+                if not history:  # replayability: schedule rides record 1
+                    rec["chaos"] = chaos_sched.to_dict()
+                    if chaos_policy is not None:
+                        rec["chaos_policy"] = chaos_policy.to_dict()
+                # silence/drops are carried state: the epoch's last
+                # step is its end-of-epoch snapshot
+                rec.update(chaos_monitor.health_record(
+                    np.asarray(m_e["edge_silence"])[-1],
+                    np.asarray(m_e["chaos_drops"])[-1],
+                    event_cfg.max_silence if event_cfg else 0,
+                ))
+            if trace_file and "trace_fired" in m_e and multihost.is_primary():
+                _write_trace(
+                    trace_file, m_e, total_passes - steps, topo,
+                    hw["state"], trace_carry,
+                )
+            elif trace_file and multihost.is_primary():
+                # non-event algos: per-step per-rank loss records — the
+                # (epoch, loss) stream cent/decent call values{r}.txt
+                # (cent.cpp:124, decent.cpp:166)
+                loss_all = np.asarray(m_e["loss"])
+                with open(trace_file, "a") as tf:
+                    for s_i in range(steps):
+                        for r in range(topo.n_ranks):
+                            tf.write(json.dumps(_loss_record(
+                                total_passes - steps, s_i, r, loss_all
+                            )) + "\n")
+            is_block_end = epoch == blk_end
+            if is_block_end and obs_rec is not None:
+                rec["obs"] = obs_rec
+            if is_block_end and hw["probe"] is not None:
+                # periodic consensus-error probe ||p_i - mean(p)||:
+                # the ground-truth drift metric that tells "quiet
+                # because the threshold says so" from "quiet because
+                # the link is dead" (chaos/monitor.py) — chaos and
+                # telemetry runs both log it at block ends. Dispatched
+                # at block end; this is just the readback.
+                cerr = np.asarray(hw["probe"])
+                rec["consensus_err_max"] = float(cerr.max())
+                rec["consensus_err_mean"] = float(cerr.mean())
+            if is_block_end and hw["eval_fut"] is not None:
+                # the jitted device eval was dispatched at the block end
+                # (before the next block donated the state); only the
+                # two-scalar readback lands here — one block late under
+                # the pipeline, same record either way
+                with _span("eval_readback", cat="host", epoch=epoch):
+                    rec.update(
+                        {
+                            "test_" + k: v
+                            for k, v in evaluator.result(
+                                hw["eval_fut"]
+                            ).items()
+                        }
+                    )
+            history.append(rec)
+            if on_epoch is not None:  # live metrics (liveness signal)
+                on_epoch(rec)
+        if not compact_done:
+            # collect post-warmup fired sizes from this block; once
+            # enough are in (or warmup is past, with an explicit
+            # compact_frac), size the buffer and switch — exactly once
+            # [n_e*steps, n_ranks]: the capacity is one static number
+            # shared by every rank, so the peak is taken across ranks
+            fe = np.asarray(m["fired_elems"])
+            blk_pass_base = (
+                start_passes + (blk_start - 1 - start_epoch) * steps
+            )
+            pnums = blk_pass_base + 1 + np.arange(fe.shape[0])
+            # warm is pass_num < warmup_passes (events.propose), so
+            # pass == warmup_passes is already real trigger data
+            post = fe[pnums >= warmup_passes]
+            if post.size:
+                compact_fired_peak = max(
+                    compact_fired_peak, float(post.max())
+                )
+                compact_post_steps += int(post.shape[0])
+            enough = (
+                compact_post_steps >= compact_min_samples
+                if compact_frac is None
+                else bool(pnums.size and pnums[-1] >= warmup_passes)
+            )
+            if enough:
+                # per-rank leaf sizes (leading axis is the rank stack);
+                # the floor rule lives with the collective
+                floor = collectives.compact_capacity_floor(
+                    int(np.prod(l.shape[1:], dtype=np.int64)) or 1
+                    for l in jax.tree.leaves(hw["state"].params)
+                )
+                if compact_frac is not None:
+                    cap = min(n_params, max(
+                        floor, int(np.ceil(compact_frac * n_params))
+                    ))
+                    autotuned = False
+                else:
+                    cap = collectives.choose_capacity(
+                        n_params, compact_fired_peak, floor
+                    )
+                    autotuned = True
+                compact_note = {"compact_autotuned": autotuned}
+                if autotuned:
+                    compact_note["compact_fired_peak_elems"] = (
+                        compact_fired_peak
+                    )
+                if autotuned and cap >= n_params:
+                    # fire rate ~1: the budget would be the whole
+                    # model — nothing to compact; stay dense, loudly
+                    compact_note["compact_skipped"] = (
+                        "observed fire rate needs capacity >= n_params"
+                    )
+                else:
+                    compact_capacity = cap
+                    run_epoch, run_epoch_idx = _build_runners(
+                        spmd(_build_step("compact", cap), topo, mesh=mesh)
+                    )
+                compact_done = True
+
     _root_span = contextlib.ExitStack()
+    pending: Optional[Dict[str, Any]] = None
     try:
-        _root_span.enter_context(_span("train", cat="run", algo=algo))
-        for blk_i, (blk_start, blk_end) in enumerate(_blocks()):
+        _root_span.enter_context(
+            _span("train", cat="run", algo=algo, pipelined=pipeline_on)
+        )
+        for blk_i, (blk_start, blk_end) in enumerate(blocks):
             n_e = blk_end - blk_start + 1
             # first block of each distinct (size, wire-mode) pays a jit
             # trace+compile (scan length is part of the shape, and the
@@ -729,10 +1097,7 @@ def train(
             cold = (n_e, mode_now) not in seen_block_sizes
             seen_block_sizes.add((n_e, mode_now))
             label_shape: Tuple[int, ...] = ()
-            with _span(
-                "dispatch_block", cat="device",
-                block=blk_i, epochs=n_e, cold=cold, wire=mode_now,
-            ):
+            with _span("data", cat="host", block=blk_i):
                 if device_data:
                     idx_np = np.concatenate(
                         [
@@ -747,275 +1112,132 @@ def train(
                     # per-(step, rank) target count: batch plus any
                     # trailing label dims (LM token axes)
                     label_shape = (batch_size,) + tuple(y_dev.shape[1:])
-                    t0 = time.perf_counter()
-                    state, m = run_epoch_idx(
-                        state, x_dev, y_dev, jnp.asarray(idx_np)
-                    )
+                    idx_dev = jnp.asarray(idx_np)
                 else:
-                    parts = [
-                        prefetcher.get(e)
-                        for e in range(blk_start, blk_end + 1)
-                    ]
-                    xb = (
-                        np.concatenate([p[0] for p in parts], axis=1)
-                        if n_e > 1 else parts[0][0]
+                    nxt = (
+                        blocks[blk_i + 1] if blk_i + 1 < len(blocks)
+                        else None
                     )
-                    yb = (
-                        np.concatenate([p[1] for p in parts], axis=1)
-                        if n_e > 1 else parts[0][1]
+                    xb, yb = prefetcher.get_block(
+                        blk_start, blk_end, next_span=nxt
                     )
-                    del parts
                     if hybrid:
                         xb, yb = expand_to_mesh(xb, yb, topo)
                     if mesh is not None:  # global placement (spans hosts)
                         xb = multihost.put_stacked(xb, mesh, topo)
                         yb = multihost.put_stacked(yb, mesh, topo)
-                    else:
+                    elif not isinstance(xb, jax.Array):
+                        # prefetcher.transfer already uploaded the common
+                        # path; this is the fallback (e.g. transfer=None)
                         xb, yb = jnp.asarray(xb), jnp.asarray(yb)
                     label_shape = tuple(yb.shape[2:])
-                    t0 = time.perf_counter()
-                    state, m = run_epoch(state, xb, yb)
-                jax.block_until_ready(state.params)
-                dt = time.perf_counter() - t0
-
-            # telemetry flush: ONE device->host read of the cumulative
-            # counters per dispatch block, diffed against the previous
-            # snapshot on the host (no device-side reset write)
-            obs_rec = None
-            if obs_on:
-                with _span("obs_flush", cat="obs", block=blk_i):
-                    tel_host = jax.tree.map(
-                        np.asarray, multihost.to_host(state.telemetry)
-                    )
-                    obs_rec = obs_device.window_record(tel_host, obs_prev)
-                    obs_prev = tel_host
-                if obs_meta_pending:
-                    obs_rec["meta"] = {
-                        "leaves": [
-                            "/".join(
-                                str(getattr(p, "key", p)) for p in kp
-                            )
-                            for kp, _ in
-                            jax.tree_util.tree_flatten_with_path(
-                                state.params
-                            )[0]
-                        ],
-                        "edges": [nb.name for nb in topo.neighbors],
-                        "silence_buckets": int(
-                            np.asarray(tel_host.silence_hist).shape[-1]
-                        ),
-                        "n_ranks": topo.n_ranks,
-                        "n_neighbors": topo.n_neighbors,
-                        "wire": wire or ("bf16" if wire_bf16 else None),
-                    }
-                    obs_meta_pending = False
-
-            # block metrics are [n_e * steps, n_ranks]; split per epoch
-            m = multihost.to_host(m)
-            steps = steps_per_epoch
-            for j, epoch in enumerate(range(blk_start, blk_end + 1)):
-                sl = slice(j * steps, (j + 1) * steps)
-                m_e = {k: np.asarray(v)[sl] for k, v in m.items()}
-                total_passes = start_passes + (epoch - start_epoch) * steps
-                rec = {
-                    "epoch": epoch,
-                    "algo": algo,
-                    "steps": steps,
-                    # 0-based jit-dispatch block index; dispatch_cold marks
-                    # records from a block that paid a compile (first block
-                    # of its size) — steady-state step math drops those
-                    # (utils.metrics.steady_records)
-                    "dispatch_block": blk_i,
-                    "dispatch_cold": cold,
-                    "wall_s": dt / n_e,
-                    "loss": float(m_e["loss"].mean()),
-                    # targets per step per rank: batch for classification,
-                    # batch x t_local for LM (correct counts tokens
-                    # elementwise)
-                    "train_acc": 100.0 * float(m_e["correct"].sum())
-                    / (topo.n_ranks * steps * int(np.prod(label_shape) or 1)),
-                    "sent_bytes_per_step_per_chip": float(
-                        m_e["sent_bytes"][..., 0].mean()
-                    ),
-                    # the SPMD wire truth next to the accounting model:
-                    # bytes the collective actually moved (docs/compaction.md)
-                    "sent_bytes_wire_real_per_step_per_chip": float(
-                        m_e["sent_bytes_wire_real"][..., 0].mean()
-                    ),
-                    "n_params": n_params,
-                    "arena": bool(arena_on),
-                }
-                if gossip_wire == "compact":
-                    rec["gossip_wire"] = mode_now
-                    if compact_capacity is not None:
-                        rec["compact_capacity"] = int(compact_capacity)
-                    if compact_note is not None:
-                        rec.update(compact_note)
-                        compact_note = None
-                if algo in ("eventgrad", "sp_eventgrad"):
-                    rec["num_deferred"] = int(m_e["num_deferred"][-1].sum())
-                    # msgs-saved vs D-PSGD: events/(n_neighbors * passes *
-                    # sz) fired
-                    events_total = int(m_e["num_events"][-1].sum())
-                    rec["num_events"] = events_total
-                    rec["msgs_saved_pct"] = msgs_saved_pct(
-                        events_total, total_passes, sz, topo.n_neighbors,
-                        topo.n_ranks,
-                    )
-                    rec["fired_frac"] = float(m_e["fired_frac"].mean())
-                if chaos_sched is not None:
-                    if not history:  # replayability: schedule rides record 1
-                        rec["chaos"] = chaos_sched.to_dict()
-                        if chaos_policy is not None:
-                            rec["chaos_policy"] = chaos_policy.to_dict()
-                    # silence/drops are carried state: the epoch's last
-                    # step is its end-of-epoch snapshot
-                    rec.update(chaos_monitor.health_record(
-                        np.asarray(m_e["edge_silence"])[-1],
-                        np.asarray(m_e["chaos_drops"])[-1],
-                        event_cfg.max_silence if event_cfg else 0,
-                    ))
-                if trace_file and "trace_fired" in m_e and multihost.is_primary():
-                    _write_trace(
-                        trace_file, m_e, total_passes - steps, topo, state,
-                        trace_carry,
-                    )
-                elif trace_file and multihost.is_primary():
-                    # non-event algos: per-step per-rank loss records — the
-                    # (epoch, loss) stream cent/decent call values{r}.txt
-                    # (cent.cpp:124, decent.cpp:166)
-                    loss_all = np.asarray(m_e["loss"])
-                    with open(trace_file, "a") as tf:
-                        for s_i in range(steps):
-                            for r in range(topo.n_ranks):
-                                tf.write(json.dumps(_loss_record(
-                                    total_passes - steps, s_i, r, loss_all
-                                )) + "\n")
-                is_block_end = epoch == blk_end
-                if is_block_end and obs_rec is not None:
-                    rec["obs"] = obs_rec
-                if (
-                    (chaos_sched is not None or obs_on) and is_block_end
-                    and not multi and not hybrid
-                ):
-                    # periodic consensus-error probe ||p_i - mean(p)||:
-                    # the ground-truth drift metric that tells "quiet
-                    # because the threshold says so" from "quiet because
-                    # the link is dead" (chaos/monitor.py) — chaos and
-                    # telemetry runs both log it at block ends
-                    cerr = np.asarray(
-                        chaos_monitor.consensus_error(state.params)
-                    )
-                    rec["consensus_err_max"] = float(cerr.max())
-                    rec["consensus_err_mean"] = float(cerr.mean())
-                if (
-                    x_test is not None and log_every_epoch and not multi
-                    and not hybrid and is_block_end
-                ):
-                    # multi-process callers evaluate once at the end on
-                    # allgathered params (multihost.to_host); hybrid meshes
-                    # skip consensus eval — averaging across sp/tp/pp/ep
-                    # ranks would mix differently-sharded parameters.
-                    # K-epoch blocks evaluate at block ends (every-K
-                    # cadence) — the final epoch is always a block end.
-                    with _span("eval", cat="host", epoch=epoch):
-                        cons = consensus_params(state.params)
-                        stats0 = rank0_slice(state.batch_stats)
-                        rec.update(
-                            {
-                                "test_" + k: v
-                                for k, v in evaluate(
-                                    model, cons, stats0, x_test, y_test
-                                ).items()
-                            }
-                        )
-                history.append(rec)
-                if on_epoch is not None:  # live metrics (liveness signal)
-                    on_epoch(rec)
-            epoch = blk_end
-            if not compact_done:
-                # collect post-warmup fired sizes from this block; once
-                # enough are in (or warmup is past, with an explicit
-                # compact_frac), size the buffer and switch — exactly once
-                # [n_e*steps, n_ranks]: the capacity is one static number
-                # shared by every rank, so the peak is taken across ranks
-                fe = np.asarray(m["fired_elems"])
-                blk_pass_base = (
-                    start_passes + (blk_start - 1 - start_epoch) * steps
-                )
-                pnums = blk_pass_base + 1 + np.arange(fe.shape[0])
-                # warm is pass_num < warmup_passes (events.propose), so
-                # pass == warmup_passes is already real trigger data
-                post = fe[pnums >= warmup_passes]
-                if post.size:
-                    compact_fired_peak = max(
-                        compact_fired_peak, float(post.max())
-                    )
-                    compact_post_steps += int(post.shape[0])
-                enough = (
-                    compact_post_steps >= compact_min_samples
-                    if compact_frac is None
-                    else bool(pnums.size and pnums[-1] >= warmup_passes)
-                )
-                if enough:
-                    # per-rank leaf sizes (leading axis is the rank stack);
-                    # the floor rule lives with the collective
-                    floor = collectives.compact_capacity_floor(
-                        int(np.prod(l.shape[1:], dtype=np.int64)) or 1
-                        for l in jax.tree.leaves(state.params)
-                    )
-                    if compact_frac is not None:
-                        cap = min(n_params, max(
-                            floor, int(np.ceil(compact_frac * n_params))
-                        ))
-                        autotuned = False
-                    else:
-                        cap = collectives.choose_capacity(
-                            n_params, compact_fired_peak, floor
-                        )
-                        autotuned = True
-                    compact_note = {"compact_autotuned": autotuned}
-                    if autotuned:
-                        compact_note["compact_fired_peak_elems"] = (
-                            compact_fired_peak
-                        )
-                    if autotuned and cap >= n_params:
-                        # fire rate ~1: the budget would be the whole
-                        # model — nothing to compact; stay dense, loudly
-                        compact_note["compact_skipped"] = (
-                            "observed fire rate needs capacity >= n_params"
-                        )
-                    else:
-                        compact_capacity = cap
-                        run_epoch, run_epoch_idx = _build_runners(
-                            spmd(_build_step("compact", cap), topo, mesh=mesh)
-                        )
-                    compact_done = True
-            if ckpt_path and (
-                epoch == epochs or (save_every and epoch % save_every == 0)
+            t0 = time.perf_counter()
+            with _span(
+                "dispatch_block", cat="device",
+                block=blk_i, epochs=n_e, cold=cold, wire=mode_now,
+                pipelined=pipeline_on,
             ):
-                # multi-process: allgather the global-mesh state to host;
-                # checkpoint.save coordinates the one-writer snapshot
-                # (checkpoint_dir must be visible to all processes)
-                with _span("checkpoint", cat="host", epoch=epoch):
-                    save_state = (
-                        multihost.to_host(state) if multi else state
+                if device_data:
+                    state, m = run_epoch_idx(state, x_dev, y_dev, idx_dev)
+                else:
+                    state, m = run_epoch(state, xb, yb)
+                if not pipeline_on:
+                    jax.block_until_ready(state.params)
+            # post-block device enqueues: every read of the NEW state is
+            # dispatched HERE, before the next iteration's run_epoch
+            # donates its buffers — in-order device execution sequences
+            # them after this block and before the next
+            tel_fut = None
+            if obs_on:
+                tel_fut = (
+                    _device_copy(state.telemetry) if pipeline_on
+                    else state.telemetry
+                )
+            probe_fut = (
+                chaos_monitor.consensus_error(state.params) if probe_on
+                else None
+            )
+            eval_fut = None
+            if evaluator is not None:
+                # K-epoch blocks evaluate at block ends (every-K cadence)
+                # — the final epoch is always a block end
+                with _span("eval", cat="device", epoch=blk_end):
+                    eval_fut = evaluator.dispatch(
+                        consensus_params(state.params),
+                        rank0_slice(state.batch_stats),
                     )
-                    checkpoint.save(
-                        ckpt_path,
-                        {
-                            "state": save_state,
-                            "epoch": np.int64(epoch),
+            hw = {
+                "blk_i": blk_i, "blk_start": blk_start, "blk_end": blk_end,
+                "m": m, "tel": tel_fut, "probe": probe_fut,
+                "eval_fut": eval_fut, "label_shape": label_shape,
+                "mode": mode_now, "cold": cold, "state": state,
+                "t_dispatched": t0,
+            }
+            if pending is not None:  # previous block's deferred host work
+                _drain(pending)
+                pending = None
+            ckpt_due = bool(ckpt_path and (
+                blk_end == epochs
+                or (save_every and blk_end % save_every == 0)
+            ))
+            if not pipeline_on or ckpt_due or not compact_done:
+                # serialized drain: serial mode by definition; a due
+                # checkpoint must snapshot the post-host-work trace
+                # carry; a compact autotune decision gates what the next
+                # block dispatches
+                _drain(hw)
+            else:
+                pending = hw
+            if ckpt_due:
+                if pipeline_on:
+                    # eager device->host snapshot (owned copies — later
+                    # trace writes keep mutating the live carry), then
+                    # serialization + atomic swap on the writer thread
+                    # overlapping the next block's compute; save() joins
+                    # any in-flight write first
+                    with _span("ckpt_snapshot", cat="host", epoch=blk_end):
+                        snap = checkpoint.host_snapshot({
+                            "state": state,
+                            "epoch": np.int64(blk_end),
                             "trace_carry": trace_carry,
-                        },
+                        })
+                    ckpt_writer.save(
+                        ckpt_path, snap,
+                        span=lambda _e=blk_end: _span(
+                            "ckpt_write", cat="host", epoch=_e
+                        ),
                     )
-            if epoch == fault_epoch:
+                else:
+                    # multi-process: allgather the global-mesh state to
+                    # host; checkpoint.save coordinates the one-writer
+                    # snapshot (checkpoint_dir visible to all processes)
+                    with _span("checkpoint", cat="host", epoch=blk_end):
+                        save_state = (
+                            multihost.to_host(state) if multi else state
+                        )
+                        checkpoint.save(
+                            ckpt_path,
+                            {
+                                "state": save_state,
+                                "epoch": np.int64(blk_end),
+                                "trace_carry": trace_carry,
+                            },
+                        )
+            if blk_end == fault_epoch:  # pipeline off under fault_inject
                 if fault_mode == "crash":
                     os._exit(13)
                 while True:  # "hang": alive but no progress (no heartbeat)
                     time.sleep(3600)
+        if pending is not None:
+            _drain(pending)
+            pending = None
+        if ckpt_writer is not None:
+            ckpt_writer.wait()  # on-exit join barrier; re-raises errors
     finally:
         _root_span.close()
+        if ckpt_writer is not None:
+            # unwind path: join without masking the primary exception
+            ckpt_writer.close(raise_errors=False)
         if prefetcher is not None:
             prefetcher.close()
 

@@ -9,7 +9,8 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Any, Optional
+import threading
+from typing import Any, Callable, ContextManager, Optional
 
 import jax
 import numpy as np
@@ -44,6 +45,92 @@ def save(path: str, state: Any) -> None:
         if os.path.exists(prev):
             shutil.rmtree(prev)
     multihost.barrier("eg-ckpt-promote")
+
+
+def host_snapshot(tree: Any) -> Any:
+    """Blocking device->host COPY of a pytree — the eager half of an async
+    save. Every leaf becomes an owned numpy array (np.array copies even
+    host-resident leaves), so the caller may keep mutating the originals
+    (trace carries, counters) while `AsyncWriter` serializes the frozen
+    snapshot on its thread."""
+    return jax.tree.map(lambda x: np.array(x), tree)
+
+
+class AsyncWriter:
+    """One background writer thread for checkpoint serialization.
+
+    The dispatch pipeline (train/loop.py, docs/ARCHITECTURE.md "The
+    dispatch pipeline") snapshots device state to host eagerly
+    (`host_snapshot`) and hands the frozen copy here; `save()` runs
+    `checkpoint.save`'s write-tmp/atomic-swap on the thread, so the
+    orbax serialization overlaps the next dispatch block's compute.
+    Crash safety is unchanged: the swap in `save` is the same atomic
+    promote, so a kill mid-serialization still leaves `<path>` or
+    `<path>.prev` complete for `latest()`.
+
+    Join barriers: `save()` joins any in-flight write first (two writers
+    must never race the tmp/prev swap), and `wait()`/`close()` join on
+    exit. A failed background save re-raises at the next barrier —
+    never silently."""
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+
+    def save(
+        self,
+        path: str,
+        payload: Any,
+        span: Optional[Callable[[], ContextManager]] = None,
+    ) -> None:
+        """Serialize `payload` (host numpy — see `host_snapshot`) to
+        `path` on the writer thread; joins the previous save first.
+        `span` (zero-arg context-manager factory) wraps the write for
+        observability (obs.Registry spans are thread-safe)."""
+        self.wait()
+
+        def work() -> None:
+            try:
+                import contextlib
+
+                with (span() if span is not None else contextlib.nullcontext()):
+                    save(path, payload)
+            except BaseException as e:  # re-raised at the next barrier
+                self._exc = e
+
+        self._thread = threading.Thread(
+            target=work, daemon=True, name="eg-ckpt-writer"
+        )
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight save (if any) and re-raise its error."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError("async checkpoint save failed") from exc
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Exit barrier. `raise_errors=False` is for exception-unwind
+        paths: join without masking the primary exception — but a
+        discarded save failure is still LOGGED (the snapshot on disk is
+        the stale previous one; a resume would replay extra epochs)."""
+        if raise_errors:
+            self.wait()
+            return
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._exc is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "async checkpoint save failed during unwind (snapshot on "
+                "disk is the previous one): %r", self._exc,
+            )
+        self._exc = None
 
 
 def latest(path: str) -> Optional[str]:

@@ -25,6 +25,9 @@ def test_repo_artifacts_all_valid():
     assert any(n.startswith("BENCH_r") for n in names)
     assert any(n.startswith("MULTICHIP_r") for n in names)
     assert "obs_report_cpu.json" in names
+    # the dispatch-pipeline proof must be committed AND schema-gated
+    # (pipelined-vs-serial bubble ratio < 1.0, bitwise_state true)
+    assert "pipeline_bubble_cpu.json" in names
     assert out["errors"] == []
 
 

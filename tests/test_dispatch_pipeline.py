@@ -1,0 +1,209 @@
+"""Zero-bubble dispatch pipeline: pipeline on == pipeline off, bitwise.
+
+The pipelined schedule (train/loop.py) only moves HOST work — the
+training scans dispatch in the same order with the same inputs, the
+eval is the same jitted device function, the checkpoint snapshot is the
+same bytes. So final state AND history metrics must be bit-identical
+with the pipeline on or off, across algorithms, telemetry modes, and a
+checkpoint/resume that lands mid-run; and a crash during the async save
+must leave a restorable snapshot (the atomic-swap invariant).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.obs import bubble
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.utils import checkpoint
+
+#: host-timing fields — the only history keys allowed to differ by mode
+_TIMING_KEYS = {"wall_s"}
+
+
+def _run(algo="eventgrad", obs="off", pipeline=None, ck=None, resume=False,
+         epochs=6, mesh=None, **kw):
+    x, y = synthetic_dataset(256, (8, 8, 1), seed=3)
+    xt, yt = synthetic_dataset(64, (8, 8, 1), seed=3, split="test")
+    cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=3)
+    return train(
+        MLP(hidden=16), Ring(4), x, y,
+        algo=algo, epochs=epochs, batch_size=8, learning_rate=0.05,
+        event_cfg=cfg if algo != "dpsgd" else None,
+        random_sampler=True, seed=5, x_test=xt, y_test=yt,
+        epochs_per_dispatch=2, obs=obs, pipeline=pipeline, mesh=mesh,
+        checkpoint_dir=str(ck) if ck else None,
+        save_every=2 if ck else 0, resume=resume, **kw,
+    )
+
+
+def _assert_value_equal(a, b, path=""):
+    """Bitwise-recursive equality that tolerates numpy leaves inside
+    history records (dict == would be ambiguous on arrays)."""
+    assert type(a) is type(b) or (
+        isinstance(a, (int, float)) and isinstance(b, (int, float))
+    ), f"{path}: {type(a)} vs {type(b)}"
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a) ^ set(b)}"
+        for k in a:
+            _assert_value_equal(a[k], b[k], f"{path}.{k}")
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_value_equal(x, y, f"{path}[{i}]")
+    elif isinstance(a, np.ndarray):
+        np.testing.assert_array_equal(a, b, err_msg=path)
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+def _assert_same_run(res0, res1):
+    state0, hist0 = res0
+    state1, hist1 = res1
+    for a, b in zip(jax.tree.leaves(state0), jax.tree.leaves(state1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert len(hist0) == len(hist1)
+    for r0, r1 in zip(hist0, hist1):
+        _assert_value_equal(
+            {k: v for k, v in r0.items() if k not in _TIMING_KEYS},
+            {k: v for k, v in r1.items() if k not in _TIMING_KEYS},
+            path=f"epoch{r0.get('epoch')}",
+        )
+
+
+@pytest.mark.parametrize("algo,obs", [
+    ("eventgrad", "off"),
+    ("eventgrad", "block"),
+    ("dpsgd", "off"),
+    ("dpsgd", "block"),
+])
+def test_pipeline_bitwise_parity(algo, obs, tmp_path):
+    """pipeline on vs off: final FULL state (params, momenta, event
+    buffers, telemetry) and every history record identical — eval and
+    checkpoint land at the same epochs with the same contents."""
+    res0 = _run(algo, obs, pipeline=False, ck=tmp_path / "a")
+    res1 = _run(algo, obs, pipeline=True, ck=tmp_path / "b")
+    _assert_same_run(res0, res1)
+    # the async save produced a restorable snapshot identical in reach
+    for d in ("a", "b"):
+        assert checkpoint.latest(str(tmp_path / d / "ckpt")) is not None
+    # eval cadence preserved: block ends only, final epoch always
+    evaled = [r["epoch"] for r in res1[1] if "test_accuracy" in r]
+    assert evaled == [2, 4, 6]
+
+
+def test_resume_mid_pipeline_matches_uninterrupted(tmp_path):
+    """A pipelined run interrupted at a mid-run snapshot and resumed
+    (still pipelined) lands on the serial uninterrupted trajectory."""
+    full = _run(pipeline=False, epochs=6)
+    ck = tmp_path / "ck"
+    _run(pipeline=True, ck=ck, epochs=4)
+    res = _run(pipeline=True, ck=ck, epochs=6, resume=True)
+    assert [h["epoch"] for h in res[1]] == [5, 6]
+    for a, b in zip(
+        jax.tree.leaves(full[0].params), jax.tree.leaves(res[0].params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipeline_rejects_fault_inject_and_auto_disables():
+    with pytest.raises(ValueError, match="fault_inject"):
+        _run(pipeline=True, fault_inject="crash:99")
+    # auto mode silently falls back to the serial schedule (the fault
+    # epoch is past the run, so training completes normally)
+    state, hist = _run(pipeline=None, fault_inject="crash:99", epochs=2)
+    assert [h["epoch"] for h in hist] == [1, 2]
+
+
+def test_crash_during_async_save_leaves_restorable_snapshot(tmp_path):
+    """The atomic-swap invariant survives the writer thread dying at the
+    worst point: after the old snapshot moved aside, before the new one
+    promoted. latest() finds the .prev and a pipelined resume works."""
+    ck = tmp_path / "ck"
+    _run(pipeline=True, ck=ck, epochs=4)
+    path = os.path.join(str(ck), "ckpt")
+    # simulate the mid-swap kill the async writer could suffer
+    os.rename(path, path + ".prev")
+    assert checkpoint.latest(path) == os.path.abspath(path) + ".prev"
+    res = _run(pipeline=True, ck=ck, epochs=6, resume=True)
+    assert [h["epoch"] for h in res[1]] == [5, 6]
+
+
+def test_async_writer_error_surfaces_at_barrier(tmp_path, monkeypatch):
+    """A failed background save re-raises at the next join barrier —
+    never silently (a run that 'checkpointed' nothing must not exit 0)."""
+    real_save = checkpoint.save
+    boom = {"armed": True}
+
+    def flaky_save(path, payload):
+        if boom.pop("armed", False):
+            raise OSError("disk full")
+        real_save(path, payload)
+
+    monkeypatch.setattr(checkpoint, "save", flaky_save)
+    w = checkpoint.AsyncWriter()
+    w.save(str(tmp_path / "ck"), {"a": np.zeros(2)})
+    with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        w.wait()
+    # the barrier consumed the error; the writer is reusable
+    w.save(str(tmp_path / "ck"), {"a": np.zeros(2)})
+    w.close()
+    assert checkpoint.latest(str(tmp_path / "ck"))
+
+
+def test_async_writer_join_barrier_orders_saves(tmp_path):
+    """save() joins the in-flight write first — two snapshots can never
+    race the tmp/prev swap; the LAST payload wins on disk."""
+    w = checkpoint.AsyncWriter()
+    p = str(tmp_path / "ck")
+    for i in range(3):
+        w.save(p, {"epoch": np.int64(i)})
+    w.close()
+    got = checkpoint.restore(checkpoint.latest(p), {"epoch": np.int64(0)})
+    assert int(got["epoch"]) == 2
+
+
+def test_pipeline_spans_decompose(tmp_path):
+    """The span trace carries the overlap phases: obs.bubble.decompose
+    recovers blocks, components, and a finite bubble from both modes."""
+    from eventgrad_tpu.obs import Registry
+
+    for flag in (False, True):
+        reg = Registry()
+        _run(obs="block", pipeline=flag, ck=tmp_path / f"p{flag}",
+             registry=reg)
+        d = bubble.decompose(reg.spans)
+        assert d["n_blocks"] == 3  # 6 epochs at K=2
+        assert d["pipelined"] is flag
+        assert 0.0 <= d["host_bubble_frac"] <= 1.0
+        assert d["wall_s"] > 0 and d["steps_s"] > 0
+        names = {s.name for s in reg.spans}
+        assert {"train", "data", "dispatch_block", "block_ready",
+                "obs_flush", "eval", "eval_readback"} <= names
+        # checkpoint spans follow the schedule: snapshot+write when
+        # pipelined, the inline span when serial
+        if flag:
+            assert {"ckpt_snapshot", "ckpt_write"} <= names
+        else:
+            assert "checkpoint" in names
+
+
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable in this environment",
+)
+def test_pipeline_parity_shard_map():
+    """The pipelined schedule is lift-agnostic: shard_map-lifted runs
+    match their serial twins bitwise too."""
+    from eventgrad_tpu.parallel.spmd import build_mesh
+
+    mesh = build_mesh(Ring(4))
+    res0 = _run(pipeline=False, mesh=mesh, epochs=4)
+    res1 = _run(pipeline=True, mesh=mesh, epochs=4)
+    _assert_same_run(res0, res1)

@@ -88,6 +88,91 @@ def test_batch_too_large_raises():
         EpochPrefetcher(x, y, n_ranks=4, batch_size=8)
 
 
+def test_speculation_misses_counted_and_logged(caplog):
+    """Out-of-order access falls back to synchronous assembly — but now
+    visibly: the miss is counted and logged (a silently cold prefetcher
+    is a perf bug)."""
+    import logging
+
+    x, y = _data(seed=1)
+    pre = EpochPrefetcher(x, y, n_ranks=2, batch_size=8, random=True, seed=0)
+    try:
+        pre.get(1)  # speculates epoch 2
+        assert pre.misses == 0
+        with caplog.at_level(logging.WARNING, "eventgrad_tpu.data.prefetch"):
+            pre.get(7)  # miss
+        assert pre.misses == 1
+        assert any("speculation miss" in r.message for r in caplog.records)
+        pre.get(8)  # predicted: no new miss
+        assert pre.misses == 1
+    finally:
+        pre.close()
+
+
+def test_get_block_matches_epoch_concat():
+    """Block-granular assembly == the loop's old per-epoch concat, and
+    the next block's speculation is consumed without a miss."""
+    x, y = _data(n=96, seed=6)
+    pre = EpochPrefetcher(x, y, n_ranks=2, batch_size=8, random=True, seed=2)
+    try:
+        xb, yb = pre.get_block(1, 3, next_span=(4, 5))
+        xs = [pre._assemble(e) for e in (1, 2, 3)]
+        np.testing.assert_array_equal(
+            xb, np.concatenate([p[0] for p in xs], axis=1)
+        )
+        np.testing.assert_array_equal(
+            yb, np.concatenate([p[1] for p in xs], axis=1)
+        )
+        pre.get_block(4, 5)  # the speculated block: served, no miss
+        assert pre.misses == 0
+    finally:
+        pre.close()
+
+
+def test_block_transfer_runs_on_worker():
+    """transfer= is applied to the speculated block on the background
+    thread (the device_put overlap of the dispatch pipeline)."""
+    import threading
+
+    x, y = _data(seed=8)
+    threads = []
+
+    def tag(arr):
+        threads.append(threading.current_thread().name)
+        return ("transferred", arr)
+
+    pre = EpochPrefetcher(x, y, 2, 8, random=True, seed=1, transfer=tag)
+    try:
+        xb, yb = pre.get_block(1, 1, next_span=(2, 2))
+        assert xb[0] == "transferred" and yb[0] == "transferred"
+        xb2, _ = pre.get_block(2, 2)
+        assert xb2[0] == "transferred"
+        # the speculated block's transfer ran on a prefetch worker
+        assert any(t.startswith("eg-prefetch-") for t in threads)
+    finally:
+        pre.close()
+
+
+def test_close_idempotent_and_safe_after_worker_error(monkeypatch):
+    """close() must retire a failed speculation WITHOUT raising (the
+    loop calls it in `finally` — it must never mask the real exception)
+    and stay safe when called repeatedly."""
+    x, y = _data(seed=9)
+    pre = EpochPrefetcher(x, y, 2, 8, random=True, seed=1)
+    monkeypatch.setattr(
+        pre, "_assemble", lambda e: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    pre._pending = pre._start((2, 2))  # doomed background assembly
+    pre.close()  # swallows the worker error
+    pre.close()  # idempotent
+    assert pre._pending is None
+    # a CONSUMED speculation still surfaces its error to the caller
+    pre._pending = pre._start((3, 3))
+    with pytest.raises(RuntimeError, match="boom"):
+        pre.get_block(3, 3)
+    pre.close()
+
+
 def test_shuffled_epochs_differ_and_are_deterministic():
     x, y = _data(n=128, seed=2)
     a = EpochPrefetcher(x, y, 2, 8, random=True, seed=5)

@@ -47,6 +47,9 @@ import numpy as np
 from eventgrad_tpu.utils import compile_cache
 
 compile_cache.honor_cpu_pin()
+# persistent XLA cache: repeated sweep invocations must not re-pay the
+# jit compile per process (no-op on the CPU backend)
+compile_cache.enable()
 
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos.policy import RecoveryPolicy, apply_ring_heal

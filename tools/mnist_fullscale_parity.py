@@ -23,6 +23,9 @@ import jax
 from eventgrad_tpu.utils import compile_cache
 
 compile_cache.honor_cpu_pin()
+# persistent XLA cache: repeated invocations must not re-pay the jit
+# compile per process (no-op on the CPU backend)
+compile_cache.enable()
 
 from eventgrad_tpu.data.datasets import load_or_synthesize
 from eventgrad_tpu.models import CNN2

@@ -25,6 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from eventgrad_tpu.utils import compile_cache  # noqa: E402
 
 compile_cache.honor_cpu_pin()
+# persistent XLA cache: repeated overhead runs must not re-pay the jit
+# compile per process (no-op on the CPU backend)
+compile_cache.enable()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

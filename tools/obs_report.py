@@ -21,7 +21,15 @@ a 4-rank CPU EventGraD + compact-wire run:
   python tools/obs_report.py /tmp/obs_hist.jsonl \
       --out artifacts/obs_report_cpu.json
 
-Usage: python tools/obs_report.py HISTORY.jsonl [--out PATH] [--quiet]
+With --trace TRACE.json (a Chrome-trace span export — cli.py
+`--obs-dir`/trace.json or bench `EG_BENCH_OBS_TRACE`), the report also
+renders the HOST-BUBBLE decomposition (obs.bubble): wall = steps +
+flush + eval + checkpoint + data + other, the dispatch-pipeline metric
+of docs/ARCHITECTURE.md "The dispatch pipeline" — one `bubble` section
+per train() window in the trace.
+
+Usage: python tools/obs_report.py HISTORY.jsonl [--trace TRACE.json]
+                                  [--out PATH] [--quiet]
 """
 
 from __future__ import annotations
@@ -42,6 +50,9 @@ from eventgrad_tpu.obs.report import (  # noqa: E402
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("history", help="metrics JSONL (cli.py --log-file)")
+    ap.add_argument("--trace", default=None, metavar="TRACE.json",
+                    help="span-trace export (Chrome-trace JSON): adds "
+                         "the host-bubble decomposition (obs.bubble)")
     ap.add_argument("--out", default=None, help="report JSON path")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress the text summary on stdout")
@@ -52,6 +63,16 @@ def main(argv=None) -> int:
         print(f"no epoch records in {args.history}", file=sys.stderr)
         return 1
     report = build_report(history)
+    bubbles = []
+    if args.trace:
+        from eventgrad_tpu.obs import bubble as obs_bubble
+
+        with open(args.trace) as f:
+            events = json.load(f).get("traceEvents", [])
+        windows = obs_bubble.train_windows(events) or [events]
+        bubbles = [obs_bubble.decompose(w) for w in windows]
+        report["bubble"] = bubbles
+        report["bubble_source"] = os.path.basename(args.trace)
     report["source"] = os.path.basename(args.history)
     report["generated_at"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -62,6 +83,11 @@ def main(argv=None) -> int:
         print(f"wrote {args.out}", file=sys.stderr)
     if not args.quiet:
         print(render_text(report))
+        if bubbles:
+            from eventgrad_tpu.obs import bubble as obs_bubble
+
+            for i, d in enumerate(bubbles):
+                print(obs_bubble.render_text(d, label=f"train window {i}"))
     return 0
 
 

@@ -67,6 +67,9 @@ import optax  # noqa: E402
 from eventgrad_tpu.utils import compile_cache  # noqa: E402
 
 compile_cache.honor_cpu_pin()
+# persistent XLA cache: the A/B legs re-run this entry point per process
+# and must not re-pay the jit compile (no-op on the CPU backend)
+compile_cache.enable()
 
 from eventgrad_tpu.data.datasets import load_or_synthesize  # noqa: E402
 from eventgrad_tpu.data.sharding import batched_epoch  # noqa: E402

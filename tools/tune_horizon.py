@@ -24,6 +24,9 @@ import jax
 from eventgrad_tpu.utils import compile_cache
 
 compile_cache.honor_cpu_pin()  # JAX_PLATFORMS=cpu must beat the axon plugin
+# persistent XLA cache: repeated invocations must not re-pay the jit
+# compile per process (no-op on the CPU backend)
+compile_cache.enable()
 
 
 def run_point(dataset: str, horizon: float, warmup: int = 30,

@@ -223,12 +223,49 @@ ARENA_ABLATION_SCHEMA = {
     },
 }
 
+_BUBBLE_LEG = {
+    "type": "object",
+    "required": ["wall_s", "steps_s", "bubble_s", "host_bubble_frac"],
+    "properties": {
+        "wall_s": {"type": "number", "minimum": 0},
+        "steps_s": {"type": "number", "minimum": 0},
+        "bubble_s": {"type": "number", "minimum": 0},
+        "host_bubble_frac": {"type": "number", "minimum": 0, "maximum": 1},
+    },
+}
+
+PIPELINE_BUBBLE_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "results", "bubble_ratio", "bitwise_state",
+    ],
+    "properties": {
+        "bench": {"enum": ["pipeline_bubble"]},
+        "platform": {"type": "string"},
+        "results": {
+            "type": "object",
+            "required": ["serial", "pipelined"],
+            "properties": {
+                "serial": _BUBBLE_LEG,
+                "pipelined": _BUBBLE_LEG,
+            },
+        },
+        # the dispatch-pipeline acceptance gate (ISSUE 5): pipelined
+        # host-bubble fraction STRICTLY below the serial leg's
+        "bubble_ratio": {"type": "number", "minimum": 0, "maximum": 0.999},
+        # and bitwise-identical training state/metrics across the legs —
+        # a perf artifact whose optimization changed training is invalid
+        "bitwise_state": {"enum": [True]},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
     ("obs_report_", OBS_REPORT_SCHEMA),
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
     ("arena_ablation_", ARENA_ABLATION_SCHEMA),
+    ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
     ("tpu_flagship", FLAGSHIP_SCHEMA),
