@@ -21,20 +21,34 @@ first-class, *measured* property instead of a hope:
     the sender-side `max_silence` knob), edge-freeze with renormalized mix
     weights, and ring heal on permanent death (survivors bridge the gap
     via a rewritten `Topology`).
+  * `membership` — ELASTIC membership: a replayable stream of epoch-keyed
+    join/leave events applied between jit dispatch blocks — leave
+    generalizes the heal, join bootstraps a newcomer's full gossip state
+    from a neighbor's snapshot streamed through the async checkpoint
+    writer, and every transition force-fires the next exchange so
+    buffers refresh in one cycle.
 
-Entry points: `train.loop.train(chaos=..., chaos_policy=...)`, the CLI's
-`--chaos/--chaos-sync-after/--chaos-freeze-after` flags, `bench.py`'s
-EG_BENCH_CHAOS mode, and `tools/chaos_sweep.py` (drop-rate vs accuracy and
-recovery-latency curves). Fault model and formats: docs/chaos.md.
+Entry points: `train.loop.train(chaos=..., chaos_policy=...,
+membership=...)`, the CLI's `--chaos/--chaos-sync-after/
+--chaos-freeze-after/--membership` flags, `bench.py`'s EG_BENCH_CHAOS
+mode, `tools/chaos_sweep.py` (drop-rate vs accuracy and recovery-latency
+curves), and `tools/soak.py` (the supervised long-running soak harness).
+Fault model and formats: docs/chaos.md.
 """
 
 from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.chaos.membership import (
+    MembershipEngine, MembershipEvent, MembershipSchedule,
+)
 from eventgrad_tpu.chaos.monitor import PeerHealth, consensus_error
 from eventgrad_tpu.chaos.policy import RecoveryPolicy, heal_ring, apply_ring_heal
 
 __all__ = [
     "ChaosSchedule",
     "FlakyWindow",
+    "MembershipEngine",
+    "MembershipEvent",
+    "MembershipSchedule",
     "PeerHealth",
     "RecoveryPolicy",
     "consensus_error",

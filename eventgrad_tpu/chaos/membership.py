@@ -1,0 +1,473 @@
+"""Elastic membership: live rank join/leave with neighbor-stream bootstrap.
+
+The chaos subsystem's ring heal (`policy.heal_ring`) only ever SHRINKS the
+ring — a long-running service monotonically degrades, because nothing can
+rejoin. This module is the full membership story: a replayable stream
+of `join`/`leave` events processed at jit-dispatch-block boundaries
+(the fused step never sees a dynamic shape):
+
+  * **leave** — the clean generalization of peer death: `heal_ring`
+    rewrites the topology to `Ring(n-1)`, survivor rows are re-sliced out
+    of the stacked state, stale receive buffers are kept (legal gossip
+    input by construction, event.cpp:177-179) and refresh within one
+    force-fire cycle.
+  * **join** — the new N -> N+1 path. The newcomer bootstraps its FULL
+    gossip `TrainState` row (params, optimizer moments, event thresholds,
+    stale neighbor buffers) from a neighbor's snapshot, streamed through
+    the existing `utils/checkpoint.host_snapshot` + `AsyncWriter`
+    machinery (the same eager-copy/background-serialize path the dispatch
+    pipeline's checkpoints use — lossless, so replay stays bitwise), and
+    the ring regrows to `Ring(n+1)`. `collectives.mix_weighted`'s uniform
+    1/(1+n_neighbors) weighting needs no renormalization on a ring: the
+    neighbor COUNT is 2 at every ring size >= 2, so regrowth only rewires
+    `neighbor_source` — exactly like the heal, in reverse.
+
+Every transition ends with a **force-fired first exchange**
+(`force_refresh`): the next pass fires every parameter on every rank, so
+all receive buffers — the newcomer's copied-stale ones and the survivors'
+rewired-stale ones — refresh in one cycle. Forced fires ride the normal
+event accounting (`num_events` counts them): elasticity spends savings,
+visibly.
+
+Determinism/replayability: a transition is a pure function of
+(schedule, event, current state), the newcomer's PRNG stream is salted
+from the source rank's key with (epoch, position), and the bootstrap
+stream round-trips bitwise — so training state is bitwise-replayable
+from the membership log alone (`train()` serializes the schedule into
+the first history record, like chaos schedules).
+
+Counters across transitions: a departed rank takes its cumulative
+`num_events`/`num_deferred`/telemetry with it, and a newcomer starts its
+counters at ZERO (copying the bootstrap source's counters would double-
+count sends that happened once). Aggregate msgs-saved-% under membership
+is therefore computed against cumulative rank-passes (train/loop.py),
+and is approximate across leaves' histories by construction.
+
+Ring(2) degenerate case: both neighbor shifts (-1/+1) resolve to the SAME
+peer. The reference still sends two puts and weighs 1/3 (topology.py
+`neighbors`), heal-to-2 keeps that contract (the healed topology IS
+`Ring(2)`), and `mix_weighted` never half-counts the peer: both directed
+edges share one source, so their health gates agree — regression-pinned
+in tests/test_topology.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.chaos.policy import apply_ring_heal
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring, Topology
+
+KINDS = ("join", "leave")
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipEvent:
+    """One transition, applied at the END of `epoch` (a dispatch-block
+    boundary — train/loop.py forces one-epoch blocks under membership).
+
+    kind="leave": `index` is the CURRENT stacked rank index removed.
+    kind="join":  `index` is the ring position the newcomer takes (rows
+    at >= index shift up by one); `src` is the CURRENT index of the
+    bootstrap neighbor (default: the newcomer's left neighbor,
+    `(index - 1) % n` at apply time).
+    """
+
+    epoch: int
+    kind: str
+    index: int
+    src: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"membership kind must be one of {KINDS}, "
+                             f"got {self.kind!r}")
+        if self.epoch < 1:
+            raise ValueError(f"membership epoch must be >= 1, got {self.epoch}")
+        if self.index < 0:
+            raise ValueError(f"membership index must be >= 0, got {self.index}")
+        if self.src is not None and self.src < 0:
+            raise ValueError(f"membership src must be >= 0, got {self.src}")
+        if self.kind == "leave" and self.src is not None:
+            raise ValueError("leave events take no bootstrap src")
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipSchedule:
+    """A replayable membership log: pure data, like `ChaosSchedule`.
+
+    Events sort stably by epoch (same-epoch events apply in listed
+    order); two runs of one schedule perform bit-identical transitions.
+
+    `seed` is provenance only — no transition consumes it (they are
+    deterministic functions of (event, state); the newcomer's PRNG salt
+    derives from the source rank's key, not the schedule). It rides
+    serialization so a schedule lifted from a chaos spec keeps its
+    origin's seed label.
+    """
+
+    seed: int = 0
+    events: Tuple[MembershipEvent, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "events",
+            tuple(sorted(self.events, key=lambda e: e.epoch)),
+        )
+
+    @property
+    def is_noop(self) -> bool:
+        return not self.events
+
+    def events_at(self, epoch: int) -> Tuple[MembershipEvent, ...]:
+        return tuple(e for e in self.events if e.epoch == int(epoch))
+
+    def n_ranks_at(self, base_n: int, epoch: int) -> int:
+        """Rank count AFTER every event with `e.epoch <= epoch` applied
+        (transitions land at epoch ends, so a snapshot taken at `epoch`
+        reflects them)."""
+        n = int(base_n)
+        for e in self.events:
+            if e.epoch <= epoch:
+                n += 1 if e.kind == "join" else -1
+                if n < 2:
+                    raise ValueError(
+                        f"membership schedule drops below 2 ranks at "
+                        f"epoch {e.epoch}"
+                    )
+        return n
+
+    def validate(self, base_n: int) -> None:
+        """Fail-fast static walk: simulate the whole schedule from
+        `base_n` ranks and reject any event whose index/src falls outside
+        the ring it will meet — hours-deep apply-time surprises belong
+        here, before any compute is spent. (Engine.apply keeps the same
+        checks as its runtime guard.)"""
+        n = int(base_n)
+        for e in self.events:
+            if e.kind == "leave":
+                if not 0 <= e.index < n:
+                    raise ValueError(
+                        f"leave index {e.index} at epoch {e.epoch} "
+                        f"outside 0..{n - 1}"
+                    )
+                n -= 1
+            else:
+                if not 0 <= e.index <= n:
+                    raise ValueError(
+                        f"join position {e.index} at epoch {e.epoch} "
+                        f"outside 0..{n}"
+                    )
+                if e.src is not None and not 0 <= e.src < n:
+                    raise ValueError(
+                        f"join src {e.src} at epoch {e.epoch} "
+                        f"outside 0..{n - 1}"
+                    )
+                n += 1
+            if n < 2:
+                raise ValueError(
+                    f"membership schedule drops below 2 ranks at "
+                    f"epoch {e.epoch}"
+                )
+
+    def topology_at(self, base_topo: Topology, epoch: int) -> Topology:
+        """The ring topology after every event with epoch <= `epoch`."""
+        n = self.n_ranks_at(base_topo.n_ranks, epoch)
+        return (
+            base_topo if n == base_topo.n_ranks
+            else Ring(n, axis=base_topo.axes[0])
+        )
+
+    # --- serialization (history records / artifacts) -------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "events": [
+                {"epoch": e.epoch, "kind": e.kind, "index": e.index,
+                 **({"src": e.src} if e.src is not None else {})}
+                for e in self.events
+            ],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MembershipSchedule":
+        return cls(
+            seed=int(d.get("seed", 0)),
+            events=tuple(
+                MembershipEvent(
+                    epoch=int(e["epoch"]), kind=str(e["kind"]),
+                    index=int(e["index"]),
+                    src=int(e["src"]) if e.get("src") is not None else None,
+                )
+                for e in d.get("events", ())
+            ),
+        )
+
+    # --- CLI spec grammar: leave=IDX@EPOCH, join=POS@EPOCH[:SRC] -------
+
+    def to_spec(self) -> str:
+        parts = [f"seed={self.seed}"]
+        parts += [format_event_clause(e) for e in self.events]
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, spec: str) -> "MembershipSchedule":
+        kw: Dict[str, Any] = {"events": []}
+        for clause in spec.split(","):
+            clause = clause.strip()
+            if not clause:
+                continue
+            key, sep, val = clause.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"bad membership clause {clause!r} (expected key=value)"
+                )
+            try:
+                if key == "seed":
+                    kw["seed"] = int(val)
+                elif key in KINDS:
+                    kw["events"].append(parse_event_clause(key, val))
+                else:
+                    raise ValueError(f"unknown membership key {key!r}")
+            except ValueError as err:
+                raise ValueError(
+                    f"bad membership clause {clause!r}: {err}"
+                ) from None
+        kw["events"] = tuple(kw["events"])
+        return cls(**kw)
+
+
+def format_event_clause(e: MembershipEvent) -> str:
+    """Inverse of `parse_event_clause` — the one emitter of the clause
+    grammar, shared by both schedules' `to_spec`."""
+    clause = f"{e.kind}={e.index}@{e.epoch}"
+    if e.src is not None:
+        clause += f":{e.src}"
+    return clause
+
+
+def parse_event_clause(kind: str, val: str) -> MembershipEvent:
+    """`IDX@EPOCH` (leave) / `POS@EPOCH[:SRC]` (join) — the shared clause
+    grammar of `MembershipSchedule.parse` and `ChaosSchedule.parse`'s
+    join=/leave= vocabulary."""
+    idx, _, rest = val.partition("@")
+    epoch, _, src = rest.partition(":")
+    if src and kind != "join":
+        raise ValueError("only join events take a :SRC suffix")
+    return MembershipEvent(
+        epoch=int(epoch), kind=kind, index=int(idx),
+        src=int(src) if src else None,
+    )
+
+
+def resolve(membership) -> "MembershipSchedule":
+    """Accept a MembershipSchedule, spec string, or serialized dict — the
+    one coercion used by train(), the CLI, and the soak tool."""
+    if isinstance(membership, MembershipSchedule):
+        return membership
+    if isinstance(membership, str):
+        return MembershipSchedule.parse(membership)
+    if isinstance(membership, dict):
+        return MembershipSchedule.from_dict(membership)
+    raise TypeError(
+        "membership must be a MembershipSchedule, spec string, or dict; "
+        f"got {type(membership)}"
+    )
+
+
+def force_refresh(state, event_cfg: Optional[EventConfig]):
+    """Arm a force-fired first exchange: the next pass fires EVERY
+    parameter on every rank, so all receive buffers refresh in one cycle.
+
+    Mechanism rides the trigger itself, so it works identically on the
+    tree and arena engines and on both wires:
+      * adaptive mode: thresholds drop to 0 — `value_diff >= 0` always
+        holds, and the fire resets thres from the (real) slope history.
+      * constant mode: `last_sent_norm` drops to -1e30 — the drift beats
+        any constant; constant thresholds ignore the slope pollution.
+        With constant == 0 every pass already fires: no-op.
+    dpsgd/allreduce (no event state) need no arming — they ship
+    everything every pass. On the compact wire a full-fire pass can
+    overflow the budget; deferred leaves keep their armed trigger and
+    drain under the capacity gate's starvation bound.
+    """
+    ev = getattr(state, "event", None)
+    if ev is None:
+        return state
+    cfg = event_cfg or EventConfig()
+    if cfg.adaptive:
+        ev = ev.replace(thres=jnp.zeros_like(ev.thres))
+    elif cfg.constant > 0.0:
+        ev = ev.replace(
+            last_sent_norm=jnp.full_like(ev.last_sent_norm, -1e30)
+        )
+    else:
+        return state  # constant == 0: every pass fires already
+    return state.replace(event=ev)
+
+
+def _insert_row(tree: Any, pos: int, row: Any) -> Any:
+    """Insert `row` (per-rank pytree) at stacked index `pos`."""
+    return jax.tree.map(
+        lambda x, r: jnp.concatenate(
+            [x[:pos], jnp.asarray(r, x.dtype)[None], x[pos:]], axis=0
+        ),
+        tree, row,
+    )
+
+
+def take_rows_host(tree: Any, keep: Tuple[int, ...]) -> Any:
+    """Host-side row slice of a numpy-leaf pytree (the loop's telemetry
+    diff base must track the device state's row layout)."""
+    idx = np.asarray(keep, np.int64)
+    return jax.tree.map(lambda x: np.take(np.asarray(x), idx, axis=0), tree)
+
+
+def insert_zero_row_host(tree: Any, pos: int) -> Any:
+    """Host-side zero-row insertion (a newcomer's cumulative telemetry
+    counters start at zero on device; the diff base matches)."""
+    return jax.tree.map(
+        lambda x: np.insert(np.asarray(x), pos, 0, axis=0), tree
+    )
+
+
+class MembershipEngine:
+    """Applies one schedule's transitions to (state, topology) at
+    dispatch-block boundaries. Host-side by design: a transition changes
+    array shapes, so it can only happen between jitted dispatches.
+
+    `bootstrap_dir` (optional) routes every join's neighbor snapshot
+    through the on-disk checkpoint stream (`AsyncWriter` + atomic swap at
+    `<dir>/bootstrap`) — the path a real newcomer process would read; in
+    memory-only mode the same `host_snapshot` eager copy is handed over
+    directly. Both are lossless, so the trained state is bitwise
+    identical either way.
+    """
+
+    def __init__(
+        self,
+        schedule: MembershipSchedule,
+        *,
+        event_cfg: Optional[EventConfig] = None,
+        bootstrap_dir: Optional[str] = None,
+    ):
+        self.schedule = schedule
+        self.event_cfg = event_cfg
+        self.bootstrap_dir = bootstrap_dir
+        #: transitions applied so far (info dicts, in order)
+        self.log: List[Dict[str, Any]] = []
+
+    def events_at(self, epoch: int) -> Tuple[MembershipEvent, ...]:
+        return self.schedule.events_at(epoch)
+
+    # --- bootstrap stream ----------------------------------------------
+
+    def _stream_row(self, row: Any) -> Tuple[Any, bool]:
+        """Neighbor-row handoff through the checkpoint machinery:
+        `host_snapshot` (eager device->host owned copies) always; with a
+        bootstrap_dir, additionally `checkpoint.save` (the same
+        write-tmp/atomic-swap as training snapshots — the transition
+        blocks on the stream anyway, so no writer thread) and restore —
+        the wire a joining process would consume. Returns
+        (host row, streamed_via_disk)."""
+        from eventgrad_tpu.utils import checkpoint
+
+        snap = checkpoint.host_snapshot(row)
+        if not self.bootstrap_dir:
+            return snap, False
+        path = os.path.join(self.bootstrap_dir, "bootstrap")
+        checkpoint.save(path, snap)
+        found = checkpoint.latest(path)
+        return checkpoint.restore(found, snap), True
+
+    # --- transitions ---------------------------------------------------
+
+    def apply(self, state, topo: Topology, ev: MembershipEvent):
+        """Apply one transition; returns (state, topology, info record).
+
+        Leave re-slices survivors (exactly `policy.apply_ring_heal`);
+        join inserts the bootstrapped row at `ev.index` and regrows the
+        ring. Both end force-refreshed (module docstring)."""
+        if len(topo.axes) != 1 or topo.gossip_axes != topo.axes:
+            raise ValueError(
+                "membership transitions handle single-axis gossip rings; "
+                f"got axes {topo.axes}"
+            )
+        t0 = time.perf_counter()
+        n = topo.n_ranks
+        info: Dict[str, Any] = {
+            "kind": ev.kind, "epoch": ev.epoch, "index": ev.index,
+            "n_ranks_before": n,
+        }
+        if ev.kind == "leave":
+            if n <= 2:
+                raise ValueError(
+                    f"cannot leave at n_ranks={n}: a ring needs >= 2"
+                )
+            new_state, new_topo, survivors = apply_ring_heal(
+                state, topo, {ev.index}
+            )
+            info["survivors"] = list(survivors)
+        else:
+            if not 0 <= ev.index <= n:
+                raise ValueError(
+                    f"join position {ev.index} outside 0..{n}"
+                )
+            src = ev.src if ev.src is not None else (ev.index - 1) % n
+            if not 0 <= src < n:
+                raise ValueError(f"join src {src} outside 0..{n - 1}")
+            row = jax.tree.map(lambda x: x[src], state)
+            row, streamed = self._stream_row(row)
+            new_state = _insert_row(state, ev.index, row)
+            new_state = self._init_newcomer(new_state, ev, src)
+            new_topo = Ring(n + 1, axis=topo.axes[0])
+            info.update(src=src, bootstrap_streamed=streamed)
+        new_state = force_refresh(new_state, self.event_cfg)
+        info["n_ranks_after"] = new_topo.n_ranks
+        info["apply_s"] = round(time.perf_counter() - t0, 4)
+        self.log.append(info)
+        return new_state, new_topo, info
+
+    def _init_newcomer(self, state, ev: MembershipEvent, src: int):
+        """Post-insert fix-ups at row `ev.index`: cumulative counters
+        start at zero (the bootstrap copies STATE, not HISTORY), the
+        PRNG stream is salted deterministically from the source key with
+        (epoch, position) so replay reproduces it, and — like the heal —
+        every rank's per-edge health resets so fresh edges start
+        healthy."""
+        pos = ev.index
+        upd = {}
+        evs = getattr(state, "event", None)
+        if evs is not None:
+            upd["event"] = evs.replace(
+                num_events=evs.num_events.at[pos].set(0),
+                num_deferred=evs.num_deferred.at[pos].set(0),
+            )
+        tel = getattr(state, "telemetry", None)
+        if tel is not None:
+            upd["telemetry"] = jax.tree.map(
+                lambda x: x.at[pos].set(jnp.zeros_like(x[pos])), tel
+            )
+        health = getattr(state, "chaos", None)
+        if health is not None:
+            upd["chaos"] = health.replace(
+                silence=jnp.zeros_like(health.silence),
+                sync_req=jnp.zeros_like(health.sync_req),
+                drops=health.drops.at[pos].set(0),
+            )
+        rng = getattr(state, "rng", None)
+        if rng is not None:
+            salt = jax.random.fold_in(
+                jax.random.fold_in(rng[pos], ev.epoch), pos
+            )
+            upd["rng"] = rng.at[pos].set(salt)
+        return state.replace(**upd) if upd else state

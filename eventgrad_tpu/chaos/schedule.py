@@ -22,13 +22,26 @@ Fault vocabulary (all composable):
                      "thinned" equivalent from the mixing step's view.
   * `death`        — permanent peer death at pass T: from T on, the rank
                      neither sends nor receives (every edge touching it is
-                     masked). Recovery is `policy.heal_ring`.
+                     masked). Recovery is `policy.heal_ring`. NOT
+                     composable with membership events below: death is
+                     rank-indexed inside the traced step and a
+                     transition re-indexes the rows (train() rejects
+                     the combination — script the removal as `leave=`).
+  * `leave`/`join` — MEMBERSHIP events (chaos/membership.py): unlike the
+                     wire faults above they are keyed by EPOCH, applied
+                     between jit dispatch blocks on the host (a rank
+                     leaves cleanly / a newcomer bootstraps in), never
+                     inside the traced step. `leave=1@3` removes rank 1
+                     after epoch 3; `join=1@5[:SRC]` inserts a newcomer
+                     at position 1 after epoch 5 (bootstrap source SRC,
+                     default the left neighbor). train() routes them to
+                     the MembershipEngine.
 
 CLI spec grammar (comma-separated clauses, see `parse`):
 
-    drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500
+    drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500,leave=1@3,join=1@5
 
-Multiple `flaky=` / `die=` clauses accumulate.
+Multiple `flaky=` / `die=` / `leave=` / `join=` clauses accumulate.
 """
 
 from __future__ import annotations
@@ -56,13 +69,17 @@ class FlakyWindow:
 
 @dataclasses.dataclass(frozen=True)
 class ChaosSchedule:
-    """A replayable fault schedule. `death` is ((rank, pass), ...) pairs."""
+    """A replayable fault schedule. `death` is ((rank, pass), ...) pairs;
+    `membership` holds epoch-keyed join/leave events (membership.py
+    `MembershipEvent` tuples) that train() hands to the
+    MembershipEngine — they never enter the traced step."""
 
     seed: int = 0
     drop_p: float = 0.0
     flaky: Tuple[FlakyWindow, ...] = ()
     deliver_every: int = 1
     death: Tuple[Tuple[int, int], ...] = ()
+    membership: Tuple[Any, ...] = ()
 
     def __post_init__(self):
         if not 0.0 <= self.drop_p <= 1.0:
@@ -78,17 +95,31 @@ class ChaosSchedule:
         for r, t in self.death:
             if r < 0 or t < 0:
                 raise ValueError(f"death ({r}, {t}) invalid")
+        object.__setattr__(
+            self, "membership",
+            tuple(sorted(self.membership, key=lambda e: e.epoch)),
+        )
 
     @property
     def is_noop(self) -> bool:
         """True when the schedule injects nothing (the drop-rate-0 regression
-        point: the trajectory must be bitwise-identical to chaos=None)."""
+        point: the trajectory must be bitwise-identical to chaos=None).
+        Membership events count: a transition changes the trajectory even
+        with zero wire faults."""
         return (
             self.drop_p == 0.0
             and not self.flaky
             and self.deliver_every == 1
             and not self.death
+            and not self.membership
         )
+
+    def membership_schedule(self):
+        """The epoch-keyed join/leave events as a MembershipSchedule (for
+        the MembershipEngine); empty events -> an is_noop schedule."""
+        from eventgrad_tpu.chaos.membership import MembershipSchedule
+
+        return MembershipSchedule(seed=self.seed, events=self.membership)
 
     def dead_ranks(self, up_to_pass: int) -> Tuple[int, ...]:
         """Ranks whose death pass is <= `up_to_pass` (host-side helper for
@@ -98,7 +129,7 @@ class ChaosSchedule:
     # --- serialization (bench records / artifacts) ---------------------
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "seed": self.seed,
             "drop_p": self.drop_p,
             "flaky": [
@@ -107,9 +138,19 @@ class ChaosSchedule:
             "deliver_every": self.deliver_every,
             "death": [list(d) for d in self.death],
         }
+        if self.membership:  # absent = legacy schedules round-trip unchanged
+            d["membership"] = self.membership_schedule().to_dict()["events"]
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ChaosSchedule":
+        membership = ()
+        if d.get("membership"):
+            from eventgrad_tpu.chaos.membership import MembershipSchedule
+
+            membership = MembershipSchedule.from_dict(
+                {"events": d["membership"]}
+            ).events
         return cls(
             seed=int(d.get("seed", 0)),
             drop_p=float(d.get("drop_p", 0.0)),
@@ -121,6 +162,7 @@ class ChaosSchedule:
             death=tuple(
                 (int(r), int(t)) for r, t in d.get("death", ())
             ),
+            membership=membership,
         )
 
     # --- CLI spec round trip -------------------------------------------
@@ -133,12 +175,16 @@ class ChaosSchedule:
             parts.append(f"delay={self.deliver_every}")
         for r, t in self.death:
             parts.append(f"die={r}@{t}")
+        if self.membership:
+            from eventgrad_tpu.chaos.membership import format_event_clause
+
+            parts += [format_event_clause(e) for e in self.membership]
         return ",".join(parts)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSchedule":
         """Parse the CLI grammar, e.g. `drop=0.2,seed=7,flaky=10-20@0.8`."""
-        kw: Dict[str, Any] = {"flaky": [], "death": []}
+        kw: Dict[str, Any] = {"flaky": [], "death": [], "membership": []}
         for clause in spec.split(","):
             clause = clause.strip()
             if not clause:
@@ -164,6 +210,12 @@ class ChaosSchedule:
                 elif key == "die":
                     r, _, t = val.partition("@")
                     kw["death"].append((int(r), int(t)))
+                elif key in ("leave", "join"):
+                    from eventgrad_tpu.chaos.membership import (
+                        parse_event_clause,
+                    )
+
+                    kw["membership"].append(parse_event_clause(key, val))
                 else:
                     raise ValueError(f"unknown chaos key {key!r}")
             except ValueError as err:
@@ -172,6 +224,7 @@ class ChaosSchedule:
                 ) from None
         kw["flaky"] = tuple(kw["flaky"])
         kw["death"] = tuple(kw["death"])
+        kw["membership"] = tuple(kw["membership"])
         return cls(**kw)
 
 

@@ -278,6 +278,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(dpsgd/eventgrad) only. Replayable: the "
                         "schedule is serialized into the first history "
                         "record")
+    p.add_argument("--membership", default=None, metavar="SPEC",
+                   help="elastic membership schedule (chaos/membership.py): "
+                        "e.g. 'leave=1@3,join=1@5' — rank 1 leaves after "
+                        "epoch 3, a newcomer joins at position 1 after "
+                        "epoch 5, bootstrapping its full gossip state from "
+                        "a neighbor's snapshot streamed through the async "
+                        "checkpoint writer; every transition force-fires "
+                        "the next exchange. Replayable: the schedule rides "
+                        "the first history record. Single-process ring "
+                        "gossip runs (dpsgd/eventgrad) only; join=/leave= "
+                        "clauses inside --chaos are equivalent")
     p.add_argument("--chaos-sync-after", type=int, default=0, metavar="N",
                    help="recovery: an edge silent N passes makes the "
                         "receiver request a forced full sync from that "
@@ -402,6 +413,37 @@ def main(argv=None) -> int:
                 "--trace-file records the synchronous exchange; not "
                 "available with --staleness"
             )
+    membership = None
+    if args.membership is not None:
+        from eventgrad_tpu.chaos import MembershipSchedule
+
+        try:
+            membership = MembershipSchedule.parse(args.membership)
+        except ValueError as e:
+            raise SystemExit(f"--membership: {e}")
+
+    def _membership_guards(flag: str):
+        """The same guards whether the events arrived via --membership or
+        a --chaos spec's join=/leave= clauses."""
+        if args.algo not in ("dpsgd", "eventgrad"):
+            raise SystemExit(
+                f"{flag} rides the gossip exchange (dpsgd/eventgrad); "
+                f"--algo {args.algo} has no ring to re-shape"
+            )
+        if args.trace_file:
+            raise SystemExit(
+                "--trace-file carries rank-shaped recv staleness; not "
+                f"available with {flag}"
+            )
+        if args.pipeline == "on":
+            raise SystemExit(
+                f"--pipeline on cannot honor {flag} (transitions "
+                "re-shape the state between blocks, which needs the "
+                "serial schedule); use --pipeline auto or off"
+            )
+
+    if membership is not None:
+        _membership_guards("--membership")
     chaos_sched = None
     chaos_policy = None
     if args.chaos is not None:
@@ -421,6 +463,13 @@ def main(argv=None) -> int:
             chaos_sched = ChaosSchedule.parse(args.chaos)
         except ValueError as e:
             raise SystemExit(f"--chaos: {e}")
+        if chaos_sched.membership:
+            if membership is not None:
+                raise SystemExit(
+                    "membership events given both via --membership and "
+                    "the --chaos spec's join=/leave= clauses; pass one"
+                )
+            _membership_guards("--chaos join=/leave=")
         if args.chaos_sync_after and args.algo != "eventgrad":
             raise SystemExit(
                 "--chaos-sync-after rides the event fire decision; "
@@ -538,6 +587,7 @@ def main(argv=None) -> int:
                 gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
                 fused_update=args.fused, fault_inject=args.fault_inject,
                 chaos=chaos_sched, chaos_policy=chaos_policy,
+                membership=membership,
                 obs=args.obs, registry=registry,
                 arena={"auto": None, "on": True, "off": False}[args.arena],
                 pipeline={

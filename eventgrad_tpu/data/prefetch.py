@@ -32,7 +32,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from eventgrad_tpu.data import native
-from eventgrad_tpu.data.sharding import epoch_index_plan
+from eventgrad_tpu.data.sharding import epoch_index_plan, epoch_steps
 
 _log = logging.getLogger(__name__)
 
@@ -79,7 +79,7 @@ class EpochPrefetcher:
         #: assembly did not predict (fell back to synchronous assembly)
         self.misses = 0
         # validates batch/shard sizes too (single source of truth)
-        self.steps = epoch_index_plan(len(x), n_ranks, batch_size).shape[1]
+        self.steps = epoch_steps(len(x), n_ranks, batch_size)
         #: ((first, last), thread, box) of the in-flight speculation
         self._pending: Optional[Tuple[Tuple[int, int], threading.Thread, dict]] = None
 

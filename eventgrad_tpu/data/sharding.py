@@ -49,6 +49,20 @@ def shard_random(n: int, n_ranks: int, seed: int = 0, epoch: int = 0) -> np.ndar
     return perm.reshape(n_ranks, per).astype(np.int64)
 
 
+def epoch_steps(n: int, n_ranks: int, batch_size: int) -> int:
+    """Steps per epoch, without materializing the index plan (same
+    full-batch truncation as `epoch_index_plan`; sampler-independent —
+    both shard to the same per-rank count)."""
+    per = _per_rank_count(n, n_ranks)
+    steps = per // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"batch_size {batch_size} larger than per-rank shard {per} "
+            f"({n} samples / {n_ranks} ranks)"
+        )
+    return steps
+
+
 def epoch_index_plan(
     n: int,
     n_ranks: int,
@@ -62,18 +76,12 @@ def epoch_index_plan(
     partial batches are dropped, matching the reference loaders'
     full-batch iteration. The single source of truth for epoch assembly —
     `batched_epoch` and `prefetch.EpochPrefetcher` both consume it."""
+    steps = epoch_steps(n, n_ranks, batch_size)
     shards = (
         shard_random(n, n_ranks, seed, epoch)
         if random
         else shard_sequential(n, n_ranks)
     )
-    per = shards.shape[1]
-    steps = per // batch_size
-    if steps == 0:
-        raise ValueError(
-            f"batch_size {batch_size} larger than per-rank shard {per} "
-            f"({n} samples / {n_ranks} ranks)"
-        )
     return shards[:, : steps * batch_size].reshape(n_ranks, steps, batch_size)
 
 

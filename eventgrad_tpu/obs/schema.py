@@ -135,6 +135,33 @@ RECORD_META_FIELDS = {
 }
 
 
+#: Elastic-membership surfaces (chaos/membership.py): per-epoch history
+#: record fields plus the Prometheus gauges `eventgrad_active_ranks` and
+#: `eventgrad_membership_transitions_total`. name -> (units, modes,
+#: description)
+MEMBERSHIP_FIELDS = {
+    "active_ranks": (
+        "int", "all",
+        "ranks alive during the record's dispatch block (constant "
+        "without membership; the elasticity trajectory with it) — also "
+        "a Prometheus gauge",
+    ),
+    "membership": (
+        "schedule dict", "membership runs",
+        "the serialized MembershipSchedule, stamped on the run's first "
+        "record (replayability rider, like `chaos`)",
+    ),
+    "membership_transitions": (
+        "records[transition]", "membership runs",
+        "transition info dicts (kind, epoch, index, src, "
+        "n_ranks_before/after, bootstrap_streamed, apply_s) on the "
+        "record FOLLOWING the block boundary they were applied at; "
+        "their cumulative count is the "
+        "membership_transitions_total gauge",
+    ),
+}
+
+
 #: derived series emitted by obs.report.build_report (tools/obs_report.py)
 REPORT_FIELDS = {
     "msgs_saved_pct_per_leaf": (
@@ -168,4 +195,5 @@ def all_field_names():
     """Every schema field name, for doc-coverage tests."""
     names = set(TELEMETRY_FIELDS) | set(RECORD_FIELDS)
     names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
+    names |= set(MEMBERSHIP_FIELDS)
     return sorted(names)

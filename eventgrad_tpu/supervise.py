@@ -14,10 +14,24 @@ Either way the child is killed and relaunched with `--resume`, restoring
 the full gossip TrainState (params, optimizer moments, event thresholds,
 stale neighbor buffers) from the latest orbax snapshot — so recovery costs
 at most one `--save-every` interval of recomputation. Pair with the train
-loop's `fault_inject` ("crash:N" / "hang:N") for end-to-end drills.
+loop's `fault_inject` ("crash:N" / "hang:N") for end-to-end drills, and
+with `--membership` schedules for elastic soak runs (tools/soak.py).
+
+Built for LONG-RUNNING soaks, not just drills:
+
+  * **sliding restart-budget window** (`--max-restarts N
+    --restart-window SEC`) — give up only when more than N restarts land
+    within any trailing SEC-second window, so a service that fails once
+    a day is not killed by a lifetime counter after N days
+    (`--restart-window 0` keeps the legacy lifetime budget);
+  * **exponential backoff with jitter** between relaunches
+    (`--backoff-base/--backoff-max/--backoff-jitter`) — a crash-looping
+    child does not hammer the machine (or its checkpoint store), and the
+    jitter decorrelates a fleet of supervisors restarting together.
 
 Usage:
-    python -m eventgrad_tpu.supervise --timeout 120 --max-restarts 3 -- \
+    python -m eventgrad_tpu.supervise --timeout 120 \
+        --max-restarts 3 --restart-window 3600 -- \
         --algo eventgrad --mesh ring:8 --dataset cifar10 --model resnet18 \
         --checkpoint-dir /ckpt --save-every 1 --log-file /logs/run.jsonl
 """
@@ -26,10 +40,58 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
+
+
+class RestartBudget:
+    """Sliding-window restart budget: allow at most `max_restarts`
+    restarts within any trailing `window_s` seconds. `window_s=0` means
+    a lifetime budget (the legacy `--max-restarts` counter). `now` is
+    injectable for tests."""
+
+    def __init__(
+        self, max_restarts: int, window_s: float = 0.0,
+        now: Callable[[], float] = time.time,
+    ):
+        if max_restarts < 0 or window_s < 0:
+            raise ValueError(
+                f"budget must be >= 0 (got {max_restarts}, {window_s})"
+            )
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self._now = now
+        self._fails: List[float] = []
+
+    def record_failure(self) -> bool:
+        """Register one failure; True = a restart is still within budget.
+        With a window, failures older than `window_s` roll off first."""
+        t = self._now()
+        if self.window_s:
+            self._fails = [f for f in self._fails if t - f < self.window_s]
+        self._fails.append(t)
+        return len(self._fails) <= self.max_restarts
+
+
+def backoff_delay(
+    consecutive_failures: int,
+    base: float = 1.0,
+    cap: float = 30.0,
+    jitter: float = 0.5,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Exponential backoff with jitter: `min(cap, base * 2^(k-1))` for
+    the k-th consecutive failure, scaled by `1 + jitter * U[0, 1)`.
+    `base=0` disables backoff entirely."""
+    if base <= 0 or consecutive_failures <= 0:
+        return 0.0
+    d = min(cap, base * (2.0 ** (consecutive_failures - 1)))
+    if jitter:
+        d *= 1.0 + jitter * (rng or random).random()
+    return d
 
 
 def _latest_mtime(path: str) -> float:
@@ -71,17 +133,38 @@ def supervise(
     max_restarts: int = 3,
     heartbeat: Optional[str] = None,
     poll_s: float = 0.5,
+    restart_window: float = 0.0,
+    backoff_base: float = 1.0,
+    backoff_max: float = 30.0,
+    backoff_jitter: float = 0.5,
+    _now: Callable[[], float] = time.time,
+    _sleep: Callable[[float], None] = time.sleep,
 ) -> int:
     """Run the CLI under supervision; returns the final exit code (0 on
     eventual success). `child_args` are eventgrad_tpu.cli flags and must
-    include --checkpoint-dir (restarts would lose all progress otherwise)."""
+    include --checkpoint-dir (restarts would lose all progress otherwise).
+
+    `restart_window` makes the budget sliding (`RestartBudget`): more
+    than `max_restarts` failures within any trailing window escalate;
+    0 keeps the lifetime counter. Between relaunches the supervisor
+    sleeps `backoff_delay(consecutive failures in the window)` —
+    exponential with jitter, capped; `backoff_base=0` disables. `_now`/
+    `_sleep` are injectable for tests (backoff only — the liveness poll
+    keeps real time)."""
     ckpt_dir = _flag_value(child_args, "--checkpoint-dir")
     if not ckpt_dir:
         raise SystemExit("supervise: child args must include --checkpoint-dir")
     heartbeat = heartbeat or _flag_value(child_args, "--log-file") or ckpt_dir
 
+    budget = RestartBudget(max_restarts, restart_window, now=_now)
     attempt = 0
+    # backoff exponent: CONSECUTIVE failures — a child that ran healthily
+    # past every backoff scale resets it, so a service failing once a day
+    # keeps restarting fast even under the lifetime (window=0) budget
+    consecutive = 0
+    backoff_reset_s = max(backoff_max, 60.0)
     while True:
+        t_launch = _now()
         argv = list(child_args)
         if attempt > 0 and "--resume" not in argv:
             argv.append("--resume")
@@ -116,19 +199,33 @@ def supervise(
         if rc == 0:
             return 0
         attempt += 1
+        if _now() - t_launch >= backoff_reset_s:
+            consecutive = 0
+        consecutive += 1
+        allowed = budget.record_failure()
         desc = reason or f"exit code {rc}"
         print(
             f"supervise: attempt {attempt} failed ({desc}); "
-            + ("restarting from latest snapshot" if attempt <= max_restarts
+            + ("restarting from latest snapshot" if allowed
                else "giving up"),
             file=sys.stderr, flush=True,
         )
-        if attempt > max_restarts:
+        if not allowed:
             if rc is None:
                 return 1
             # signal deaths (rc < 0) would wrap around in sys.exit; report
             # them the shell way
             return 128 + abs(rc) if rc < 0 else rc
+        delay = backoff_delay(
+            consecutive, base=backoff_base, cap=backoff_max,
+            jitter=backoff_jitter,
+        )
+        if delay:
+            print(
+                f"supervise: backing off {delay:.1f}s before relaunch",
+                file=sys.stderr, flush=True,
+            )
+            _sleep(delay)
 
 
 def main(argv=None) -> int:
@@ -140,6 +237,21 @@ def main(argv=None) -> int:
                    help="seconds without heartbeat progress before the child "
                         "is declared hung and killed (0 = crash detection only)")
     p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--restart-window", type=float, default=0.0,
+                   metavar="SEC",
+                   help="sliding budget window: give up only when more "
+                        "than --max-restarts failures land within any "
+                        "trailing SEC seconds (0 = lifetime counter, the "
+                        "legacy behavior)")
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="first-relaunch delay in seconds; doubles per "
+                        "consecutive failure in the window (0 = no "
+                        "backoff)")
+    p.add_argument("--backoff-max", type=float, default=30.0,
+                   help="backoff delay cap in seconds")
+    p.add_argument("--backoff-jitter", type=float, default=0.5,
+                   help="multiplicative jitter J: delays scale by "
+                        "1 + J*U[0,1) to decorrelate fleet restarts")
     p.add_argument("--heartbeat", default=None,
                    help="file/dir whose mtime is the liveness signal "
                         "(default: the child's --log-file, else its "
@@ -154,7 +266,9 @@ def main(argv=None) -> int:
         raise SystemExit("supervise: pass CLI flags after --")
     return supervise(
         child, timeout=args.timeout, max_restarts=args.max_restarts,
-        heartbeat=args.heartbeat,
+        heartbeat=args.heartbeat, restart_window=args.restart_window,
+        backoff_base=args.backoff_base, backoff_max=args.backoff_max,
+        backoff_jitter=args.backoff_jitter,
     )
 
 

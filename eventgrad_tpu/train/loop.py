@@ -27,13 +27,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from eventgrad_tpu.chaos import membership as chaos_membership
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos import schedule as chaos_schedule
 from eventgrad_tpu.chaos.policy import RecoveryPolicy
 from eventgrad_tpu.obs import OBS_MODES
 from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
-from eventgrad_tpu.data.sharding import epoch_index_plan
+from eventgrad_tpu.data.sharding import epoch_index_plan, epoch_steps
 from eventgrad_tpu.parallel import collectives, multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
@@ -260,6 +261,7 @@ def train(
     fault_inject: Optional[str] = None,
     chaos: Optional[Any] = None,
     chaos_policy: Optional[RecoveryPolicy] = None,
+    membership: Optional[Any] = None,
     on_epoch: Optional[Any] = None,
     device_data: Optional[bool] = None,
     epochs_per_dispatch: int = 1,
@@ -310,6 +312,28 @@ def train(
     injected-drop counts, and a consensus-error probe at dispatch-block
     ends; the first record carries the serialized schedule so the run is
     replayable from its log alone. See docs/chaos.md.
+
+    membership (a chaos.MembershipSchedule, spec string like
+    "leave=1@3,join=1@5", or serialized dict — also liftable from a
+    chaos spec's join=/leave= clauses) runs the run under the ELASTIC
+    membership engine (chaos/membership.py): at the end of each named
+    epoch (a dispatch-block boundary — membership pins one-epoch blocks,
+    so the fused step never sees a dynamic shape) a rank leaves (ring
+    heal generalized to a clean N->N-1 re-slice) or a newcomer joins
+    (N->N+1: its full gossip TrainState row bootstraps from a neighbor's
+    snapshot streamed through utils/checkpoint.host_snapshot +
+    AsyncWriter — on disk under `<checkpoint_dir>/bootstrap` when a
+    checkpoint_dir exists, in host memory otherwise; bitwise either
+    way), and the next pass force-fires every exchange so stale buffers
+    refresh in one cycle. The data shards, step program, and prefetcher
+    rebuild for the new rank count (one extra jit compile per
+    transition). Deterministic and replayable: the schedule rides the
+    first history record (like chaos), and replaying it reproduces the
+    final state bitwise. Every record carries `active_ranks`; the record
+    after a transition carries `membership_transitions`. Single-process
+    plain-ring gossip runs only (dpsgd/eventgrad, mesh=None, no
+    device_data/trace_file; pipeline forced off — transitions mutate
+    state between blocks). See docs/chaos.md "Membership & elasticity".
 
     gossip_wire="compact" (eventgrad only) switches the exchange to the
     budgeted compacted wire (collectives.compact_neighbor_vals) once
@@ -420,6 +444,90 @@ def train(
         if fault_mode not in ("crash", "hang") or not n.isdigit():
             raise ValueError(f"bad fault_inject spec {fault_inject!r}")
         fault_epoch = int(n)
+    ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+
+    # --- elastic membership resolution (chaos/membership.py) -----------
+    memb_sched = (
+        chaos_membership.resolve(membership) if membership is not None
+        else None
+    )
+    if chaos_sched is not None and chaos_sched.membership:
+        inline = chaos_sched.membership_schedule()
+        if memb_sched is not None and not memb_sched.is_noop:
+            # identical events are NOT a conflict: a chaos-inline run
+            # stamps both riders (rec["membership"] and the chaos dict's
+            # embedded join=/leave= clauses), and a replay from its own
+            # log feeds both back — tools/soak.py's replay leg does
+            if memb_sched.events != inline.events:
+                raise ValueError(
+                    "membership events arrived both via membership= and "
+                    "the chaos spec's join=/leave= clauses, and they "
+                    "disagree; pass one schedule"
+                )
+        memb_sched = inline
+    memb_on = memb_sched is not None and not memb_sched.is_noop
+    memb_engine = None
+    memb_raw = None  # peeked snapshot: reused by the resume restore below
+    if memb_on:
+        if algo not in ("dpsgd", "eventgrad"):
+            raise ValueError(
+                "membership transitions ride the gossip exchange "
+                f"(dpsgd, eventgrad); got algo={algo!r}"
+            )
+        if len(topo.axes) != 1 or topo.gossip_axes != topo.axes:
+            raise ValueError(
+                "membership transitions handle single-axis gossip rings; "
+                f"got axes {topo.axes}"
+            )
+        if mesh is not None or multihost.is_multiprocess():
+            raise ValueError(
+                "membership needs the single-process vmap path (a "
+                "transition re-shapes the stacked state between blocks)"
+            )
+        if trace_file:
+            raise ValueError(
+                "trace_file carries rank-shaped recv staleness; not "
+                "available under membership transitions"
+            )
+        if chaos_sched is not None and chaos_sched.death:
+            # die= is rank-indexed INSIDE the traced step; a transition
+            # re-slices the stacked rows, silently retargeting the death
+            # to a different worker — use a membership leave instead
+            raise ValueError(
+                "chaos die= events are rank-indexed in the traced step "
+                "and do not compose with membership re-indexing; script "
+                "the removal as a membership leave= event"
+            )
+        # fail fast on a schedule that ever shrinks the ring below 2 or
+        # names an index/src outside the ring it will meet
+        memb_sched.validate(topo.n_ranks)
+        beyond = [e for e in memb_sched.events if e.epoch > epochs]
+        if beyond:
+            # legal (the interrupted first leg of a longer schedule runs
+            # exactly this way, then a resume completes it) but worth a
+            # flag: these events will not apply in THIS run
+            import warnings
+            warnings.warn(
+                f"{len(beyond)} membership event(s) land beyond "
+                f"epochs={epochs} (first: {beyond[0].kind}@"
+                f"{beyond[0].epoch}) and will not apply in this run",
+                RuntimeWarning,
+            )
+        memb_base_n = topo.n_ranks  # pre-schedule ring size
+        # resume: the snapshot's rank count follows from the membership
+        # log at its saved epoch — peek the epoch, then build state (and
+        # everything downstream) at that topology
+        if ckpt_path and resume:
+            found0 = checkpoint.latest(ckpt_path)
+            if found0:
+                # one deserialization serves both the epoch peek and the
+                # full restore below (raw= short-circuits the disk read)
+                memb_raw = checkpoint.peek(found0)
+                ep0 = int(np.asarray(memb_raw["epoch"]))
+                topo = memb_sched.topology_at(topo, ep0)
+        memb_engine = chaos_membership.MembershipEngine(
+            memb_sched, event_cfg=event_cfg, bootstrap_dir=checkpoint_dir,
+        )
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
 
     # data shards across the data axes (gossip + any declared ddp
@@ -532,7 +640,7 @@ def train(
     # --- dispatch-pipeline resolution (docs/ARCHITECTURE.md): auto = on
     # wherever the serialized host chain is the only thing it removes
     if pipeline is None:
-        pipeline_on = not multi and fault_mode is None
+        pipeline_on = not multi and fault_mode is None and not memb_on
     else:
         pipeline_on = bool(pipeline)
         if pipeline_on and multi:
@@ -548,7 +656,12 @@ def train(
                 "land at an exact post-snapshot epoch boundary, which "
                 "needs the serial schedule); use pipeline=None/False"
             )
-    ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+        if pipeline_on and memb_on:
+            raise ValueError(
+                "pipeline=True cannot honor membership transitions (they "
+                "re-shape the state between blocks, which needs the "
+                "serial schedule); use pipeline=None/False"
+            )
     # shape metadata only — never dispatch a device op just to count
     n_params = trees.tree_count_params(state.params) // topo.n_ranks
     sz = trees.tree_num_leaves(state.params)
@@ -573,11 +686,13 @@ def train(
                         found,
                         {"state": tmpl_state, "epoch": np.int64(0),
                          "trace_carry": trace_carry},
+                        raw=memb_raw,
                     )
                     return r, r["trace_carry"]
                 except Exception:
                     return checkpoint.restore(
-                        found, {"state": tmpl_state, "epoch": np.int64(0)}
+                        found, {"state": tmpl_state, "epoch": np.int64(0)},
+                        raw=memb_raw,
                     ), None
 
             def _attempt(tmpl_state):
@@ -593,6 +708,7 @@ def train(
                         found,
                         {"state": tmpl_state, "epoch": np.int64(0),
                          "trace_carry": trace_carry},
+                        raw=memb_raw,
                     )
                     # ONLY known-added fields may fill from init —
                     # anything else missing (opt_state restructured,
@@ -652,7 +768,9 @@ def train(
                 arena_on = False
             if carry is not None:
                 trace_carry = carry
-            else:
+            elif not memb_on:
+                # membership snapshots deliberately omit the rank-shaped
+                # carry (trace_file is unsupported there) — not a loss
                 warnings.warn(
                     "checkpoint has no restorable trace_carry; "
                     "recv-trace staleness restarts from zeros"
@@ -693,7 +811,7 @@ def train(
     # eligibility: the single-process vmap/single-mesh path only — hybrid
     # meshes reshape/slice batches per rank (expand_to_mesh) and multihost
     # runs place shards across processes; both keep the host path.
-    eligible = mesh is None and not hybrid and not multi
+    eligible = mesh is None and not hybrid and not multi and not memb_on
     data_bytes = np.asarray(x_train).size * 4  # post-cast f32/int32 bytes
     if device_data is None:
         device_data = (
@@ -706,11 +824,15 @@ def train(
     elif device_data and not eligible:
         raise ValueError(
             "device_data requires the single-process, non-hybrid, "
-            "mesh=None path (hybrid/multihost runs shard batches on host)"
+            "mesh=None path without membership transitions (hybrid/"
+            "multihost runs shard batches on host; membership re-shards "
+            "the resident plan per transition)"
         )
     K = max(1, int(epochs_per_dispatch))
     if fault_mode is not None:
         K = 1  # the fault must land at an exact epoch boundary
+    if memb_on:
+        K = 1  # every epoch end is a block boundary a transition can use
     if obs == "epoch":
         # per-epoch telemetry wants every epoch to BE a block end; the
         # flush stays once-per-dispatch — it is the dispatch that shrinks
@@ -784,9 +906,7 @@ def train(
             np.ascontiguousarray(x_train, input_cast_dtype(x_train))
         )
         y_dev = jnp.asarray(np.ascontiguousarray(y_train, np.int32))
-        steps_per_epoch = epoch_index_plan(
-            len(x_train), n_data, batch_size
-        ).shape[1]
+        steps_per_epoch = epoch_steps(len(x_train), n_data, batch_size)
     else:
         # plain single-process path: the prefetcher worker also runs the
         # device_put, so block B+1's stacked arrays land on device while
@@ -853,6 +973,23 @@ def train(
     # back-to-back device time, and with the pipe empty (serial mode) it
     # reduces to the old dispatch-to-block_until_ready measurement
     last_ready_t = float("-inf")
+    # pass bookkeeping rides hw per block instead of closed-over
+    # arithmetic: under membership the steps-per-epoch and rank count
+    # change at transitions (without membership the values are identical
+    # to the old start_passes + (epoch - start_epoch) * steps form)
+    passes_done = start_passes
+    rank_passes_done = start_passes * topo.n_ranks
+    if memb_on and start_epoch > 0:
+        # resumed elastic run: the rank count (and steps/epoch) varied
+        # over the resumed history — reconstruct cumulative rank-passes
+        # from the schedule so msgs_saved_pct matches the uninterrupted
+        # run's denominators exactly
+        rank_passes_done = sum(
+            epoch_steps(len(x_train), nr, batch_size) * nr
+            for e in range(1, start_epoch + 1)
+            for nr in (memb_sched.n_ranks_at(memb_base_n, e - 1),)
+        )
+    memb_recs_pending: List[Dict[str, Any]] = []
 
     def _drain(hw: Dict[str, Any]) -> None:
         """Run one block's host work: metrics readback, telemetry flush,
@@ -869,6 +1006,10 @@ def train(
         blk_i, blk_start, blk_end = hw["blk_i"], hw["blk_start"], hw["blk_end"]
         n_e = blk_end - blk_start + 1
         mode_now, cold, label_shape = hw["mode"], hw["cold"], hw["label_shape"]
+        # rank count / pass base AT DISPATCH TIME: under membership the
+        # topology changes between blocks, so every per-block quantity
+        # rides hw instead of reading the loop's current topo
+        n_ranks_blk, n_nb_blk = hw["n_ranks"], hw["n_nb"]
         with _span("block_ready", cat="device", block=blk_i):
             jax.block_until_ready(hw["m"])
         # stamp readiness BEFORE the metrics D2H copy: wall_s measures
@@ -905,18 +1046,18 @@ def train(
                     "silence_buckets": int(
                         np.asarray(tel_host.silence_hist).shape[-1]
                     ),
-                    "n_ranks": topo.n_ranks,
-                    "n_neighbors": topo.n_neighbors,
+                    "n_ranks": n_ranks_blk,
+                    "n_neighbors": n_nb_blk,
                     "wire": wire or ("bf16" if wire_bf16 else None),
                 }
                 obs_meta_pending = False
 
         # block metrics are [n_e * steps, n_ranks]; split per epoch
-        steps = steps_per_epoch
+        steps = hw["steps"]
         for j, epoch in enumerate(range(blk_start, blk_end + 1)):
             sl = slice(j * steps, (j + 1) * steps)
             m_e = {k: np.asarray(v)[sl] for k, v in m.items()}
-            total_passes = start_passes + (epoch - start_epoch) * steps
+            total_passes = hw["pass_base"] + (j + 1) * steps
             rec = {
                 "epoch": epoch,
                 "algo": algo,
@@ -929,11 +1070,14 @@ def train(
                 "dispatch_cold": cold,
                 "wall_s": dt / n_e,
                 "loss": float(m_e["loss"].mean()),
+                # ranks alive during this block (membership elasticity:
+                # the per-epoch active-rank count, docs/OBSERVABILITY.md)
+                "active_ranks": n_ranks_blk,
                 # targets per step per rank: batch for classification,
                 # batch x t_local for LM (correct counts tokens
                 # elementwise)
                 "train_acc": 100.0 * float(m_e["correct"].sum())
-                / (topo.n_ranks * steps * int(np.prod(label_shape) or 1)),
+                / (n_ranks_blk * steps * int(np.prod(label_shape) or 1)),
                 "sent_bytes_per_step_per_chip": float(
                     m_e["sent_bytes"][..., 0].mean()
                 ),
@@ -958,11 +1102,29 @@ def train(
                 # sz) fired
                 events_total = int(m_e["num_events"][-1].sum())
                 rec["num_events"] = events_total
-                rec["msgs_saved_pct"] = msgs_saved_pct(
-                    events_total, total_passes, sz, topo.n_neighbors,
-                    topo.n_ranks,
-                )
+                if memb_on:
+                    # elastic denominator: cumulative RANK-passes (the
+                    # rank count varied); approximate — a departed rank
+                    # takes its event count with it, a newcomer starts
+                    # at zero (chaos/membership.py docstring)
+                    rec["msgs_saved_pct"] = msgs_saved_pct(
+                        events_total,
+                        hw["rank_base"] + (j + 1) * steps * n_ranks_blk,
+                        sz, n_nb_blk, 1,
+                    )
+                else:
+                    rec["msgs_saved_pct"] = msgs_saved_pct(
+                        events_total, total_passes, sz, n_nb_blk,
+                        n_ranks_blk,
+                    )
                 rec["fired_frac"] = float(m_e["fired_frac"].mean())
+            if memb_on:
+                if not history:  # replayability: the membership log
+                    # alone reproduces the final state bitwise
+                    rec["membership"] = memb_sched.to_dict()
+                if j == 0 and hw.get("memb_recs"):
+                    # transitions applied at the previous block boundary
+                    rec["membership_transitions"] = hw["memb_recs"]
             if chaos_sched is not None:
                 if not history:  # replayability: schedule rides record 1
                     rec["chaos"] = chaos_sched.to_dict()
@@ -1021,6 +1183,15 @@ def train(
             history.append(rec)
             if on_epoch is not None:  # live metrics (liveness signal)
                 on_epoch(rec)
+        if registry is not None:
+            # Prometheus faces of the elasticity story: the live rank
+            # count and the cumulative transition counter
+            registry.gauge("active_ranks", n_ranks_blk)
+            if memb_engine is not None:
+                registry.gauge(
+                    "membership_transitions_total",
+                    float(len(memb_engine.log)),
+                )
         if not compact_done:
             # collect post-warmup fired sizes from this block; once
             # enough are in (or warmup is past, with an explicit
@@ -1028,13 +1199,18 @@ def train(
             # [n_e*steps, n_ranks]: the capacity is one static number
             # shared by every rank, so the peak is taken across ranks
             fe = np.asarray(m["fired_elems"])
-            blk_pass_base = (
-                start_passes + (blk_start - 1 - start_epoch) * steps
-            )
-            pnums = blk_pass_base + 1 + np.arange(fe.shape[0])
+            pnums = hw["pass_base"] + 1 + np.arange(fe.shape[0])
             # warm is pass_num < warmup_passes (events.propose), so
             # pass == warmup_passes is already real trigger data
-            post = fe[pnums >= warmup_passes]
+            keep = pnums >= warmup_passes
+            if hw.get("memb_recs"):
+                # a block that opens with a membership force-fire is
+                # transient: the full-fire rewire pass, then a couple
+                # of passes of threshold re-adaptation — sampling it
+                # sizes the budget toward the whole model and silently
+                # disables compaction. Resume sampling next block.
+                keep[:] = False
+            post = fe[keep]
             if post.size:
                 compact_fired_peak = max(
                     compact_fired_peak, float(post.max())
@@ -1094,8 +1270,11 @@ def train(
             # steady-state step math can exclude them (the tail-remainder
             # block recompiles too, not just block 0)
             mode_now = "compact" if compact_capacity is not None else "dense"
-            cold = (n_e, mode_now) not in seen_block_sizes
-            seen_block_sizes.add((n_e, mode_now))
+            # the rank count is part of the compiled shape too: a
+            # membership transition recompiles even at an already-seen
+            # block size
+            cold = (n_e, mode_now, topo.n_ranks) not in seen_block_sizes
+            seen_block_sizes.add((n_e, mode_now, topo.n_ranks))
             label_shape: Tuple[int, ...] = ()
             with _span("data", cat="host", block=blk_i):
                 if device_data:
@@ -1172,7 +1351,16 @@ def train(
                 "eval_fut": eval_fut, "label_shape": label_shape,
                 "mode": mode_now, "cold": cold, "state": state,
                 "t_dispatched": t0,
+                "steps": steps_per_epoch,
+                "pass_base": passes_done,
+                "rank_base": rank_passes_done,
+                "n_ranks": topo.n_ranks,
+                "n_nb": topo.n_neighbors,
+                "memb_recs": memb_recs_pending or None,
             }
+            memb_recs_pending = []
+            passes_done += n_e * steps_per_epoch
+            rank_passes_done += n_e * steps_per_epoch * topo.n_ranks
             if pending is not None:  # previous block's deferred host work
                 _drain(pending)
                 pending = None
@@ -1188,6 +1376,63 @@ def train(
                 _drain(hw)
             else:
                 pending = hw
+            if memb_engine is not None:
+                # elastic membership transitions land HERE: after the
+                # block's host work drained (membership forces the serial
+                # schedule) and BEFORE any checkpoint, so snapshots are
+                # always post-transition — a resume at epoch E rebuilds
+                # the topology from every event with epoch <= E
+                for ev in memb_engine.events_at(blk_end):
+                    state, topo, info = memb_engine.apply(state, topo, ev)
+                    memb_recs_pending.append(info)
+                    if registry is not None:
+                        # last-write-wins: keep the cumulative gauge
+                        # current even for a final-epoch transition (no
+                        # drain runs after it)
+                        registry.gauge(
+                            "membership_transitions_total",
+                            float(len(memb_engine.log)),
+                        )
+                    if obs_prev is not None:
+                        # the telemetry diff base tracks the device
+                        # state's row layout (newcomer counters start 0)
+                        obs_prev = (
+                            chaos_membership.take_rows_host(
+                                obs_prev, tuple(info["survivors"])
+                            )
+                            if ev.kind == "leave"
+                            else chaos_membership.insert_zero_row_host(
+                                obs_prev, ev.index
+                            )
+                        )
+                if memb_recs_pending and blk_end < epochs:
+                    # the rank count changed: rebuild the data shards and
+                    # the jitted runners for the new topology (one fresh
+                    # compile per transition — the price of keeping every
+                    # dispatched shape static). A final-epoch transition
+                    # skips the rebuild (nothing left to dispatch): it
+                    # exists for resume continuity — the final snapshot
+                    # is post-transition and the force-fire cycle runs on
+                    # the resumed run's first pass
+                    n_data = topo.n_data_ranks
+                    if prefetcher is not None:
+                        prefetcher.close()
+                        prefetcher = EpochPrefetcher(
+                            x_train, y_train, n_data, batch_size,
+                            random=random_sampler, seed=seed,
+                            last_epoch=epochs, transfer=transfer,
+                        )
+                        steps_per_epoch = prefetcher.steps
+                    run_epoch, run_epoch_idx = _build_runners(
+                        spmd(
+                            _build_step(
+                                "compact" if compact_capacity is not None
+                                else "dense",
+                                compact_capacity,
+                            ),
+                            topo, mesh=mesh,
+                        )
+                    )
             if ckpt_due:
                 if pipeline_on:
                     # eager device->host snapshot (owned copies — later
@@ -1215,14 +1460,17 @@ def train(
                         save_state = (
                             multihost.to_host(state) if multi else state
                         )
-                        checkpoint.save(
-                            ckpt_path,
-                            {
-                                "state": save_state,
-                                "epoch": np.int64(blk_end),
-                                "trace_carry": trace_carry,
-                            },
-                        )
+                        payload = {
+                            "state": save_state,
+                            "epoch": np.int64(blk_end),
+                        }
+                        if not memb_on:
+                            # the recv-trace carry is rank-shaped; the
+                            # elastic run (trace_file unsupported there)
+                            # omits it so a resume can re-shape the
+                            # template from the membership log alone
+                            payload["trace_carry"] = trace_carry
+                        checkpoint.save(ckpt_path, payload)
             if blk_end == fault_epoch:  # pipeline off under fault_inject
                 if fault_mode == "crash":
                     os._exit(13)
@@ -1231,6 +1479,15 @@ def train(
         if pending is not None:
             _drain(pending)
             pending = None
+        if memb_recs_pending and history:
+            # transitions at the FINAL epoch boundary have no next block
+            # record to ride: attach them to the returned history's last
+            # record so the in-process log stays complete (the JSONL
+            # stream already emitted that line — its readers replay from
+            # the schedule rider, which names every event regardless)
+            history[-1].setdefault("membership_transitions", [])
+            history[-1]["membership_transitions"] += memb_recs_pending
+            memb_recs_pending = []
         if ckpt_writer is not None:
             ckpt_writer.wait()  # on-exit join barrier; re-raises errors
     finally:

@@ -143,9 +143,29 @@ def latest(path: str) -> Optional[str]:
     return None
 
 
-def restore(path: str, template: Any) -> Any:
+def peek(path: str) -> Any:
+    """Template-free raw restore -> host numpy pytree. Restores the WHOLE
+    snapshot (orbax has no partial read here), so use it only where the
+    shape of the snapshot is itself unknown — e.g. a membership-elastic
+    resume must read the saved epoch before it can size the state
+    template (the rank count at that epoch follows from the membership
+    schedule; train/loop.py)."""
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(path)
+
+
+def restore(path: str, template: Any, raw: Any = None) -> Any:
     """Restore into the structure of `template` (an abstract or concrete
-    TrainState with the same shapes/dtypes)."""
+    TrainState with the same shapes/dtypes). `raw` (a `peek` of the same
+    snapshot) grafts from the already-deserialized pytree instead of
+    re-reading disk — exact-structure like the orbax item restore: a
+    template leaf the snapshot lacks raises."""
+    if raw is not None:
+        restored, missing = _graft(raw, template)
+        if missing:
+            raise ValueError(f"snapshot lacks leaves {missing}")
+        return restored
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         target = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
@@ -162,17 +182,25 @@ def _path_name(keypath) -> str:
     )
 
 
-def restore_with_fill(path: str, template: Any):
+def restore_with_fill(path: str, template: Any, raw: Any = None):
     """Forward-compatible restore: snapshot leaves graft onto `template`
     BY PATH, and any leaf the snapshot lacks keeps its template (init)
     value — so a state field added after the snapshot was taken (e.g. a
     new counter) resumes from its initial value instead of failing the
     exact-structure match `restore` enforces. Returns (restored,
     missing_path_names); the caller decides how loud to be about the
-    fills. A snapshot leaf with no template counterpart is ignored."""
-    path = os.path.abspath(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        raw = ckptr.restore(path)
+    fills. A snapshot leaf with no template counterpart is ignored.
+    `raw` (a `peek` of the same snapshot) skips the disk read."""
+    if raw is None:
+        path = os.path.abspath(path)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            raw = ckptr.restore(path)
+    return _graft(raw, template)
+
+
+def _graft(raw: Any, template: Any):
+    """Path-keyed graft of a template-free restore onto `template`:
+    (leaves filled in template order, missing template path names)."""
     raw_map = {
         _path_name(kp): v
         for kp, v in jax.tree_util.tree_flatten_with_path(raw)[0]

@@ -28,6 +28,9 @@ def test_repo_artifacts_all_valid():
     # the dispatch-pipeline proof must be committed AND schema-gated
     # (pipelined-vs-serial bubble ratio < 1.0, bitwise_state true)
     assert "pipeline_bubble_cpu.json" in names
+    # the elastic-membership soak proof (ISSUE 6): >= 6 transitions,
+    # zero escalations, bounded recovery, bitwise replay, <= 0.5 pt gap
+    assert "soak_cpu.json" in names
     assert out["errors"] == []
 
 

@@ -1,0 +1,63 @@
+"""Soak harness (tools/soak.py, ISSUE 6): the supervised
+kill/join/leave/flaky schedule survives, recovers within one save
+interval, replays bitwise, and emits a SOAK_SCHEMA-valid artifact.
+
+The smoke leg runs the full pipeline (baseline + supervised-kill
+subprocess + replay) at a reduced op point — ~60-90 s on the shared CPU,
+tier-1 eligible; the full op point (the committed artifacts/soak_cpu.json
+geometry) sits behind the `slow` marker.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check(out):
+    va = _load_tool("validate_artifacts")
+    assert va.validate(out, va.SOAK_SCHEMA) == [], out
+    # the claims the schema gates, asserted directly for a readable
+    # failure: transitions survived (active_ranks tracked the schedule),
+    # zero escalations, bounded recovery, bitwise replay, accuracy gap
+    assert out["supervisor_escalations"] == 0
+    assert out["supervisor_restarts"] >= 1
+    assert out["active_ranks_verified"] is True
+    assert out["recovery_ok"] is True
+    assert out["replay_bitwise"] is True
+    assert out["n_transitions"] >= 6 and out["n_joins"] >= 2
+    assert out["final_acc_gap_pt"] <= 0.5
+
+
+def test_soak_smoke_schema_valid(tmp_path):
+    soak = _load_tool("soak")
+    out = soak.run_soak(
+        str(tmp_path / "soak.json"), mode="smoke",
+        workdir=str(tmp_path / "w"),
+    )
+    _check(out)
+    assert os.path.exists(str(tmp_path / "soak.json"))
+
+
+@pytest.mark.slow
+def test_soak_full_schedule(tmp_path):
+    soak = _load_tool("soak")
+    out = soak.run_soak(
+        str(tmp_path / "soak.json"), mode="full",
+        workdir=str(tmp_path / "w"),
+    )
+    _check(out)
+    assert out["n_transitions"] >= 8

@@ -112,6 +112,115 @@ def test_supervisor_requires_checkpoint_dir(tmp_path):
         supervise(["--algo", "dpsgd"])
 
 
+# --- sliding restart-budget window + backoff (ISSUE 6 satellite) --------
+
+
+def test_restart_budget_lifetime_and_window():
+    from eventgrad_tpu.supervise import RestartBudget
+
+    # window 0 = lifetime counter (legacy --max-restarts semantics)
+    clock = iter(float(t) for t in range(100)).__next__
+    b = RestartBudget(2, 0.0, now=clock)
+    assert b.record_failure() and b.record_failure()
+    assert not b.record_failure()  # 3rd failure ever: escalate
+
+    # sliding window: old failures roll off, a once-a-day crasher lives
+    times = iter([0.0, 5.0, 100.0, 103.0, 106.0]).__next__
+    w = RestartBudget(2, 10.0, now=times)
+    assert w.record_failure()          # t=0
+    assert w.record_failure()          # t=5: 2 in window, at budget
+    assert w.record_failure()          # t=100: both rolled off
+    assert w.record_failure()          # t=103: 2 in window
+    assert not w.record_failure()      # t=106: 3 within 10s -> escalate
+    with pytest.raises(ValueError):
+        RestartBudget(-1)
+
+
+def test_backoff_delay_doubles_caps_and_jitters():
+    from eventgrad_tpu.supervise import backoff_delay
+
+    import random
+
+    no_jit = [backoff_delay(k, base=1.0, cap=8.0, jitter=0.0)
+              for k in range(1, 7)]
+    assert no_jit == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]  # doubles, then caps
+    assert backoff_delay(3, base=0.0) == 0.0  # disabled
+    assert backoff_delay(0) == 0.0
+    rng = random.Random(7)
+    jittered = backoff_delay(2, base=1.0, cap=8.0, jitter=0.5, rng=rng)
+    assert 2.0 <= jittered <= 3.0  # 2 * (1 + 0.5*U[0,1))
+
+
+class _FakeProc:
+    """A child that exits instantly with a scripted return code."""
+
+    def __init__(self, rc):
+        self.returncode = rc
+
+    def poll(self):
+        return self.returncode
+
+
+def _run_fake_supervise(monkeypatch, rcs, **kw):
+    """Drive supervise() against scripted child exits; returns
+    (final rc, argv per attempt, backoff sleeps)."""
+    from eventgrad_tpu import supervise as sup
+
+    codes = iter(rcs)
+    launches, sleeps = [], []
+
+    def fake_popen(cmd, *a, **k):
+        launches.append(cmd)
+        return _FakeProc(next(codes))
+
+    monkeypatch.setattr(sup.subprocess, "Popen", fake_popen)
+    clock = iter(float(t) for t in range(0, 10000, kw.pop("dt", 1))).__next__
+    rc = sup.supervise(
+        ["--checkpoint-dir", "/tmp/nonexistent-ck"],
+        _now=clock, _sleep=sleeps.append, **kw,
+    )
+    return rc, launches, sleeps
+
+
+def test_supervise_backoff_between_relaunches(monkeypatch):
+    rc, launches, sleeps = _run_fake_supervise(
+        monkeypatch, [7, 7, 0], max_restarts=5,
+        backoff_base=0.5, backoff_max=4.0, backoff_jitter=0.0,
+    )
+    assert rc == 0 and len(launches) == 3
+    assert sleeps == [0.5, 1.0]  # exponential, one per failed attempt
+    # every relaunch resumes from the snapshot
+    assert all("--resume" in cmd for cmd in launches[1:])
+
+
+def test_supervise_sliding_window_outlives_lifetime_budget(monkeypatch):
+    """With a sliding window, spaced-out failures never accumulate: a
+    run that fails more times than max_restarts IN TOTAL still finishes,
+    as long as no window ever holds more than the budget."""
+    rc, launches, _ = _run_fake_supervise(
+        monkeypatch, [1, 1, 1, 0], max_restarts=1, restart_window=2.0,
+        dt=5, backoff_base=0.0,
+    )
+    assert rc == 0 and len(launches) == 4  # 3 failures > lifetime budget
+
+    # same failure pattern under the lifetime counter: gives up after 1
+    rc2, launches2, _ = _run_fake_supervise(
+        monkeypatch, [1, 1, 1, 0], max_restarts=1, restart_window=0.0,
+        dt=5, backoff_base=0.0,
+    )
+    assert rc2 == 1 and len(launches2) == 2
+
+
+def test_supervise_window_burst_escalates(monkeypatch):
+    """A crash loop (failures faster than the window drains) exhausts
+    the sliding budget and escalates with the child's exit code."""
+    rc, launches, _ = _run_fake_supervise(
+        monkeypatch, [9, 9, 9, 9], max_restarts=2, restart_window=100.0,
+        dt=1, backoff_base=0.0,
+    )
+    assert rc == 9 and len(launches) == 3
+
+
 def test_crash_recovery_hybrid_lm(tmp_path):
     """Elastic recovery composes with hybrid meshes: a dp x sp
     ring-attention LM run crash-injected after epoch 1 is restarted from

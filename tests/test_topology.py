@@ -1,3 +1,5 @@
+import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from eventgrad_tpu.parallel.topology import Ring, Torus
@@ -23,3 +25,97 @@ def test_degenerate_axis_has_no_neighbors():
     assert topo.n_neighbors == 0
     topo = Torus(4, 1)
     assert topo.n_neighbors == 2  # only the size-4 axis gossips
+
+
+# --- Ring(2) degenerate: both shifts resolve to the SAME peer ----------
+# (ISSUE 6 satellite: heal-to-2 / join-from-2 must not double-count that
+# peer in mix_weighted.) Verified semantics: the reference ships TWO puts
+# on a 2-ring and weighs 1/3 (topology.neighbors keeps both shifts), so
+# the uniform mix intentionally sees the peer twice — that is reference
+# parity, mean-preserving, and what a fresh Ring(2) run does. What must
+# NOT happen is a HALF-counted peer under gating: both directed edges
+# share one source, so their health/delivery state agrees and
+# mix_weighted's renormalization either keeps the peer (weight over the
+# alive edge count) or drops it entirely — pinned below.
+
+
+def test_ring2_both_shifts_same_peer():
+    topo = Ring(2)
+    assert topo.n_neighbors == 2  # two puts, like the reference
+    assert topo.mix_weight == pytest.approx(1 / 3)
+    srcs = [
+        [topo.neighbor_source(r, nb) for nb in topo.neighbors]
+        for r in range(2)
+    ]
+    assert srcs == [[1, 1], [0, 0]]  # -1 and +1 are the same rank
+
+
+def test_ring2_heal_is_exactly_ring2():
+    """Heal-to-2 hands downstream collectives EXACTLY Ring(2): same
+    neighbor specs, same (shared-peer) sources, same 1/3 weight — no
+    special case for the degenerate size."""
+    from eventgrad_tpu.chaos.policy import heal_ring
+
+    healed, survivors = heal_ring(Ring(3), {1})
+    ref = Ring(2)
+    assert survivors == (0, 2)
+    assert healed.n_ranks == 2 and healed.n_neighbors == 2
+    assert healed.mix_weight == ref.mix_weight
+    for r in range(2):
+        for nb_h, nb_r in zip(healed.neighbors, ref.neighbors):
+            assert healed.neighbor_source(r, nb_h) == ref.neighbor_source(
+                r, nb_r
+            )
+
+
+def test_ring2_mix_counts_peer_per_reference_two_puts():
+    """Uniform mix on Ring(2): (p + q + q) / 3 — the reference's two-put
+    semantics, mean-preserving (sum over ranks is conserved)."""
+    from eventgrad_tpu.parallel import collectives
+    from eventgrad_tpu.parallel.spmd import spmd
+
+    topo = Ring(2)
+    p = jnp.array([3.0, 9.0])
+
+    def fn(pp):
+        return collectives.mix(pp, collectives.neighbor_vals(pp, topo), topo)
+
+    out = np.asarray(spmd(fn, topo)(p))
+    np.testing.assert_allclose(out, [(3 + 9 + 9) / 3, (9 + 3 + 3) / 3])
+    assert out.sum() == pytest.approx(12.0)  # mean-preserving
+
+
+def test_ring2_mix_weighted_never_half_counts_the_peer():
+    """Gated mixing on Ring(2): with BOTH edges alive the peer enters
+    twice at weight 1/3 (bitwise the uniform mix — reference parity);
+    with both edges dead it leaves entirely (weight renormalizes to 1).
+    The one-edge-off state weighs the single delivered copy at 1/2 —
+    the renormalization, not a half-counted peer (per-edge delivery is
+    real on the wire: each put can be lost independently)."""
+    from eventgrad_tpu.parallel import collectives
+    from eventgrad_tpu.parallel.spmd import spmd
+
+    topo = Ring(2)
+    p = jnp.array([3.0, 9.0])
+
+    def fn(pp, gate):
+        bufs = collectives.neighbor_vals(pp, topo)
+        return collectives.mix_weighted(pp, bufs, gate)
+
+    both = np.asarray(spmd(lambda pp: fn(pp, jnp.array([True, True])), topo)(p))
+    np.testing.assert_array_equal(
+        both,
+        np.asarray(spmd(
+            lambda pp: collectives.mix(
+                pp, collectives.neighbor_vals(pp, topo), topo
+            ), topo,
+        )(p)),
+    )
+    none = np.asarray(
+        spmd(lambda pp: fn(pp, jnp.array([False, False])), topo)(p)
+    )
+    np.testing.assert_allclose(none, [3.0, 9.0])  # peer fully out
+    one = np.asarray(
+        spmd(lambda pp: fn(pp, jnp.array([False, True])), topo)(p)
+    )
+    np.testing.assert_allclose(one, [(3 + 9) / 2, (9 + 3) / 2])

@@ -1,0 +1,336 @@
+"""Supervised soak harness: survive a scripted kill/join/leave/flaky
+schedule and prove it with a schema-validated artifact.
+
+The elastic-membership acceptance run (ISSUE 6): one supervised training
+service is driven through
+
+  * >= 6 scripted MEMBERSHIP transitions (>= 2 joins) — ranks leave
+    cleanly and newcomers bootstrap from neighbor snapshots
+    (chaos/membership.py), applied live between dispatch blocks;
+  * a FLAKY network window (chaos schedule, total blackout for a slice
+    of passes) riding the same run;
+  * a process KILL (`--fault-inject crash:N`) that the supervisor
+    (`eventgrad_tpu.supervise`, sliding restart-budget window +
+    exponential backoff) recovers from the latest snapshot.
+
+Then three verdicts are measured, not asserted:
+
+  * recovery — per-transition lost recomputation epochs, bounded by one
+    `--save-every` interval (membership transitions lose ZERO epochs:
+    state carries over live; the supervisor restart loses at most the
+    epochs since the last snapshot);
+  * accuracy — final consensus test accuracy within 0.5 pt of a
+    transition-free baseline trained in-process on the same data;
+  * replayability — the membership + chaos schedules parsed back out of
+    the soak run's OWN log reproduce its final snapshot bitwise in a
+    clean in-process replay (crash recovery + elastic transitions leave
+    no numerical trace).
+
+Output: artifacts/soak_<platform>.json, validated against
+`tools/validate_artifacts.SOAK_SCHEMA` (tier-1 gated by
+tests/test_artifacts.py; the short `--smoke` leg runs inside
+tests/test_soak.py, the full schedule behind the `slow` marker).
+
+Usage:
+    python tools/soak.py [--smoke] [--out artifacts/soak_cpu.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+# CPU proxy by design (the artifact is soak_cpu.json): pin THIS process
+# and every supervised child to the CPU backend, and make the package
+# importable from the children regardless of install state
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PYTHONPATH"] = (
+    _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+).rstrip(os.pathsep)
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+compile_cache.enable()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+#: (ranks, epochs, n_synth, batch, save_every, crash_epoch,
+#:  membership spec, chaos spec) per mode. The crash epoch must sit ON
+#: the save_every grid: `fault_inject` re-fires on any recomputed epoch
+#: (the drill's contract since PR 1), so the kill lands right after a
+#: snapshot — at a membership-transition boundary, which additionally
+#: exercises the elastic resume path (the restored topology follows from
+#: the membership log at the peeked epoch). Flaky windows are
+#: pass-indexed.
+_OP_POINTS = {
+    "full": dict(
+        ranks=5, epochs=18, n_synth=768, batch=8, save_every=2,
+        crash_epoch=8,
+        membership=("leave=2@2,join=2@4,leave=4@6,join=4@8,"
+                    "leave=1@11,join=1@13,leave=3@15,join=3@16"),
+        chaos="drop=0,seed=11,flaky=40-60@1.0",
+    ),
+    "smoke": dict(
+        ranks=4, epochs=6, n_synth=192, batch=8, save_every=2,
+        crash_epoch=4,
+        membership=("leave=1@1,join=1@2,leave=2@3,join=2@4,"
+                    "leave=0@5,join=0@5"),
+        chaos="drop=0,seed=11,flaky=10-16@1.0",
+    ),
+}
+
+_COMMON_CLI = ["--algo", "eventgrad", "--mesh", None, "--dataset",
+               "synthetic", "--model", "mlp", "--warmup-passes", "2",
+               "--max-silence", "8", "--lr", "0.1"]
+
+
+def _train_kwargs(op: Dict[str, Any]) -> Dict[str, Any]:
+    """The in-process mirror of the child CLI flags (baseline/replay legs
+    must train the exact program the supervised child did)."""
+    from eventgrad_tpu.parallel.events import EventConfig
+
+    return dict(
+        algo="eventgrad",
+        epochs=op["epochs"],
+        batch_size=op["batch"],
+        learning_rate=0.1,
+        event_cfg=EventConfig(warmup_passes=2, max_silence=8),
+        seed=0,
+    )
+
+
+def _load_data(op: Dict[str, Any]):
+    from eventgrad_tpu.data.datasets import load_or_synthesize
+
+    n_test = max(512, op["n_synth"] // 8)
+    x, y = load_or_synthesize("mnist", None, "train", op["n_synth"], 0)
+    xt, yt = load_or_synthesize("mnist", None, "test", n_test, 0)
+    return x, y, xt, yt
+
+
+def _records(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _restart_transitions(
+    epoch_recs: List[Dict[str, Any]], save_every: int
+) -> List[Dict[str, Any]]:
+    """Supervisor restarts, recovered from the log itself: every attempt
+    stamps the serialized membership schedule on ITS first record, so
+    attempt boundaries are the records after the first that carry the
+    `membership` rider. Each restart lost `prev_epoch - (cur_epoch - 1)`
+    epochs of recompute (0 when the kill landed right on a snapshot)."""
+    out = []
+    starts = [i for i, r in enumerate(epoch_recs) if "membership" in r]
+    for i in starts[1:]:
+        prev = int(epoch_recs[i - 1]["epoch"])
+        cur = int(epoch_recs[i]["epoch"])
+        out.append({
+            "kind": "restart", "epoch": prev,
+            "lost_epochs": max(0, prev - (cur - 1)),
+            "save_every": int(save_every),
+        })
+    return out
+
+
+def run_soak(
+    out_path: str, mode: str = "full", workdir: Optional[str] = None,
+) -> Dict[str, Any]:
+    import tempfile
+
+    from eventgrad_tpu import supervise
+    from eventgrad_tpu.models import MODEL_REGISTRY
+    from eventgrad_tpu.parallel.topology import Ring
+    from eventgrad_tpu.train.loop import evaluate, train
+    from eventgrad_tpu.train.loop import consensus_params, rank0_slice
+    from eventgrad_tpu.utils import checkpoint
+
+    op = _OP_POINTS[mode]
+    t_start = time.perf_counter()
+    x, y, xt, yt = _load_data(op)
+    model = MODEL_REGISTRY["mlp"]()
+    kw = _train_kwargs(op)
+
+    ctx = tempfile.TemporaryDirectory() if workdir is None else None
+    tmp = workdir if workdir is not None else ctx.name
+    os.makedirs(tmp, exist_ok=True)
+    try:
+        # --- leg 1: transition-free baseline (in-process) --------------
+        st_base, _ = train(model, Ring(op["ranks"]), x, y, **kw)
+        acc_base = evaluate(
+            model,
+            consensus_params(st_base.params),
+            rank0_slice(st_base.batch_stats),
+            xt, yt,
+        )["accuracy"]
+
+        # --- leg 2: the supervised soak (subprocess, killed once) ------
+        ck = os.path.join(tmp, "ck")
+        log = os.path.join(tmp, "soak.jsonl")
+        child = [
+            a if a is not None else f"ring:{op['ranks']}"
+            for a in _COMMON_CLI
+        ] + [
+            "--epochs", str(op["epochs"]),
+            "--batch-size", str(op["batch"]),
+            "--n-synth", str(op["n_synth"]),
+            "--membership", op["membership"],
+            "--chaos", op["chaos"],
+            "--fault-inject", f"crash:{op['crash_epoch']}",
+            "--checkpoint-dir", ck,
+            "--save-every", str(op["save_every"]),
+            "--log-file", log,
+        ]
+        rc = supervise.supervise(
+            child, timeout=0.0, max_restarts=3, restart_window=600.0,
+            backoff_base=0.2, backoff_max=2.0,
+        )
+        escalations = 0 if rc == 0 else 1
+        recs = _records(log)
+        epoch_recs = [r for r in recs if "epoch" in r]
+        final_rec = next(r for r in reversed(recs) if r.get("final"))
+        acc_soak = float(final_rec["accuracy"])
+        msgs_saved = float(
+            next(
+                r["msgs_saved_pct"] for r in reversed(epoch_recs)
+                if "msgs_saved_pct" in r
+            )
+        )
+
+        # --- transition accounting -------------------------------------
+        # ground truth is the schedule the run LOGGED about itself
+        # (rec["membership"] on each attempt's first record); per-epoch
+        # active_ranks must track it exactly — the "survived" proof. A
+        # membership transition loses ZERO epochs (state carries over
+        # live); transition records enrich with apply timings where the
+        # process lived long enough to write the next record (a kill at
+        # the transition epoch eats the record, never the transition).
+        from eventgrad_tpu.chaos.membership import MembershipSchedule
+
+        memb_logged = next(
+            r["membership"] for r in epoch_recs if "membership" in r
+        )
+        sched = MembershipSchedule.from_dict(memb_logged)
+        active_ranks_verified = all(
+            int(r["active_ranks"])
+            == sched.n_ranks_at(op["ranks"], int(r["epoch"]) - 1)
+            for r in epoch_recs
+        )
+        applied = {
+            (t["kind"], int(t["epoch"]), int(t["index"])): t
+            for r in epoch_recs
+            for t in r.get("membership_transitions", ())
+        }
+        transitions: List[Dict[str, Any]] = []
+        for e in sched.events:
+            t = {"kind": e.kind, "epoch": e.epoch, "index": e.index,
+                 "lost_epochs": 0}
+            seen = applied.get((e.kind, e.epoch, e.index))
+            if seen is not None:
+                t["apply_s"] = float(seen.get("apply_s", 0.0))
+                t["n_ranks_after"] = int(seen["n_ranks_after"])
+            transitions.append(t)
+        restarts = _restart_transitions(epoch_recs, op["save_every"])
+        transitions = sorted(
+            transitions + restarts, key=lambda t: t["epoch"]
+        )
+        n_joins = sum(1 for t in transitions if t["kind"] == "join")
+        n_memb = sum(1 for t in transitions if t["kind"] != "restart")
+        recovery_ok = all(
+            t["lost_epochs"] <= op["save_every"] for t in transitions
+        )
+
+        # --- leg 3: replay from the run's OWN logged schedules ---------
+        chaos_logged = next(
+            r["chaos"] for r in epoch_recs if "chaos" in r
+        )
+        st_replay, _ = train(
+            model, Ring(op["ranks"]), x, y,
+            membership=memb_logged, chaos=chaos_logged, **kw,
+        )
+        found = checkpoint.latest(os.path.join(ck, "ckpt"))
+        snap = checkpoint.restore(
+            found, {"state": st_replay, "epoch": np.int64(0)}
+        )
+        replay_bitwise = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree.leaves(st_replay.params),
+                jax.tree.leaves(snap["state"].params),
+            )
+        )
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+    out = {
+        "bench": "soak",
+        "platform": jax.default_backend(),
+        "mode": mode,
+        "op_point": {
+            k: op[k]
+            for k in ("ranks", "epochs", "n_synth", "batch", "crash_epoch",
+                      "membership", "chaos")
+        },
+        "save_every": op["save_every"],
+        "n_transitions": n_memb,
+        "n_joins": n_joins,
+        "supervisor_restarts": len(restarts),
+        "supervisor_escalations": escalations,
+        "transitions": transitions,
+        "active_ranks_verified": bool(active_ranks_verified),
+        "recovery_ok": bool(recovery_ok),
+        "final_acc_baseline": round(float(acc_base), 3),
+        "final_acc_soak": round(acc_soak, 3),
+        "final_acc_gap_pt": round(abs(float(acc_base) - acc_soak), 3),
+        "msgs_saved_pct": round(msgs_saved, 2),
+        "replay_bitwise": bool(replay_bitwise),
+        "wall_s": round(time.perf_counter() - t_start, 1),
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                    exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced op point (<= ~60 s on CPU; same schema, "
+                         "same >= 6-transition floor)")
+    ap.add_argument("--out", default=os.path.join(
+        _ROOT, "artifacts", f"soak_{jax.default_backend()}.json"
+    ))
+    args = ap.parse_args(argv)
+    out = run_soak(args.out, mode="smoke" if args.smoke else "full")
+    print(json.dumps(out, indent=1, sort_keys=True))
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_artifacts",
+        os.path.join(_ROOT, "tools", "validate_artifacts.py"),
+    )
+    va = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(va)
+    errs = va.validate(out, va.SOAK_SCHEMA)
+    for e in errs:
+        print(f"SOAK_SCHEMA violation: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -259,6 +259,57 @@ PIPELINE_BUBBLE_SCHEMA = {
     },
 }
 
+SOAK_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "op_point", "save_every", "n_transitions",
+        "n_joins", "supervisor_restarts", "supervisor_escalations",
+        "transitions", "active_ranks_verified", "recovery_ok",
+        "final_acc_baseline",
+        "final_acc_soak", "final_acc_gap_pt", "msgs_saved_pct",
+        "replay_bitwise", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["soak"]},
+        "platform": {"type": "string"},
+        "save_every": {"type": "integer", "minimum": 1},
+        # the elastic-membership acceptance gates (ISSUE 6): >= 6
+        # scripted transitions, >= 2 of them joins, survived with ZERO
+        # supervisor escalations, every recovery within one save
+        # interval, replay from the logged schedule bitwise, and the
+        # final accuracy within 0.5 pt of the transition-free baseline
+        "n_transitions": {"type": "integer", "minimum": 6},
+        "n_joins": {"type": "integer", "minimum": 2},
+        "supervisor_restarts": {"type": "integer", "minimum": 1},
+        "supervisor_escalations": {"enum": [0]},
+        "transitions": {
+            "type": "array",
+            "minItems": 6,
+            "items": {
+                "type": "object",
+                "required": ["kind", "epoch", "lost_epochs"],
+                "properties": {
+                    "kind": {"enum": ["join", "leave", "restart"]},
+                    "epoch": {"type": "integer", "minimum": 1},
+                    # epochs of recomputation the transition cost; the
+                    # per-item bound vs save_every is recovery_ok below
+                    "lost_epochs": {"type": "integer", "minimum": 0},
+                },
+            },
+        },
+        # per-epoch active_ranks tracked the logged schedule exactly —
+        # the "transitions survived" proof
+        "active_ranks_verified": {"enum": [True]},
+        "recovery_ok": {"enum": [True]},
+        "final_acc_gap_pt": {"type": "number", "minimum": 0,
+                             "maximum": 0.5},
+        "msgs_saved_pct": {"type": "number", "minimum": 0,
+                           "maximum": 100},
+        "replay_bitwise": {"enum": [True]},
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
@@ -268,6 +319,7 @@ _ARTIFACT_FAMILIES = (
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
+    ("soak_", SOAK_SCHEMA),
     ("tpu_flagship", FLAGSHIP_SCHEMA),
 )
 
