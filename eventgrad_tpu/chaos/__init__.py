@@ -27,6 +27,12 @@ first-class, *measured* property instead of a hope:
     from a neighbor's snapshot streamed through the async checkpoint
     writer, and every transition force-fires the next exchange so
     buffers refresh in one cycle.
+  * `integrity` — LYING peers and SICK ranks (where the faults above are
+    silent ones): wire checksums on every gossip payload (a failed check
+    is an event that did not fire), non-finite quarantine inside the
+    fused step, and the host-side divergence sentinel + rollback-to-
+    last-good engine riding the block drain. Exercised by the
+    `bitflip=` / `nanstep=` fault clauses of `schedule`.
 
 Entry points: `train.loop.train(chaos=..., chaos_policy=...,
 membership=...)`, the CLI's `--chaos/--chaos-sync-after/
@@ -37,6 +43,10 @@ Fault model and formats: docs/chaos.md.
 """
 
 from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.chaos.integrity import (
+    INTEGRITY_ABORT_EXIT, DivergenceSentinel, IntegrityConfig,
+    IntegrityEscalation,
+)
 from eventgrad_tpu.chaos.membership import (
     MembershipEngine, MembershipEvent, MembershipSchedule,
 )
@@ -46,6 +56,10 @@ from eventgrad_tpu.chaos.policy import RecoveryPolicy, heal_ring, apply_ring_hea
 __all__ = [
     "ChaosSchedule",
     "FlakyWindow",
+    "INTEGRITY_ABORT_EXIT",
+    "DivergenceSentinel",
+    "IntegrityConfig",
+    "IntegrityEscalation",
     "MembershipEngine",
     "MembershipEvent",
     "MembershipSchedule",

@@ -32,10 +32,12 @@ from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.parallel.topology import Topology
 
 #: fold_in tags separating the independent per-schedule random streams
-#: (drop draws vs. delivery-thinning phases); arbitrary but frozen —
-#: changing them changes every serialized schedule's replay.
+#: (drop draws vs. delivery-thinning phases vs. bitflip draws); arbitrary
+#: but frozen — changing them changes every serialized schedule's replay.
 _TAG_DROP = 0x5EED
 _TAG_PHASE = 0x9A5E
+_TAG_FLIP = 0xB17F
+_TAG_FLIP_POS = 0xB170
 
 
 def rank_and_sources(topo: Topology) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -118,6 +120,128 @@ def delivery_mask(
         deliver = deliver & ~(dead_now & (srcs == dead_rank))
         deliver = deliver & ~(dead_now & (rank == dead_rank))
     return deliver
+
+
+def corrupt_mask(
+    sched: ChaosSchedule,
+    topo: Topology,
+    pass_num: jnp.ndarray,
+    rank: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-edge wire-corruption decisions for the current pass:
+    (corrupt bool [n_neighbors], flip_salt int32 [n_neighbors]).
+
+    A True bit means "the payload received on this edge this pass has one
+    bit flipped in transit"; `flip_salt` seeds which element flips
+    (`flip_one_bit` takes it modulo the buffer size). Deterministic in
+    (seed, pass, receiver rank, edge index) via the same counter-style
+    fold_in chains as `delivery_mask`, on an independent tag — adding
+    bitflips to a schedule never perturbs its drop draws."""
+    n_nb = topo.n_neighbors
+    if rank is None:
+        rank, _ = rank_and_sources(topo)
+    rank = jnp.asarray(rank, jnp.int32)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    key = jax.random.PRNGKey(sched.seed)
+
+    u = jax.random.uniform(
+        jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(key, _TAG_FLIP), pass_i),
+            rank,
+        ),
+        (n_nb,),
+    )
+    p = jnp.zeros((n_nb,), jnp.float32)
+    for w in sched.bitflip:
+        in_window = (pass_i >= w.start_pass) & (pass_i < w.end_pass)
+        p = jnp.where(in_window, jnp.maximum(p, jnp.float32(w.drop_p)), p)
+    corrupt = u < p  # u in [0, 1): p == 0 can never corrupt
+    salt = jax.random.randint(
+        jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.fold_in(key, _TAG_FLIP_POS), pass_i
+            ),
+            rank,
+        ),
+        (n_nb,), 0, 2**31 - 1,
+    )
+    return corrupt, salt
+
+
+def flip_one_bit(
+    buf: jnp.ndarray, do_flip: jnp.ndarray, salt: jnp.ndarray,
+) -> jnp.ndarray:
+    """Flip one bit of a wire buffer in transit (when `do_flip`).
+
+    The flipped element is `salt % buf.size`; the flipped bit is the
+    second-most-significant of the element's storage word — for a float
+    payload that is the exponent MSB, the worst case a real bit error
+    can do (a ~1e38-scale excursion), and exactly what the integrity
+    checksum must catch. Works on any wire dtype (f32/bf16 bitcast to
+    ints; int8 flips bit 6). Shapes are static; the flip is one
+    dynamic-index XOR under `where`, so the traced program is identical
+    whether or not the bit fires this pass."""
+    flat = buf.reshape(-1)
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        nbits = jnp.finfo(flat.dtype).bits
+        int_dt = {16: jnp.int16, 32: jnp.int32, 64: jnp.int64}[nbits]
+        bits = lax.bitcast_convert_type(flat, int_dt)
+    else:
+        nbits = jnp.iinfo(flat.dtype).bits
+        int_dt = flat.dtype
+        bits = flat
+    mask = jnp.asarray(1 << (nbits - 2), int_dt)
+    idx = jnp.asarray(salt, jnp.int32) % flat.size
+    flipped = bits.at[idx].set(
+        jnp.where(do_flip, bits[idx] ^ mask, bits[idx])
+    )
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        flipped = lax.bitcast_convert_type(flipped, flat.dtype)
+    return flipped.reshape(buf.shape)
+
+
+def nanstep_mask(
+    sched: ChaosSchedule,
+    topo: Topology,
+    pass_num: jnp.ndarray,
+    rank: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """bool []: is this rank's gradient poisoned (NaN) on this pass?
+    Pure data — the schedule's `nanstep=R@P` events, no randomness."""
+    if rank is None:
+        rank, _ = rank_and_sources(topo)
+    rank = jnp.asarray(rank, jnp.int32)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    hit = jnp.zeros((), bool)
+    for r, t in sched.nanstep:
+        hit = hit | ((rank == r) & (pass_i == t))
+    return hit
+
+
+def corruption_table(
+    sched: ChaosSchedule, topo: Topology, n_passes: int, start_pass: int = 1
+) -> np.ndarray:
+    """Host-side replay of the bitflip schedule: bool [n_passes, n_ranks,
+    n_neighbors] of injected corruptions — the ground truth the integrity
+    artifact's zero-silent-acceptance accounting compares against (same
+    fold_in chain as `corrupt_mask`, like `delivery_table`)."""
+    out = np.zeros((n_passes, topo.n_ranks, topo.n_neighbors), bool)
+    fn = jax.jit(lambda p, r: corrupt_mask(sched, topo, p, rank=r)[0])
+    for pi in range(n_passes):
+        for r in range(topo.n_ranks):
+            out[pi, r] = np.asarray(fn(jnp.int32(start_pass + pi), jnp.int32(r)))
+    return out
+
+
+def nansteps_in_range(
+    sched: ChaosSchedule, n_ranks: int, n_passes: int, start_pass: int = 1
+) -> int:
+    """How many scheduled nanstep events land within the run (the
+    integrity artifact's quarantine accounting denominator)."""
+    return sum(
+        1 for r, t in sched.nanstep
+        if 0 <= r < n_ranks and start_pass <= t < start_pass + n_passes
+    )
 
 
 def delivery_table(

@@ -27,6 +27,22 @@ Fault vocabulary (all composable):
                      rank-indexed inside the traced step and a
                      transition re-indexes the rows (train() rejects
                      the combination — script the removal as `leave=`).
+  * `bitflip`      — WIRE CORRUPTION: per-edge per-pass probability that
+                     one bit of the received gossip payload flips in
+                     transit (a lying peer / a bad link, as opposed to a
+                     silent one). Windowed like flaky (`bitflip=S-E@p`;
+                     bare `bitflip=p` corrupts for the whole run). The
+                     defense is the integrity engine's wire checksums
+                     (chaos/integrity.py): a failed check is treated
+                     exactly as not-fired. Event-exchange (eventgrad)
+                     runs only — the corruption rides the masked/compact
+                     wire buffer.
+  * `nanstep`      — SICK RANK: `nanstep=R@P` poisons rank R's gradients
+                     with NaN on pass P (an overflowed loss, a bad batch,
+                     a kernel bug). The defense is the integrity engine's
+                     non-finite quarantine: the rank skips its update and
+                     suppresses its sends for that step. Clauses
+                     accumulate.
   * `leave`/`join` — MEMBERSHIP events (chaos/membership.py): unlike the
                      wire faults above they are keyed by EPOCH, applied
                      between jit dispatch blocks on the host (a rank
@@ -39,9 +55,11 @@ Fault vocabulary (all composable):
 
 CLI spec grammar (comma-separated clauses, see `parse`):
 
-    drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500,leave=1@3,join=1@5
+    drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500,leave=1@3,join=1@5,
+    bitflip=40-60@0.5,nanstep=2@45
 
-Multiple `flaky=` / `die=` / `leave=` / `join=` clauses accumulate.
+Multiple `flaky=` / `die=` / `leave=` / `join=` / `bitflip=` / `nanstep=`
+clauses accumulate.
 """
 
 from __future__ import annotations
@@ -80,6 +98,11 @@ class ChaosSchedule:
     deliver_every: int = 1
     death: Tuple[Tuple[int, int], ...] = ()
     membership: Tuple[Any, ...] = ()
+    #: wire-corruption windows: FlakyWindow tuples whose drop_p is the
+    #: per-edge per-pass BITFLIP probability (one flipped payload bit)
+    bitflip: Tuple[FlakyWindow, ...] = ()
+    #: gradient-poison events: ((rank, pass), ...) — rank's grads go NaN
+    nanstep: Tuple[Tuple[int, int], ...] = ()
 
     def __post_init__(self):
         if not 0.0 <= self.drop_p <= 1.0:
@@ -99,6 +122,14 @@ class ChaosSchedule:
             self, "membership",
             tuple(sorted(self.membership, key=lambda e: e.epoch)),
         )
+        object.__setattr__(
+            self, "bitflip",
+            tuple(sorted(self.bitflip, key=lambda w: (w.start_pass, w.end_pass))),
+        )
+        object.__setattr__(self, "nanstep", tuple(sorted(self.nanstep)))
+        for r, t in self.nanstep:
+            if r < 0 or t < 0:
+                raise ValueError(f"nanstep ({r}, {t}) invalid")
 
     @property
     def is_noop(self) -> bool:
@@ -112,7 +143,19 @@ class ChaosSchedule:
             and self.deliver_every == 1
             and not self.death
             and not self.membership
+            and not self.bitflip
+            and not self.nanstep
         )
+
+    @property
+    def has_bitflips(self) -> bool:
+        """True when any pass could corrupt a payload (the step then
+        threads the corruption transform into the exchange)."""
+        return any(w.drop_p > 0.0 for w in self.bitflip)
+
+    @property
+    def has_nansteps(self) -> bool:
+        return bool(self.nanstep)
 
     def membership_schedule(self):
         """The epoch-keyed join/leave events as a MembershipSchedule (for
@@ -140,6 +183,12 @@ class ChaosSchedule:
         }
         if self.membership:  # absent = legacy schedules round-trip unchanged
             d["membership"] = self.membership_schedule().to_dict()["events"]
+        if self.bitflip:  # absent = legacy schedules round-trip unchanged
+            d["bitflip"] = [
+                [w.start_pass, w.end_pass, w.drop_p] for w in self.bitflip
+            ]
+        if self.nanstep:
+            d["nanstep"] = [list(e) for e in self.nanstep]
         return d
 
     @classmethod
@@ -163,6 +212,13 @@ class ChaosSchedule:
                 (int(r), int(t)) for r, t in d.get("death", ())
             ),
             membership=membership,
+            bitflip=tuple(
+                FlakyWindow(int(s), int(e), float(p))
+                for s, e, p in d.get("bitflip", ())
+            ),
+            nanstep=tuple(
+                (int(r), int(t)) for r, t in d.get("nanstep", ())
+            ),
         )
 
     # --- CLI spec round trip -------------------------------------------
@@ -175,6 +231,10 @@ class ChaosSchedule:
             parts.append(f"delay={self.deliver_every}")
         for r, t in self.death:
             parts.append(f"die={r}@{t}")
+        for w in self.bitflip:
+            parts.append(f"bitflip={w.start_pass}-{w.end_pass}@{w.drop_p:g}")
+        for r, t in self.nanstep:
+            parts.append(f"nanstep={r}@{t}")
         if self.membership:
             from eventgrad_tpu.chaos.membership import format_event_clause
 
@@ -184,7 +244,10 @@ class ChaosSchedule:
     @classmethod
     def parse(cls, spec: str) -> "ChaosSchedule":
         """Parse the CLI grammar, e.g. `drop=0.2,seed=7,flaky=10-20@0.8`."""
-        kw: Dict[str, Any] = {"flaky": [], "death": [], "membership": []}
+        kw: Dict[str, Any] = {
+            "flaky": [], "death": [], "membership": [], "bitflip": [],
+            "nanstep": [],
+        }
         for clause in spec.split(","):
             clause = clause.strip()
             if not clause:
@@ -210,6 +273,28 @@ class ChaosSchedule:
                 elif key == "die":
                     r, _, t = val.partition("@")
                     kw["death"].append((int(r), int(t)))
+                elif key == "bitflip":
+                    # a bare probability corrupts the whole run — tried
+                    # FIRST so scientific notation (`bitflip=1e-3`, the
+                    # natural spell for realistic flip rates) is not
+                    # misread as a `S-E` pass range by its '-'
+                    try:
+                        p_whole = float(val)
+                    except ValueError:
+                        p_whole = None
+                    if p_whole is not None:
+                        kw["bitflip"].append(
+                            FlakyWindow(0, 2**31 - 1, p_whole)
+                        )
+                    else:  # windowed like flaky: `bitflip=S-E@p`
+                        span, _, p = val.partition("@")
+                        s, _, e = span.partition("-")
+                        kw["bitflip"].append(
+                            FlakyWindow(int(s), int(e), float(p) if p else 1.0)
+                        )
+                elif key == "nanstep":
+                    r, _, t = val.partition("@")
+                    kw["nanstep"].append((int(r), int(t)))
                 elif key in ("leave", "join"):
                     from eventgrad_tpu.chaos.membership import (
                         parse_event_clause,
@@ -225,6 +310,8 @@ class ChaosSchedule:
         kw["flaky"] = tuple(kw["flaky"])
         kw["death"] = tuple(kw["death"])
         kw["membership"] = tuple(kw["membership"])
+        kw["bitflip"] = tuple(kw["bitflip"])
+        kw["nanstep"] = tuple(kw["nanstep"])
         return cls(**kw)
 
 

@@ -33,6 +33,9 @@ import sys
 import jax
 import numpy as np
 
+from eventgrad_tpu.chaos.integrity import (
+    INTEGRITY_ABORT_EXIT, IntegrityEscalation,
+)
 from eventgrad_tpu.data.datasets import load_or_synthesize, synthetic_lm_dataset
 from eventgrad_tpu.models import MODEL_REGISTRY
 from eventgrad_tpu.parallel import multihost
@@ -289,6 +292,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "the first history record. Single-process ring "
                         "gossip runs (dpsgd/eventgrad) only; join=/leave= "
                         "clauses inside --chaos are equivalent")
+    p.add_argument("--integrity", default=None, metavar="SPEC",
+                   help="integrity engine (chaos/integrity.py, docs/"
+                        "chaos.md): 'on', 'off', or field=value clauses "
+                        "(e.g. 'checksum=1,quarantine=1,sentinel=1,"
+                        "rollback=1,max_rollbacks=1'). on = wire "
+                        "checksums on every gossip payload (a failed "
+                        "check is an event that did not fire), non-"
+                        "finite quarantine (a NaN-producing rank skips "
+                        "its update and suppresses its sends), and the "
+                        "divergence sentinel with rollback-to-last-good "
+                        "(restore every rank from the retained snapshot, "
+                        "force-refresh all event buffers, harden, "
+                        "replay). A trip beyond max_rollbacks exits "
+                        f"{INTEGRITY_ABORT_EXIT} and the supervisor "
+                        "gives up without a restart. off is bitwise-"
+                        "identical to no flag")
     p.add_argument("--chaos-sync-after", type=int, default=0, metavar="N",
                    help="recovery: an edge silent N passes makes the "
                         "receiver request a forced full sync from that "
@@ -497,6 +516,36 @@ def main(argv=None) -> int:
             "land at an exact post-snapshot epoch boundary, which needs "
             "the serial schedule); use --pipeline auto or off"
         )
+    integrity_cfg = None
+    if args.integrity is not None:
+        from eventgrad_tpu.chaos import integrity as chaos_integrity
+
+        try:
+            integrity_cfg = chaos_integrity.resolve(args.integrity)
+        except ValueError as e:
+            raise SystemExit(f"--integrity: {e}")
+    if integrity_cfg is not None:
+        if (
+            (integrity_cfg.checksum or integrity_cfg.quarantine)
+            and args.algo != "eventgrad"
+        ):
+            raise SystemExit(
+                "--integrity checksums/quarantine ride the event "
+                f"exchange; --algo {args.algo} has none (pass "
+                "'checksum=0,quarantine=0,...' for the sentinel alone)"
+            )
+        if args.fused:
+            raise SystemExit(
+                "--integrity is not combinable with --fused (the Pallas "
+                "update tail bypasses the guarded update path)"
+            )
+        if args.pipeline == "on" and integrity_cfg.sentinel:
+            raise SystemExit(
+                "--pipeline on cannot honor the --integrity sentinel/"
+                "rollback engine (the verdict on block B gates what "
+                "block B+1 may dispatch); use --pipeline auto or off, "
+                "or pass 'sentinel=0,rollback=0,...'"
+            )
     if not is_lm and not args.model.startswith("resnet") and (
         args.num_classes != 10 or args.num_filters != 64
     ):
@@ -573,29 +622,38 @@ def main(argv=None) -> int:
     )
     hist = []
     try:
-        with scope:
-            state, hist = train(
-                model, topo, x, y,
-                algo=args.algo, epochs=args.epochs, batch_size=batch,
-                learning_rate=args.lr, momentum=args.momentum,
-                event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
-                augment=args.augment, random_sampler=args.random_sampler,
-                sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
-                checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
-                resume=args.resume, trace_file=args.trace_file,
-                wire=args.wire, staleness=args.staleness,
-                gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
-                fused_update=args.fused, fault_inject=args.fault_inject,
-                chaos=chaos_sched, chaos_policy=chaos_policy,
-                membership=membership,
-                obs=args.obs, registry=registry,
-                arena={"auto": None, "on": True, "off": False}[args.arena],
-                pipeline={
-                    "auto": None, "on": True, "off": False
-                }[args.pipeline],
-                on_epoch=emit,  # records stream as epochs finish: live
-                # metrics for the user, a liveness signal for supervise.py
-            )
+        try:
+            with scope:
+                state, hist = train(
+                    model, topo, x, y,
+                    algo=args.algo, epochs=args.epochs, batch_size=batch,
+                    learning_rate=args.lr, momentum=args.momentum,
+                    event_cfg=event_cfg, sparse_cfg=SparseConfig(args.topk_percent),
+                    augment=args.augment, random_sampler=args.random_sampler,
+                    sync_bn=args.sync_bn, mesh=mesh, seed=args.seed, x_test=xt, y_test=yt,
+                    checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
+                    resume=args.resume, trace_file=args.trace_file,
+                    wire=args.wire, staleness=args.staleness,
+                    gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
+                    fused_update=args.fused, fault_inject=args.fault_inject,
+                    chaos=chaos_sched, chaos_policy=chaos_policy,
+                    membership=membership, integrity=integrity_cfg,
+                    obs=args.obs, registry=registry,
+                    arena={"auto": None, "on": True, "off": False}[args.arena],
+                    pipeline={
+                        "auto": None, "on": True, "off": False
+                    }[args.pipeline],
+                    on_epoch=emit,  # records stream as epochs finish: live
+                    # metrics for the user, a liveness signal for supervise.py
+                )
+        except IntegrityEscalation as e:
+            # the retained last-known-good state cannot outrun this
+            # fault: exit the reserved code so the supervisor gives up
+            # instead of replaying the same divergence
+            if primary:
+                emit({"integrity_abort": True, "reason": str(e)})
+            print(f"integrity abort: {e}", file=sys.stderr, flush=True)
+            return INTEGRITY_ABORT_EXIT
 
         if hybrid:
             # consensus averaging across sp/tp/pp/ep ranks would mix

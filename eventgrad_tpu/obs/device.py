@@ -35,6 +35,12 @@ class TelemetryState(struct.PyTreeNode):
     fired_elems_sum: jnp.ndarray  # f32 []
     fired_elems_peak: jnp.ndarray # f32 []
     edge_bytes: jnp.ndarray       # f32 [n_edges]
+    # integrity counters (chaos/integrity.py): per-edge wire rejections
+    # (checksum mismatch / non-finite payload) and quarantined passes.
+    # Defaulted so pre-integrity snapshots restore via the known-added
+    # migration path (train/loop.py restore_with_fill).
+    wire_reject: jnp.ndarray = None    # type: ignore[assignment]  # i32 [n_edges]
+    quarantined: jnp.ndarray = None    # type: ignore[assignment]  # i32 []
 
     @classmethod
     def init(cls, n_leaves: int, n_edges: int) -> "TelemetryState":
@@ -49,6 +55,8 @@ class TelemetryState(struct.PyTreeNode):
             fired_elems_sum=jnp.zeros((), jnp.float32),
             fired_elems_peak=jnp.zeros((), jnp.float32),
             edge_bytes=jnp.zeros((n_edges,), jnp.float32),
+            wire_reject=jnp.zeros((n_edges,), jnp.int32),
+            quarantined=jnp.zeros((), jnp.int32),
         )
 
 
@@ -72,6 +80,8 @@ def accumulate(
     silence: Optional[jnp.ndarray] = None,       # f32/i32 [L] passes quiet
     fired_elems: Optional[jnp.ndarray] = None,   # f32 [] admitted elements
     edge_bytes: Optional[jnp.ndarray] = None,    # f32 [n_edges] this pass
+    wire_reject: Optional[jnp.ndarray] = None,   # bool/i32 [n_edges]
+    quarantined: Optional[jnp.ndarray] = None,   # bool/i32 []
 ) -> TelemetryState:
     """One pass of counter updates; omitted (None) quantities leave their
     counters untouched (the non-event algorithms pass only edge_bytes).
@@ -96,6 +106,10 @@ def accumulate(
         upd["fired_elems_peak"] = jnp.maximum(tel.fired_elems_peak, fe)
     if edge_bytes is not None:
         upd["edge_bytes"] = tel.edge_bytes + edge_bytes
+    if wire_reject is not None:
+        upd["wire_reject"] = tel.wire_reject + wire_reject.astype(jnp.int32)
+    if quarantined is not None:
+        upd["quarantined"] = tel.quarantined + quarantined.astype(jnp.int32)
     return tel.replace(**upd)
 
 
@@ -119,7 +133,7 @@ def window_record(cur, prev=None):
 
     steps = int(d("steps").reshape(-1)[0])
     denom = max(1, steps)
-    return {
+    rec = {
         "schema": OBS_SCHEMA_VERSION,
         "steps": steps,
         "fire_count": [int(v) for v in d("fire_count").sum(axis=0)],
@@ -141,3 +155,12 @@ def window_record(cur, prev=None):
             round(float(v), 2) for v in d("edge_bytes").mean(axis=0) / denom
         ],
     }
+    if cur.wire_reject is not None:
+        # integrity counters were known-added: a pre-integrity snapshot
+        # (or a hand-built test state) carries None — omit the keys
+        # instead of fabricating zeros for a run that never counted
+        rec["wire_reject_count"] = [
+            int(v) for v in d("wire_reject").sum(axis=0)
+        ]
+        rec["quarantined_steps"] = int(d("quarantined").sum())
+    return rec

@@ -76,6 +76,17 @@ TELEMETRY_FIELDS = {
         "collective actually moved — dense/masked ship the full payload, "
         "compact ships the static capacity; see docs/compaction.md)",
     ),
+    "wire_reject": (
+        "rejections[edge]", "integrity runs",
+        "per-edge payloads rejected at the wire (checksum mismatch or "
+        "non-finite content) — each rejection kept the stale buffer, "
+        "bitwise an event that did not fire (docs/chaos.md)",
+    ),
+    "quarantined": (
+        "passes", "integrity runs",
+        "passes this rank spent quarantined (non-finite local gradients "
+        "or post-update parameters: update skipped, sends suppressed)",
+    ),
 }
 
 #: Host-side `obs` block attached to block-end history records
@@ -117,6 +128,14 @@ RECORD_FIELDS = {
     "edge_bytes_per_step": (
         "bytes[edge]", "gossip algos",
         "per-edge wire-real bytes per pass (rank mean)",
+    ),
+    "wire_reject_count": (
+        "rejections[edge]", "integrity runs",
+        "per-edge wire rejections in this flush window, summed over ranks",
+    ),
+    "quarantined_steps": (
+        "rank-passes", "integrity runs",
+        "quarantined rank-passes in this flush window, summed over ranks",
     ),
 }
 
@@ -162,6 +181,43 @@ MEMBERSHIP_FIELDS = {
 }
 
 
+#: Integrity-engine surfaces (chaos/integrity.py): per-epoch history
+#: record fields plus the Prometheus gauges
+#: `eventgrad_wire_rejects_total`, `eventgrad_quarantined_steps_total`,
+#: and `eventgrad_integrity_rollbacks_total`.
+#: name -> (units, modes, description)
+INTEGRITY_FIELDS = {
+    "wire_rejects": (
+        "rejections", "integrity runs",
+        "payloads rejected at the wire this epoch (checksum mismatch or "
+        "non-finite content), summed over ranks and edges — cumulative "
+        "form is the wire_rejects_total gauge",
+    ),
+    "quarantined_steps": (
+        "rank-passes", "integrity runs",
+        "rank-passes quarantined this epoch (update skipped, sends "
+        "suppressed) — cumulative form is the quarantined_steps_total "
+        "gauge",
+    ),
+    "integrity": (
+        "config dict", "integrity runs",
+        "the serialized IntegrityConfig, stamped on the run's first "
+        "record (replayability rider, like `chaos`)",
+    ),
+    "integrity_rollbacks": (
+        "int", "integrity runs",
+        "rollbacks performed so far (cumulative; also the "
+        "integrity_rollbacks_total gauge)",
+    ),
+    "integrity_rollback": (
+        "info dict", "integrity runs",
+        "rollback info (reason, tripped_epoch, restored_epoch, "
+        "hardened) on the first record AFTER the engine restored the "
+        "last-known-good snapshot",
+    ),
+}
+
+
 #: derived series emitted by obs.report.build_report (tools/obs_report.py)
 REPORT_FIELDS = {
     "msgs_saved_pct_per_leaf": (
@@ -195,5 +251,5 @@ def all_field_names():
     """Every schema field name, for doc-coverage tests."""
     names = set(TELEMETRY_FIELDS) | set(RECORD_FIELDS)
     names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
-    names |= set(MEMBERSHIP_FIELDS)
+    names |= set(MEMBERSHIP_FIELDS) | set(INTEGRITY_FIELDS)
     return sorted(names)

@@ -59,9 +59,19 @@ def event_propose_pack(
     spec: ArenaSpec,
     capacity: Optional[int] = None,
     force_fire: Any = None,
+    suppress_fire: Any = None,
 ) -> Tuple[EventProposal, jnp.ndarray, Optional[jnp.ndarray],
            Optional[jnp.ndarray]]:
     """One fused pass of the sender side: trigger -> gate -> pack.
+
+    `suppress_fire` (optional bool scalar or [L]) clears the fire bits
+    BEFORE the gate and the pack — the integrity engine's quarantine
+    channel (chaos/integrity.py): a rank whose gradients went non-finite
+    ships nothing this pass, and receivers see one more event that did
+    not fire. Suppression wins over force_fire (a quarantined rank must
+    not answer a forced-sync request with poisoned values), and the
+    suppressed leaves are never committed, so they re-contend next pass
+    exactly like a capacity deferral.
 
     Returns (proposal, effective fire bits, packed wire buffer, per-
     position leaf ids). With `capacity=None` (dense/masked wires) the
@@ -75,6 +85,8 @@ def event_propose_pack(
     materializations."""
     prop = propose(params, state, pass_num, cfg, force_fire=force_fire)
     fire_vec = prop.fire_vec
+    if suppress_fire is not None:
+        fire_vec = fire_vec & ~jnp.broadcast_to(suppress_fire, fire_vec.shape)
     packed = leaf_id = None
     if capacity is not None:
         pri = None
@@ -84,7 +96,7 @@ def event_propose_pack(
             ff = jnp.broadcast_to(force_fire, fire_vec.shape)
             pri = ff if pri is None else (pri | ff)
         fire_vec = capacity_gate(
-            prop.fire_vec, spec.sizes, int(capacity), priority=pri
+            fire_vec, spec.sizes, int(capacity), priority=pri
         )
         # the pack source: leaves in arena order. The gather touches
         # FIRED leaves only (plus a masked-out clip lane), so the

@@ -135,6 +135,39 @@ def _int8_decode(got_q: Any, got_s: Any, scale_def, like: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# wire integrity: per-neighbor payload checksums (chaos/integrity.py)
+
+def wire_checksum(buf: jnp.ndarray) -> jnp.ndarray:
+    """int32 [] checksum of a wire buffer's exact bit pattern.
+
+    The buffer's storage words (f32/bf16 bitcast to ints; int8 as-is)
+    sum in int32 with wraparound — integer addition is exact and
+    associative, so the sum is bitwise-deterministic under any reduction
+    order, identical on sender and receiver, and any single flipped bit
+    changes it. Cost: one [n] integer reduction per exchange — the
+    integrity engine's entire wire-side overhead."""
+    flat = buf.reshape(-1)
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        nbits = jnp.finfo(flat.dtype).bits
+        int_dt = {16: jnp.int16, 32: jnp.int32, 64: jnp.int64}[nbits]
+        flat = lax.bitcast_convert_type(flat, int_dt)
+    return jnp.sum(flat.astype(jnp.int32))
+
+
+def _verify_wire(got_buf, got_csum, decoded, checksum: bool, finite: bool):
+    """bool [] per-neighbor wire verdict: checksum of the received buffer
+    matches what the sender computed, and (optionally) the DECODED
+    payload is finite. Shared by all four event exchanges so tree and
+    arena reject bit-identically."""
+    ok = jnp.ones((), bool)
+    if checksum:
+        ok = ok & (wire_checksum(got_buf) == got_csum)
+    if finite:
+        ok = ok & jnp.all(jnp.isfinite(decoded))
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # flat-segment helpers: leaf-major views of the packed (raveled) model
 
 def _leaf_meta(tree: Any) -> Tuple[Tuple[int, ...], Tuple[int, ...], int]:
@@ -232,7 +265,10 @@ def masked_neighbor_vals(
     topo: Topology,
     wire=None,
     deliver: "Optional[Any]" = None,
-) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+    checksum: bool = False,
+    finite: bool = False,
+    corrupt=None,
+):
     """Event-triggered exchange (EventGraD's RMA window, deterministic form).
 
     `payload` — pytree of parameters; `fire` — matching pytree of boolean
@@ -257,7 +293,21 @@ def masked_neighbor_vals(
     event that did not fire. `recv_fires` stays the RAW sender bits
     (what was on the wire), so callers can count injected drops as
     `sent & ~delivered`.
+
+    Integrity (chaos/integrity.py, packable payloads only): `checksum`
+    ships an int32 `wire_checksum` of the wire buffer and verifies it on
+    receive; `finite` additionally rejects payloads carrying NaN/Inf;
+    `corrupt` is an optional `(edge_index, wire_buf) -> wire_buf`
+    transform modeling in-transit corruption (chaos.inject.flip_one_bit),
+    applied BEFORE verification — so an injected flip is either caught or
+    (with verification off) silently accepted, exactly like a real wire.
+    A failed check clears the edge's effective bits like an undelivered
+    message: the stale buffer is kept, bitwise the not-fired path. With
+    any of the three set, a third return value `oks` (bool [n_neighbors])
+    reports the per-edge verdicts; otherwise the return signature is the
+    legacy (new_bufs, recv_fires).
     """
+    integrity = checksum or finite or corrupt is not None
     fire_leaves, fire_def = jax.tree.flatten(fire)
     fire_vec = jnp.stack(fire_leaves)
 
@@ -266,6 +316,12 @@ def masked_neighbor_vals(
             fire_def, [got_vec[i] for i in range(len(fire_leaves))]
         )
 
+    if integrity and not _packable(payload):
+        raise ValueError(
+            "wire integrity (checksum/finite/corrupt) rides the packed "
+            "single-buffer wire and needs a packable (single-dtype, "
+            "multi-leaf) payload"
+        )
     if _packable(payload):
         # one wire buffer (+ one fire-bit vector) per neighbor: the whole
         # model rides a single ICI transfer instead of one per tensor
@@ -281,22 +337,44 @@ def masked_neighbor_vals(
                 _leaf_absmax(jax.tree.leaves(payload)), fire_vec
             )
             q = _int8_encode_flat(masked_flat, scale_vec, seg)
+            csum = wire_checksum(q) if checksum else None
 
-            def receive(nb):
-                got_q, got_s, got_vec = recv_from(
-                    (q, scale_vec, fire_vec), topo, nb
+            def receive(nb, i):
+                lanes = (q, scale_vec, fire_vec) + (
+                    (csum,) if checksum else ()
                 )
+                got = recv_from(lanes, topo, nb)
+                got_q, got_s, got_vec = got[0], got[1], got[2]
+                if corrupt is not None:
+                    got_q = corrupt(i, got_q)
                 deq = got_q.astype(flat.dtype) * got_s[seg].astype(flat.dtype)
-                return unravel(deq), _unflat_fire(got_vec)
+                ok = (
+                    _verify_wire(
+                        got_q, got[3] if checksum else None, deq,
+                        checksum, finite,
+                    )
+                    if integrity else None
+                )
+                return unravel(deq), _unflat_fire(got_vec), ok
         else:
             wire_buf = _wire_out(masked_flat, wire)
+            csum = wire_checksum(wire_buf) if checksum else None
 
-            def receive(nb):
-                got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
-                return (
-                    unravel(got_flat.astype(flat.dtype)),
-                    _unflat_fire(got_vec),
+            def receive(nb, i):
+                lanes = (wire_buf, fire_vec) + ((csum,) if checksum else ())
+                got = recv_from(lanes, topo, nb)
+                got_flat, got_vec = got[0], got[1]
+                if corrupt is not None:
+                    got_flat = corrupt(i, got_flat)
+                deq = got_flat.astype(flat.dtype)
+                ok = (
+                    _verify_wire(
+                        got_flat, got[2] if checksum else None, deq,
+                        checksum, finite,
+                    )
+                    if integrity else None
                 )
+                return unravel(deq), _unflat_fire(got_vec), ok
     else:
         masked = jax.tree.map(
             lambda p, f: jnp.where(f, p, jnp.zeros_like(p)), payload, fire
@@ -304,33 +382,42 @@ def masked_neighbor_vals(
         if wire == "int8":
             q, scale_vec, scale_def = _int8_encode(masked)
 
-            def receive(nb):
+            def receive(nb, i):
                 got_tree, got_s, got_vec = recv_from(
                     (q, scale_vec, fire_vec), topo, nb
                 )
                 return _int8_decode(got_tree, got_s, scale_def, masked), (
                     _unflat_fire(got_vec)
-                )
+                ), None
         else:
             wire_tree = _wire_out(masked, wire)
 
-            def receive(nb):
+            def receive(nb, i):
                 got_p, got_f = recv_from((wire_tree, fire), topo, nb)
-                return _wire_in(got_p, masked), got_f
+                return _wire_in(got_p, masked), got_f, None
 
-    new_bufs, recv_fires = [], []
+    new_bufs, recv_fires, oks = [], [], []
     for i, (nb, last) in enumerate(zip(topo.neighbors, last_bufs)):
-        got_p, got_f = receive(nb)
+        got_p, got_f, ok = receive(nb, i)
         eff_f = got_f
+        if ok is not None:
+            # a failed wire check is an event that did not fire: the
+            # stale buffer survives bitwise (same where as deliver)
+            eff_f = jax.tree.map(
+                lambda f, _o=ok: jnp.logical_and(f, _o), eff_f
+            )
         if deliver is not None:
             eff_f = jax.tree.map(
-                lambda f, _d=deliver[i]: jnp.logical_and(f, _d), got_f
+                lambda f, _d=deliver[i]: jnp.logical_and(f, _d), eff_f
             )
         buf = jax.tree.map(
             lambda f, new, old: jnp.where(f, new, old), eff_f, got_p, last
         )
         new_bufs.append(buf)
         recv_fires.append(got_f)
+        oks.append(ok)
+    if integrity:
+        return tuple(new_bufs), tuple(recv_fires), jnp.stack(oks)
     return tuple(new_bufs), tuple(recv_fires)
 
 
@@ -412,7 +499,10 @@ def compact_neighbor_vals(
     capacity: int,
     wire=None,
     deliver: "Optional[Any]" = None,
-) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+    checksum: bool = False,
+    finite: bool = False,
+    corrupt=None,
+):
     """Event-triggered exchange through a fixed-capacity compacted buffer:
     non-fired leaves never touch the interconnect.
 
@@ -430,8 +520,13 @@ def compact_neighbor_vals(
     `capacity` is static (jit-shape); pick it with `choose_capacity` from
     the observed post-warmup fire rate. Requires a single parameter dtype
     and `capacity >= max leaf size` (a bigger leaf could never ship).
-    `deliver` has the masked-path chaos semantics. See docs/compaction.md.
+    `deliver` has the masked-path chaos semantics, and `checksum` /
+    `finite` / `corrupt` the masked-path integrity semantics (the
+    checksum covers the packed wire buffer; a failed check keeps every
+    stale leaf, and the third return value `oks` carries the per-edge
+    verdicts). See docs/compaction.md.
     """
+    integrity = checksum or finite or corrupt is not None
     leaves, treedef = jax.tree.flatten(payload)
     if len(leaves) < 1:
         raise ValueError("compact exchange needs a non-empty payload")
@@ -468,26 +563,48 @@ def compact_neighbor_vals(
         # same codec call as the masked wire — the bit-identity guarantee
         # rests on the two sites sharing one quantize
         wire_packed = _int8_encode_flat(packed, scale_vec, leaf_id)
+        csum = wire_checksum(wire_packed) if checksum else None
 
         def ship(nb):
-            return recv_from((wire_packed, scale_vec, fire_vec), topo, nb)
+            lanes = (wire_packed, scale_vec, fire_vec) + (
+                (csum,) if checksum else ()
+            )
+            got = recv_from(lanes, topo, nb)
+            return got[0], got[1], got[2], (got[3] if checksum else None)
     else:
         wire_packed = _wire_out(packed, wire)
+        csum = wire_checksum(wire_packed) if checksum else None
 
         def ship(nb):
-            got_packed, got_vec = recv_from((wire_packed, fire_vec), topo, nb)
-            return got_packed, None, got_vec
+            lanes = (wire_packed, fire_vec) + ((csum,) if checksum else ())
+            got = recv_from(lanes, topo, nb)
+            return got[0], None, got[1], (got[2] if checksum else None)
 
     sizes_arr = jnp.asarray(sizes, jnp.int32)
-    new_bufs, recv_fires = [], []
+    new_bufs, recv_fires, oks = [], [], []
     for i, (nb, last) in enumerate(zip(topo.neighbors, last_bufs)):
-        got_packed, got_scales, got_vec = ship(nb)
+        got_packed, got_scales, got_vec, got_c = ship(nb)
+        if corrupt is not None:
+            got_packed = corrupt(i, got_packed)
+        ok = None
+        if integrity:
+            # finite guard: the float wire carries values directly; the
+            # int8 wire's values are finite by construction but decode
+            # through the f32 scale lane — verify whichever can go bad
+            dec = (
+                got_packed.astype(jnp.float32)
+                if jnp.issubdtype(got_packed.dtype, jnp.floating)
+                else got_scales
+            )
+            ok = _verify_wire(got_packed, got_c, dec, checksum, finite)
         # offsets recomputed from the received fire bits (implicit lane)
         got_fired = jnp.where(got_vec, sizes_arr, 0)
         got_offsets = jnp.cumsum(got_fired) - got_fired
         eff_vec = got_vec
+        if ok is not None:
+            eff_vec = eff_vec & ok
         if deliver is not None:
-            eff_vec = got_vec & deliver[i]
+            eff_vec = eff_vec & deliver[i]
         stale_leaves, last_def = jax.tree.flatten(last)
         out = []
         for k, stale in enumerate(stale_leaves):
@@ -499,6 +616,9 @@ def compact_neighbor_vals(
             out.append(jnp.where(eff_vec[k], val.reshape(stale.shape), stale))
         new_bufs.append(jax.tree.unflatten(last_def, out))
         recv_fires.append(_unflat_fire(got_vec))
+        oks.append(ok)
+    if integrity:
+        return tuple(new_bufs), tuple(recv_fires), jnp.stack(oks)
     return tuple(new_bufs), tuple(recv_fires)
 
 
@@ -660,8 +780,10 @@ def masked_neighbor_vals_flat(
     wire=None,
     deliver: "Optional[Any]" = None,
     wire_builder=None,
-) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...],
-           Tuple[jnp.ndarray, ...]]:
+    checksum: bool = False,
+    finite: bool = False,
+    corrupt=None,
+):
     """Event-triggered masked exchange on the arena.
 
     The zero-masking of non-fired leaves fuses into the wire build
@@ -677,7 +799,13 @@ def masked_neighbor_vals_flat(
     masked-wire kernel (ops.event_engine.masked_wire; the step gates it
     on TPU + a measured ops/arena_tuning.py win): the payload is then
     assembled raw and masked/quantized by the kernel in its own single
-    HBM pass, bitwise the inline fused form."""
+    HBM pass, bitwise the inline fused form.
+
+    `checksum` / `finite` / `corrupt` have the tree masked path's
+    integrity semantics (a failed check clears the edge's eff bits; the
+    verdicts come back as a fourth return value `oks`, bool
+    [n_neighbors] stacked)."""
+    integrity = checksum or finite or corrupt is not None
     leaves = spec.treedef.flatten_up_to(payload)
     dt = spec.dtype
     if wire == "int8":
@@ -705,12 +833,23 @@ def masked_neighbor_vals_flat(
                 ],
                 jnp.int8,
             )
+        csum = wire_checksum(q) if checksum else None
 
-        def receive(nb):
-            got_q, got_s, got_vec = recv_from(
-                (q, scale_vec, fire_vec), topo, nb
+        def receive(nb, i):
+            lanes = (q, scale_vec, fire_vec) + ((csum,) if checksum else ())
+            got = recv_from(lanes, topo, nb)
+            got_q, got_s, got_vec = got[0], got[1], got[2]
+            if corrupt is not None:
+                got_q = corrupt(i, got_q)
+            cand = got_q.astype(dt) * got_s[seg].astype(dt)
+            ok = (
+                _verify_wire(
+                    got_q, got[3] if checksum else None, cand,
+                    checksum, finite,
+                )
+                if integrity else None
             )
-            return got_q.astype(dt) * got_s[seg].astype(dt), got_vec
+            return cand, got_vec, ok
     else:
         if wire_builder is not None:
             masked = wire_builder(
@@ -726,18 +865,38 @@ def masked_neighbor_vals_flat(
                 dt,
             )
         wire_buf = _wire_out(masked, wire)
+        csum = wire_checksum(wire_buf) if checksum else None
 
-        def receive(nb):
-            got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
-            return got_flat.astype(dt), got_vec
+        def receive(nb, i):
+            lanes = (wire_buf, fire_vec) + ((csum,) if checksum else ())
+            got = recv_from(lanes, topo, nb)
+            got_flat, got_vec = got[0], got[1]
+            if corrupt is not None:
+                got_flat = corrupt(i, got_flat)
+            cand = got_flat.astype(dt)
+            ok = (
+                _verify_wire(
+                    got_flat, got[2] if checksum else None, cand,
+                    checksum, finite,
+                )
+                if integrity else None
+            )
+            return cand, got_vec, ok
 
-    cands, effs, raws = [], [], []
+    cands, effs, raws, oks = [], [], [], []
     for i, nb in enumerate(topo.neighbors):
-        got_flat, got_vec = receive(nb)
-        eff = got_vec if deliver is None else (got_vec & deliver[i])
+        got_flat, got_vec, ok = receive(nb, i)
+        eff = got_vec
+        if ok is not None:
+            eff = eff & ok
+        if deliver is not None:
+            eff = eff & deliver[i]
         cands.append(got_flat)
         effs.append(eff)
         raws.append(got_vec)
+        oks.append(ok)
+    if integrity:
+        return tuple(cands), tuple(effs), tuple(raws), jnp.stack(oks)
     return tuple(cands), tuple(effs), tuple(raws)
 
 
@@ -751,8 +910,10 @@ def compact_neighbor_vals_flat(
     spec: "arena.ArenaSpec",
     wire=None,
     deliver: "Optional[Any]" = None,
-) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...],
-           Tuple[jnp.ndarray, ...]]:
+    checksum: bool = False,
+    finite: bool = False,
+    corrupt=None,
+):
     """Budgeted compacted exchange on the arena.
 
     `packed`/`leaf_id` come pre-built from the single-pass
@@ -763,7 +924,10 @@ def compact_neighbor_vals_flat(
     starts[k])]` — the exact elements `compact_neighbor_vals` slices
     out, selected by the same `where(eff, new, stale)` rule at commit
     time. Returns the same (candidates, eff bits, raw bits) triple as
-    the masked flat path."""
+    the masked flat path, plus the per-edge `oks` verdicts when any of
+    `checksum` / `finite` / `corrupt` (tree compact path semantics) is
+    set."""
+    integrity = checksum or finite or corrupt is not None
     capacity = int(capacity)
     if capacity < spec.floor:
         raise ValueError(
@@ -779,15 +943,22 @@ def compact_neighbor_vals_flat(
         # same codec as the masked wire (per-position scale is the
         # packed element's source-leaf scale)
         wire_packed = _int8_encode_flat(packed, scale_vec, leaf_id)
+        csum = wire_checksum(wire_packed) if checksum else None
 
         def ship(nb):
-            return recv_from((wire_packed, scale_vec, fire_vec), topo, nb)
+            lanes = (wire_packed, scale_vec, fire_vec) + (
+                (csum,) if checksum else ()
+            )
+            got = recv_from(lanes, topo, nb)
+            return got[0], got[1], got[2], (got[3] if checksum else None)
     else:
         wire_packed = _wire_out(packed, wire)
+        csum = wire_checksum(wire_packed) if checksum else None
 
         def ship(nb):
-            got_packed, got_vec = recv_from((wire_packed, fire_vec), topo, nb)
-            return got_packed, None, got_vec
+            lanes = (wire_packed, fire_vec) + ((csum,) if checksum else ())
+            got = recv_from(lanes, topo, nb)
+            return got[0], None, got[1], (got[2] if checksum else None)
 
     seg = spec.seg_expand()
     sizes_arr = spec.sizes_arr()
@@ -795,9 +966,19 @@ def compact_neighbor_vals_flat(
     pos_in_leaf = (
         jnp.arange(spec.n_total, dtype=jnp.int32) - spec.starts_arr()[seg]
     )
-    cands, effs, raws = [], [], []
+    cands, effs, raws, oks = [], [], [], []
     for i, nb in enumerate(topo.neighbors):
-        got_packed, got_scales, got_vec = ship(nb)
+        got_packed, got_scales, got_vec, got_c = ship(nb)
+        if corrupt is not None:
+            got_packed = corrupt(i, got_packed)
+        ok = None
+        if integrity:
+            dec = (
+                got_packed.astype(jnp.float32)
+                if jnp.issubdtype(got_packed.dtype, jnp.floating)
+                else got_scales
+            )
+            ok = _verify_wire(got_packed, got_c, dec, checksum, finite)
         # offsets recomputed from the received fire bits (implicit lane)
         got_fired = jnp.where(got_vec, sizes_arr, 0)
         got_offsets = jnp.cumsum(got_fired) - got_fired
@@ -806,10 +987,17 @@ def compact_neighbor_vals_flat(
         val = data.astype(dt)
         if got_scales is not None:
             val = val * got_scales[seg].astype(dt)
-        eff = got_vec if deliver is None else (got_vec & deliver[i])
+        eff = got_vec
+        if ok is not None:
+            eff = eff & ok
+        if deliver is not None:
+            eff = eff & deliver[i]
         cands.append(val)
         effs.append(eff)
         raws.append(got_vec)
+        oks.append(ok)
+    if integrity:
+        return tuple(cands), tuple(effs), tuple(raws), jnp.stack(oks)
     return tuple(cands), tuple(effs), tuple(raws)
 
 

@@ -47,6 +47,16 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 
+#: exit code reserved by the integrity engine for "the divergence
+#: sentinel tripped beyond the rollback budget" — a relaunch from the
+#: latest snapshot would replay the same divergence, so the supervisor
+#: gives up immediately, restart budget notwithstanding. Kept equal to
+#: chaos.integrity.INTEGRITY_ABORT_EXIT (pinned by tests/
+#: test_supervise.py) without importing it: the supervisor must stay a
+#: jax-free process.
+INTEGRITY_ABORT_EXIT = 77
+
+
 class RestartBudget:
     """Sliding-window restart budget: allow at most `max_restarts`
     restarts within any trailing `window_s` seconds. `window_s=0` means
@@ -198,6 +208,17 @@ def supervise(
         rc = proc.returncode
         if rc == 0:
             return 0
+        if rc == INTEGRITY_ABORT_EXIT:
+            # permanent escalation from the integrity engine: restarting
+            # would restore the same last-known-good snapshot and replay
+            # the same divergence — human (or policy) attention required
+            print(
+                f"supervise: child exited {rc} (integrity escalation); "
+                "giving up without restart — a relaunch would replay "
+                "the same divergence",
+                file=sys.stderr, flush=True,
+            )
+            return rc
         attempt += 1
         if _now() - t_launch >= backoff_reset_s:
             consecutive = 0

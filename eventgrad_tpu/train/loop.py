@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from eventgrad_tpu.chaos import integrity as chaos_integrity
 from eventgrad_tpu.chaos import membership as chaos_membership
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos import schedule as chaos_schedule
@@ -262,6 +263,7 @@ def train(
     chaos: Optional[Any] = None,
     chaos_policy: Optional[RecoveryPolicy] = None,
     membership: Optional[Any] = None,
+    integrity: Optional[Any] = None,
     on_epoch: Optional[Any] = None,
     device_data: Optional[bool] = None,
     epochs_per_dispatch: int = 1,
@@ -334,6 +336,35 @@ def train(
     plain-ring gossip runs only (dpsgd/eventgrad, mesh=None, no
     device_data/trace_file; pipeline forced off — transitions mutate
     state between blocks). See docs/chaos.md "Membership & elasticity".
+
+    integrity (a chaos.IntegrityConfig, "on"/"off", or serialized dict)
+    arms the integrity engine (chaos/integrity.py, docs/chaos.md
+    "Integrity & rollback"). The IN-STEP defenses — wire checksums on
+    every gossip payload (a failed check is an event that did not fire)
+    and non-finite quarantine (a rank whose grads go NaN/Inf skips its
+    update and suppresses its sends) — ride the fused step
+    (algo="eventgrad"; they compose with the pipeline). The HOST-SIDE
+    engine — the `DivergenceSentinel` judging every dispatch block's
+    mean loss and consensus-error probe, and the rollback that restores
+    all ranks from the retained last-known-good snapshot on a trip —
+    rides the block drain and forces the serial schedule (like
+    membership: a tripped block must not cascade into an already-
+    dispatched successor). Rollback: the loop retains a host-memory
+    last-known-good snapshot after every HEALTHY block (plus validated
+    on-disk rolling retention under `<checkpoint_dir>/good` via
+    utils/checkpoint.RollingRetention when a checkpoint_dir exists); on
+    trip it restores that snapshot, re-arms every event buffer through
+    the membership engine's force_refresh (all wires rewire in one fire
+    cycle), HARDENS the step (escalate=True: checksums + quarantine on,
+    one recompile) and replays — deterministically, so the whole run
+    (faults, trip, rollback, replay) is bitwise-reproducible from the
+    seed. A trip beyond max_rollbacks (or with rollback disabled)
+    raises chaos.IntegrityEscalation — the CLI exits
+    INTEGRITY_ABORT_EXIT and the supervisor gives up WITHOUT a restart.
+    History records gain wire_rejects / quarantined_steps /
+    integrity_rollbacks; the first record carries the serialized config
+    (replayability, like `chaos`), and the first record after a
+    rollback carries `integrity_rollback` (reason, epochs, hardened).
 
     gossip_wire="compact" (eventgrad only) switches the exchange to the
     budgeted compacted wire (collectives.compact_neighbor_vals) once
@@ -438,6 +469,35 @@ def train(
         return registry.span(name, **args)
 
     chaos_sched = chaos_schedule.resolve(chaos) if chaos is not None else None
+    # --- integrity-engine resolution (chaos/integrity.py) --------------
+    integ_cfg = chaos_integrity.resolve(integrity)
+    # the host-side engine: sentinel judges blocks; rollback needs it
+    # (a rollback can only be *requested* by a trip)
+    integ_engine_on = integ_cfg is not None and integ_cfg.sentinel
+    integ_rollback_on = integ_engine_on and integ_cfg.rollback
+    if integ_cfg is not None:
+        if (integ_cfg.checksum or integ_cfg.quarantine) and algo != "eventgrad":
+            raise ValueError(
+                "integrity checksums/quarantine ride the event exchange "
+                f"(algo='eventgrad'); got algo={algo!r} — for the host-"
+                "side sentinel alone pass IntegrityConfig(checksum="
+                "False, quarantine=False)"
+            )
+        if integ_rollback_on and integ_cfg.escalate and algo != "eventgrad":
+            raise ValueError(
+                "integrity escalate=True hardens the event exchange "
+                "after a rollback (checksums + quarantine on), which "
+                f"needs algo='eventgrad'; got algo={algo!r} — pass "
+                "escalate=False"
+            )
+    if integ_engine_on and (mesh is not None or multihost.is_multiprocess()):
+        raise ValueError(
+            "the integrity sentinel/rollback engine needs the single-"
+            "process path (a rollback restores host-retained state "
+            "between blocks); in-step defenses alone "
+            "(IntegrityConfig(sentinel=False, rollback=False)) compose "
+            "with any backend"
+        )
     fault_mode, fault_epoch = None, -1
     if fault_inject:
         fault_mode, _, n = fault_inject.partition(":")
@@ -527,6 +587,14 @@ def train(
                 topo = memb_sched.topology_at(topo, ep0)
         memb_engine = chaos_membership.MembershipEngine(
             memb_sched, event_cfg=event_cfg, bootstrap_dir=checkpoint_dir,
+        )
+    if integ_rollback_on and memb_on:
+        raise ValueError(
+            "integrity rollback does not compose with membership "
+            "transitions (a retained snapshot's rank count can disagree "
+            "with the post-transition ring); run the sentinel without "
+            "rollback (IntegrityConfig(rollback=False)) or drop the "
+            "membership schedule"
         )
     tx = optax.sgd(learning_rate, momentum=momentum if momentum else None)
 
@@ -640,7 +708,10 @@ def train(
     # --- dispatch-pipeline resolution (docs/ARCHITECTURE.md): auto = on
     # wherever the serialized host chain is the only thing it removes
     if pipeline is None:
-        pipeline_on = not multi and fault_mode is None and not memb_on
+        pipeline_on = (
+            not multi and fault_mode is None and not memb_on
+            and not integ_engine_on
+        )
     else:
         pipeline_on = bool(pipeline)
         if pipeline_on and multi:
@@ -661,6 +732,14 @@ def train(
                 "pipeline=True cannot honor membership transitions (they "
                 "re-shape the state between blocks, which needs the "
                 "serial schedule); use pipeline=None/False"
+            )
+        if pipeline_on and integ_engine_on:
+            raise ValueError(
+                "pipeline=True cannot honor the integrity sentinel/"
+                "rollback engine (the verdict on block B gates what "
+                "block B+1 may dispatch); use pipeline=None/False, or "
+                "keep only the in-step defenses (IntegrityConfig("
+                "sentinel=False, rollback=False))"
             )
     # shape metadata only — never dispatch a device op just to count
     n_params = trees.tree_count_params(state.params) // topo.n_ranks
@@ -783,6 +862,10 @@ def train(
     start_passes = int(np.asarray(state.pass_num).reshape(-1)[0])
     if mesh is not None:
         state = multihost.put_stacked(state, mesh, topo)
+    # the ACTIVE in-step integrity config: a rollback with escalate=True
+    # swaps it for cfg.hardened() and rebuilds the runners once
+    integ_now = integ_cfg
+
     def _build_step(wire_mode: str, capacity: Optional[int] = None):
         return make_train_step(
             model, tx, topo, algo,
@@ -794,6 +877,7 @@ def train(
             gossip_wire=wire_mode, compact_capacity=capacity,
             obs=obs_on,
             arena=arena_on,
+            integrity=integ_now,
             # NOTE arena_sgd (the all-flat SGD tail) stays off: it costs
             # two extra full-model ravels per step, and the measured CPU
             # ravel price (see ArenaSpec.ravel) makes the unflatten +
@@ -962,10 +1046,29 @@ def train(
     # One evaluator per run: the jitted scan and the device-resident test
     # set are reused at every block end.
     evaluator = DeviceEvaluator(model, x_test, y_test) if eval_on else None
-    probe_on = (chaos_sched is not None or obs_on) and not multi and not hybrid
+    probe_on = (
+        (chaos_sched is not None or obs_on or integ_cfg is not None)
+        and not multi and not hybrid
+    )
     ckpt_writer = (
         checkpoint.AsyncWriter() if (ckpt_path and pipeline_on) else None
     )
+    # --- integrity engine state (chaos/integrity.py) -------------------
+    integ_sentinel = (
+        chaos_integrity.DivergenceSentinel(integ_cfg) if integ_engine_on
+        else None
+    )
+    integ_retention = (
+        checkpoint.RollingRetention(
+            os.path.join(checkpoint_dir, "good"), keep=integ_cfg.keep_good,
+        )
+        if integ_rollback_on and checkpoint_dir else None
+    )
+    integ_good: Optional[Dict[str, Any]] = None  # last-known-good snapshot
+    integ_trip: Optional[str] = None      # set by _drain, consumed below
+    integ_rollbacks = 0
+    integ_rollback_info: Optional[Dict[str, Any]] = None
+    integ_totals = {"wire_rejects": 0, "quarantined_steps": 0}
     blocks = list(_blocks())
     # observed-readiness clock for wall_s: dt of a block runs from its
     # dispatch (or the previous block's observed readiness, whichever is
@@ -1003,6 +1106,7 @@ def train(
         nonlocal compact_capacity, compact_done, compact_note
         nonlocal compact_fired_peak, compact_post_steps
         nonlocal run_epoch, run_epoch_idx
+        nonlocal integ_trip, integ_rollback_info
         blk_i, blk_start, blk_end = hw["blk_i"], hw["blk_start"], hw["blk_end"]
         n_e = blk_end - blk_start + 1
         mode_now, cold, label_shape = hw["mode"], hw["cold"], hw["label_shape"]
@@ -1137,6 +1241,24 @@ def train(
                     np.asarray(m_e["chaos_drops"])[-1],
                     event_cfg.max_silence if event_cfg else 0,
                 ))
+            if integ_cfg is not None:
+                if not history:  # replayability: config rides record 1
+                    rec["integrity"] = integ_cfg.to_dict()
+                if "integrity_wire_reject" in m_e:
+                    # per-step in-step verdicts, summed over the epoch
+                    # (ranks x edges / ranks); cumulative forms feed the
+                    # *_total gauges below
+                    wr = int(np.asarray(m_e["integrity_wire_reject"]).sum())
+                    qs = int(np.asarray(m_e["integrity_quarantined"]).sum())
+                    integ_totals["wire_rejects"] += wr
+                    integ_totals["quarantined_steps"] += qs
+                    rec["wire_rejects"] = wr
+                    rec["quarantined_steps"] = qs
+                rec["integrity_rollbacks"] = integ_rollbacks
+                if integ_rollback_info is not None:
+                    # first record AFTER the engine restored last-good
+                    rec["integrity_rollback"] = integ_rollback_info
+                    integ_rollback_info = None
             if trace_file and "trace_fired" in m_e and multihost.is_primary():
                 _write_trace(
                     trace_file, m_e, total_passes - steps, topo,
@@ -1192,6 +1314,31 @@ def train(
                     "membership_transitions_total",
                     float(len(memb_engine.log)),
                 )
+            if integ_cfg is not None:
+                # Prometheus faces of the integrity story (obs/schema.py
+                # INTEGRITY_FIELDS): cumulative rejections, quarantined
+                # rank-passes, and rollbacks performed
+                registry.gauge(
+                    "wire_rejects_total", float(integ_totals["wire_rejects"])
+                )
+                registry.gauge(
+                    "quarantined_steps_total",
+                    float(integ_totals["quarantined_steps"]),
+                )
+                registry.gauge(
+                    "integrity_rollbacks_total", float(integ_rollbacks)
+                )
+        if integ_sentinel is not None:
+            # divergence sentinel: judge the BLOCK (mean loss over every
+            # step in the dispatch block + the block-end consensus-error
+            # probe); the verdict gates what the next block may dispatch
+            # (the loop's trip handler performs the rollback)
+            blk_loss = float(np.asarray(m["loss"], np.float64).mean())
+            cerr = (
+                float(np.asarray(hw["probe"]).max())
+                if hw["probe"] is not None else None
+            )
+            integ_trip = integ_sentinel.observe(blk_loss, cerr)
         if not compact_done:
             # collect post-warmup fired sizes from this block; once
             # enough are in (or warmup is past, with an explicit
@@ -1256,13 +1403,36 @@ def train(
                     )
                 compact_done = True
 
+    if integ_rollback_on:
+        # an in-memory snapshot ALWAYS backs the rollback: seed the
+        # last-known-good with the initial (or resumed) state, so a trip
+        # on the very first block rolls back to the start and replays
+        # hardened instead of escalating
+        integ_good = {
+            "snap": checkpoint.host_snapshot({
+                "state": state,
+                "epoch": np.int64(start_epoch),
+                "trace_carry": trace_carry,
+            }),
+            "epoch": start_epoch,
+            "next_bi": 0,
+            "sentinel": integ_sentinel.snapshot(),
+            "obs_prev": obs_prev,
+            "passes_done": passes_done,
+            "rank_passes_done": rank_passes_done,
+        }
     _root_span = contextlib.ExitStack()
     pending: Optional[Dict[str, Any]] = None
     try:
         _root_span.enter_context(
             _span("train", cat="run", algo=algo, pipelined=pipeline_on)
         )
-        for blk_i, (blk_start, blk_end) in enumerate(blocks):
+        bi = 0
+        while bi < len(blocks):
+            # index-based iteration: an integrity rollback REWINDS bi to
+            # the block after the restored snapshot and replays
+            blk_i = bi
+            blk_start, blk_end = blocks[bi]
             n_e = blk_end - blk_start + 1
             # first block of each distinct (size, wire-mode) pays a jit
             # trace+compile (scan length is part of the shape, and the
@@ -1376,6 +1546,119 @@ def train(
                 _drain(hw)
             else:
                 pending = hw
+            if integ_sentinel is not None:
+                # the sentinel forces the serial schedule, so this
+                # block's verdict landed in the _drain above
+                reason, integ_trip = integ_trip, None
+                if reason is not None:
+                    if (
+                        not integ_rollback_on
+                        or integ_rollbacks >= integ_cfg.max_rollbacks
+                    ):
+                        raise chaos_integrity.IntegrityEscalation(
+                            f"divergence sentinel tripped ({reason}) at "
+                            f"epoch {blk_end} with "
+                            + ("rollback disarmed"
+                               if not integ_rollback_on else
+                               "the rollback budget spent "
+                               f"({integ_rollbacks}/"
+                               f"{integ_cfg.max_rollbacks})")
+                            + "; the retained last-known-good state "
+                            "cannot outrun this fault — restarting "
+                            "would replay the same divergence"
+                        )
+                    integ_rollbacks += 1
+                    with _span(
+                        "integrity_rollback", cat="host", epoch=blk_end
+                    ):
+                        # restore EVERY rank from last-known-good, then
+                        # re-arm all event buffers through the
+                        # membership engine's force_refresh — the next
+                        # pass force-fires every exchange, so stale
+                        # receive buffers rewire in one cycle
+                        state = jax.tree.map(
+                            jnp.asarray, integ_good["snap"]["state"]
+                        )
+                        state = chaos_membership.force_refresh(
+                            state, event_cfg
+                        )
+                        # owned copy: trace writes during the replay
+                        # must not mutate the retained snapshot
+                        trace_carry = {
+                            k: np.array(v)
+                            for k, v in
+                            integ_good["snap"]["trace_carry"].items()
+                        }
+                    hardened = False
+                    if integ_cfg.escalate:
+                        # harden the step: the replayed segment meets
+                        # the same scheduled faults (replay is pass-
+                        # keyed), so rolling back without checksums +
+                        # quarantine would diverge identically and
+                        # burn the budget. One recompile.
+                        new_cfg = integ_now.hardened()
+                        if new_cfg != integ_now:
+                            integ_now = new_cfg
+                            hardened = True
+                            run_epoch, run_epoch_idx = _build_runners(
+                                spmd(
+                                    _build_step(
+                                        "compact"
+                                        if compact_capacity is not None
+                                        else "dense",
+                                        compact_capacity,
+                                    ),
+                                    topo, mesh=mesh,
+                                )
+                            )
+                            # a new program: every block size pays a
+                            # fresh compile — keep the cold tags honest
+                            seen_block_sizes.clear()
+                    if prefetcher is not None:
+                        # the worker speculates FORWARD; a rewind needs
+                        # a fresh prefetcher at the replay start
+                        prefetcher.close()
+                        prefetcher = EpochPrefetcher(
+                            x_train, y_train, n_data, batch_size,
+                            random=random_sampler, seed=seed,
+                            last_epoch=epochs, transfer=transfer,
+                        )
+                    integ_sentinel.rewind(integ_good["sentinel"])
+                    obs_prev = integ_good["obs_prev"]
+                    passes_done = integ_good["passes_done"]
+                    rank_passes_done = integ_good["rank_passes_done"]
+                    integ_rollback_info = {
+                        "reason": reason,
+                        "tripped_epoch": blk_end,
+                        "restored_epoch": integ_good["epoch"],
+                        "hardened": hardened,
+                    }
+                    bi = integ_good["next_bi"]
+                    continue
+                if integ_rollback_on:
+                    # a HEALTHY block becomes the new last-known-good:
+                    # host-memory always; validated rolling retention on
+                    # disk at checkpoint cadence (each snapshot rides
+                    # save()'s fsynced atomic swap)
+                    with _span(
+                        "integrity_retain", cat="host", epoch=blk_end
+                    ):
+                        snap = checkpoint.host_snapshot({
+                            "state": state,
+                            "epoch": np.int64(blk_end),
+                            "trace_carry": trace_carry,
+                        })
+                    integ_good = {
+                        "snap": snap,
+                        "epoch": blk_end,
+                        "next_bi": bi + 1,
+                        "sentinel": integ_sentinel.snapshot(),
+                        "obs_prev": obs_prev,
+                        "passes_done": passes_done,
+                        "rank_passes_done": rank_passes_done,
+                    }
+                    if integ_retention is not None and ckpt_due:
+                        integ_retention.save_good(blk_end, snap)
             if memb_engine is not None:
                 # elastic membership transitions land HERE: after the
                 # block's host work drained (membership forces the serial
@@ -1476,6 +1759,7 @@ def train(
                     os._exit(13)
                 while True:  # "hang": alive but no progress (no heartbeat)
                     time.sleep(3600)
+            bi += 1
         if pending is not None:
             _drain(pending)
             pending = None

@@ -100,6 +100,7 @@ def make_train_step(
     compact_capacity: Optional[int] = None,
     obs: bool = False,
     arena: bool = False,
+    integrity: Optional[Any] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -169,6 +170,26 @@ def make_train_step(
     obs=False the traced program is bit-identical to before the telemetry
     subsystem existed (regression-tested in tests/test_obs.py).
 
+    integrity (a chaos.integrity.IntegrityConfig) arms the in-step
+    integrity defenses on the event exchange (algo="eventgrad" only):
+    with `checksum`, every masked/compact payload ships a
+    collectives.wire_checksum and a failed verification is treated
+    exactly as an event that did not fire (stale buffer kept, rejection
+    counted per edge and — with chaos — fed into PeerHealth silence so
+    the existing sync/freeze policies escalate); with `quarantine`,
+    non-finite local gradients make the rank skip its optimizer update
+    and suppress its sends for the step (it keeps mixing — gossip is
+    the recovery), incoming payloads are finite-checked like a failed
+    checksum, and a non-finite post-update parameter set rolls the rank
+    back to its pre-step state. With both flags off (or integrity=None)
+    the traced step is bit-identical to a pre-integrity build; with
+    them on but no faults firing, the trajectory is bitwise-unchanged
+    (gates that never trip select the same values). The chaos
+    `bitflip=` / `nanstep=` clauses inject the corresponding faults —
+    with integrity off they land silently (the measured counterfactual
+    of tools/integrity_sweep.py). Not combinable with the fused Pallas
+    tail (the quarantine gate rides the optax tail).
+
     chaos (a chaos.ChaosSchedule) injects deterministic message loss into
     the gossip edges inside this fused step: a dropped message keeps the
     receiver's stale buffer (eventgrad) or leaves the edge out of a
@@ -212,6 +233,25 @@ def make_train_step(
             "chaos_policy requires chaos (pass ChaosSchedule() to run "
             "monitoring/recovery without injected faults)"
         )
+    integ_checksum = integrity is not None and integrity.checksum
+    integ_quar = integrity is not None and integrity.quarantine
+    if (integ_checksum or integ_quar) and algo != "eventgrad":
+        raise ValueError(
+            "integrity checksums/quarantine ride the event exchange's "
+            f"not-fired semantics (algo='eventgrad'); got algo={algo!r}"
+        )
+    if (integ_checksum or integ_quar) and fused_sgd is not None:
+        raise ValueError(
+            "integrity is not combinable with the fused update tail: the "
+            "quarantine gate selects between the mixed and updated "
+            "parameters in the optax tail"
+        )
+    if chaos is not None and (chaos.has_bitflips or chaos.has_nansteps):
+        if algo != "eventgrad":
+            raise ValueError(
+                "bitflip=/nanstep= faults target the event exchange "
+                f"(algo='eventgrad'); got algo={algo!r}"
+            )
     chaos_policy = chaos_policy or RecoveryPolicy()
     if chaos is not None:
         chaos_policy.validate_against(event_cfg.max_silence if event_cfg else 0)
@@ -308,6 +348,37 @@ def make_train_step(
                 return g / _n if sharded else lax.pmean(g, _ax)
 
             grads = jax.tree_util.tree_map_with_path(fix, grads)
+
+        # chaos nanstep= injection: poison this rank's gradients with NaN
+        # on the scheduled pass — BEFORE the quarantine guard, so the
+        # defense (or, with integrity off, the counterfactual poisoning)
+        # sees exactly what a sick rank would produce
+        if chaos is not None and chaos.has_nansteps:
+            poison = chaos_inject.nanstep_mask(chaos, topo, pass_num)
+            bad = jnp.where(poison, jnp.float32(jnp.nan), jnp.float32(1.0))
+            grads = jax.tree.map(lambda g: g * bad.astype(g.dtype), grads)
+
+        # non-finite quarantine (chaos/integrity.py): a rank whose grads
+        # went NaN/Inf skips its update and suppresses its sends this
+        # pass. One stacked [L]-scalar reduction — the guard's whole cost.
+        quar = None
+        if integ_quar:
+            quar = ~jnp.all(jnp.stack(
+                [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+            ))
+
+        # chaos bitflip= injection: the per-edge in-transit corruption
+        # transform the event exchanges apply to received wire buffers
+        corrupt_fn = None
+        if chaos is not None and chaos.has_bitflips:
+            cbits, csalts = chaos_inject.corrupt_mask(chaos, topo, pass_num)
+            corrupt_fn = lambda i, buf: chaos_inject.flip_one_bit(
+                buf, cbits[i], csalts[i]
+            )
+        integ_wire = bool(
+            integ_checksum or integ_quar or corrupt_fn is not None
+        )
+        oks = None  # per-edge wire verdicts (bool [n_nb]) when integ_wire
 
         params = state.params
         event_state = state.event
@@ -419,14 +490,17 @@ def make_train_step(
                     compact_capacity if gossip_wire == "compact" else None
                 ),
                 force_fire=force_fire,
+                suppress_fire=quar,  # quarantine: send nothing this pass
             )
             event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
             obs_prop, obs_fire_vec = prop, fire_vec
             arena_fire_vec = fire_vec
             if gossip_wire == "compact":
-                cands, effs, raws = collectives.compact_neighbor_vals_flat(
+                res = collectives.compact_neighbor_vals_flat(
                     params, fire_vec, packed, leaf_id, topo,
                     compact_capacity, spec, wire, deliver=deliver,
+                    checksum=integ_checksum, finite=integ_quar,
+                    corrupt=corrupt_fn,
                 )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -444,9 +518,11 @@ def make_train_step(
                     wb = lambda f, fe, se: event_engine.masked_wire(
                         f, fe, se, interpret=False
                     )
-                cands, effs, raws = collectives.masked_neighbor_vals_flat(
+                res = collectives.masked_neighbor_vals_flat(
                     params, fire_vec, topo, spec, wire, deliver=deliver,
                     wire_builder=wb,
+                    checksum=integ_checksum, finite=integ_quar,
+                    corrupt=corrupt_fn,
                 )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -454,11 +530,21 @@ def make_train_step(
                         fire_bits=True,
                     )
                 )
+            if integ_wire:
+                cands, effs, raws, oks = res
+            else:
+                cands, effs, raws = res
             if deliver is not None:
-                # raws are the RAW sender bits (what was on the wire)
+                # raws are the RAW sender bits (what was on the wire); a
+                # rejected payload is NOT a delivery — its silence keeps
+                # growing, so persistent corruption escalates through
+                # the existing sync/freeze policies
                 sent_any = jnp.stack([jnp.any(rv) for rv in raws])
+                delivered = sent_any & deliver
+                if oks is not None:
+                    delivered = delivered & oks
                 health = chaos_monitor.update(
-                    health, sent_any & deliver, sent_any & ~deliver
+                    health, delivered, sent_any & ~deliver
                 )
                 if chaos_policy.sync_after:
                     need = health.silence >= chaos_policy.sync_after
@@ -498,6 +584,12 @@ def make_train_step(
                 force_fire=force_fire,
             )
             fire_vec = prop.fire_vec
+            if quar is not None:
+                # quarantine: send nothing this pass (suppression wins
+                # over force_fire — never answer a sync request with
+                # poisoned values); suppressed leaves re-contend next
+                # pass like a capacity deferral
+                fire_vec = fire_vec & ~quar
             if gossip_wire == "compact":
                 # wire-budget admission: overdue leaves (max_silence) and
                 # chaos forced syncs claim capacity first; the overflow is
@@ -511,7 +603,7 @@ def make_train_step(
                     ff = jnp.broadcast_to(force_fire, fire_vec.shape)
                     pri = ff if pri is None else (pri | ff)
                 fire_vec = capacity_gate(
-                    prop.fire_vec, leaf_sizes, compact_capacity, priority=pri
+                    fire_vec, leaf_sizes, compact_capacity, priority=pri
                 )
             event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
             obs_prop, obs_fire_vec = prop, fire_vec
@@ -519,9 +611,11 @@ def make_train_step(
                 p_def, [fire_vec[i] for i in range(len(p_leaves))]
             )
             if gossip_wire == "compact":
-                new_bufs, recv_fires = collectives.compact_neighbor_vals(
+                res = collectives.compact_neighbor_vals(
                     params, fire, event_state.bufs, topo, compact_capacity,
                     wire, deliver=deliver,
+                    checksum=integ_checksum, finite=integ_quar,
+                    corrupt=corrupt_fn,
                 )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -530,9 +624,11 @@ def make_train_step(
                     )
                 )
             else:
-                new_bufs, recv_fires = collectives.masked_neighbor_vals(
+                res = collectives.masked_neighbor_vals(
                     params, fire, event_state.bufs, topo, wire,
                     deliver=deliver,
+                    checksum=integ_checksum, finite=integ_quar,
+                    corrupt=corrupt_fn,
                 )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -540,16 +636,25 @@ def make_train_step(
                         fire_bits=True,
                     )
                 )
+            if integ_wire:
+                new_bufs, recv_fires, oks = res
+            else:
+                new_bufs, recv_fires = res
             if deliver is not None:
                 # recv_fires are the RAW sender bits: sent & delivered
                 # resets silence, sent & ~delivered is an observed
-                # injected drop, ~sent is legitimate event quiet
+                # injected drop, ~sent is legitimate event quiet — and a
+                # REJECTED payload is not a delivery (silence grows, so
+                # persistent corruption escalates via sync/freeze)
                 sent_any = jnp.stack([
                     jnp.any(jnp.stack(jax.tree.leaves(rf)))
                     for rf in recv_fires
                 ])
+                delivered = sent_any & deliver
+                if oks is not None:
+                    delivered = delivered & oks
                 health = chaos_monitor.update(
-                    health, sent_any & deliver, sent_any & ~deliver
+                    health, delivered, sent_any & ~deliver
                 )
                 if chaos_policy.sync_after:
                     need = health.silence >= chaos_policy.sync_after
@@ -716,6 +821,40 @@ def make_train_step(
             updates, opt_state = tx.update(grads, state.opt_state, mixed)
             params = optax.apply_updates(mixed, updates)
 
+        quar_eff = None
+        if integ_quar:
+            # quarantine tail: the rank skips its gradient update (it
+            # keeps the gossip mix — healthy neighbors are the recovery
+            # path) and freezes its optimizer/BN state for the pass; a
+            # non-finite post-update parameter set (lr blowup — the
+            # fault the grad guard can't see) rolls the whole rank back
+            # to its pre-step state. Gates that never trip select the
+            # same values, so a fault-free trajectory is bitwise
+            # unchanged (tests/test_integrity.py).
+            params = jax.tree.map(
+                lambda m, p: jnp.where(quar, m, p), mixed, params
+            )
+            opt_state = jax.tree.map(
+                lambda o, n: jnp.where(quar, o, n),
+                state.opt_state, opt_state,
+            )
+            new_stats = jax.tree.map(
+                lambda o, n: jnp.where(quar, o, n),
+                state.batch_stats, new_stats,
+            )
+            params_ok = jnp.all(jnp.stack(
+                [jnp.all(jnp.isfinite(p)) for p in jax.tree.leaves(params)]
+            ))
+            params = jax.tree.map(
+                lambda old, n: jnp.where(params_ok, n, old),
+                state.params, params,
+            )
+            opt_state = jax.tree.map(
+                lambda old, n: jnp.where(params_ok, n, old),
+                state.opt_state, opt_state,
+            )
+            quar_eff = quar | ~params_ok
+
         if sync_bn and has_bn:
             new_stats = collectives.allreduce_mean(new_stats, topo)
 
@@ -740,6 +879,8 @@ def make_train_step(
                     silence=obs_prop.iter_diff,
                     fired_elems=fired_elems,
                     edge_bytes=per_edge,
+                    wire_reject=(~oks if oks is not None else None),
+                    quarantined=quar_eff,
                 )
             else:
                 telemetry = obs_device.accumulate(
@@ -777,6 +918,17 @@ def make_train_step(
         if chaos is not None:
             metrics["edge_silence"] = health.silence  # int32 [n_nb]
             metrics["chaos_drops"] = health.drops  # cumulative int32
+        if integrity is not None:
+            # per-step integrity verdicts (the loop sums them into the
+            # epoch records and the sentinel/artifact accounting)
+            metrics["integrity_wire_reject"] = (
+                (~oks).astype(jnp.int32) if oks is not None
+                else jnp.zeros((n_nb,), jnp.int32)
+            )
+            metrics["integrity_quarantined"] = (
+                quar_eff.astype(jnp.int32) if quar_eff is not None
+                else jnp.int32(0)
+            )
         if trace and algo in ("eventgrad", "sp_eventgrad"):
             # send{r}.txt columns: norm of the (pre-mix) param at the event
             # check, the post-decay/post-fire threshold, and the fire bit

@@ -17,10 +17,42 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+def _fsync_path(path: str) -> None:
+    """fsync one file or directory (best-effort on filesystems that
+    reject directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - e.g. directory fsync unsupported
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: str) -> None:
+    """fsync every file AND directory under `root`, bottom-up, then
+    `root`'s parent. A rename-commit is only durable once the renamed
+    tree's data and the directory entries referencing it have hit disk:
+    without the directory fsyncs a host crash can leave the promoted
+    name pointing at zero-length files — fatal for a rollback engine
+    whose whole contract is 'the last-known-good snapshot survives'."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for f in filenames:
+            _fsync_path(os.path.join(dirpath, f))
+        _fsync_path(dirpath)
+
+
 def save(path: str, state: Any) -> None:
-    """Crash-safe snapshot: write to `<path>.tmp`, swap the old snapshot to
-    `<path>.prev`, promote tmp, drop prev. A kill at any point leaves either
-    `<path>` or `<path>.prev` complete — `latest()` finds whichever survived.
+    """Crash-safe snapshot: write to `<path>.tmp`, fsync the written tree
+    (files and directories — rename-commit durability needs both), swap
+    the old snapshot to `<path>.prev`, promote tmp, drop prev, fsync the
+    parent directory so the renames themselves persist. A kill at any
+    point leaves either `<path>` or `<path>.prev` complete — `latest()`
+    finds whichever survived — and a HOST CRASH after return cannot lose
+    the promoted snapshot (the rollback engine depends on this).
 
     Multi-process: EVERY process must call this (orbax coordinates the write
     internally and only the primary touches disk); `path` must be on a
@@ -34,6 +66,9 @@ def save(path: str, state: Any) -> None:
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp, state, force=True)
     if multihost.is_primary():
+        # durability point: the tmp tree's bytes are on disk BEFORE any
+        # rename makes them the snapshot of record
+        _fsync_tree(tmp)
         if os.path.exists(path):
             # make room for the demotion; the current snapshot covers the gap
             if os.path.exists(prev):
@@ -44,6 +79,8 @@ def save(path: str, state: Any) -> None:
         os.rename(tmp, path)
         if os.path.exists(prev):
             shutil.rmtree(prev)
+        # persist the rename-commit itself
+        _fsync_path(os.path.dirname(path))
     multihost.barrier("eg-ckpt-promote")
 
 
@@ -133,6 +170,96 @@ class AsyncWriter:
         self._exc = None
 
 
+class RollingRetention:
+    """Validated rolling retention of last-known-good snapshots.
+
+    The integrity engine's rollback source (chaos/integrity.py): after
+    every dispatch block the divergence sentinel judged healthy, the
+    loop retains that state as `<directory>/good-<epoch>` — each written
+    through `save`'s fsynced atomic swap, so every retained snapshot is
+    individually crash-safe AND durable. Retention keeps the newest
+    `keep` VALIDATED snapshots; pruning runs BEFORE a new save is
+    dispatched (never after), so the invariant "at least one complete
+    validated snapshot exists on disk" holds at every instant — even if
+    the in-flight save dies mid-write, the newest retained snapshot
+    survives untouched. With `keep=1` that means the only validated
+    snapshot is never deleted until its successor has fully committed.
+
+    Writes go through the optional `writer` (an `AsyncWriter` — the
+    dispatch pipeline's background serialization) or synchronously via
+    `save` when none is given.
+    """
+
+    PREFIX = "good-"
+
+    def __init__(
+        self, directory: str, keep: int = 2,
+        writer: "Optional[AsyncWriter]" = None,
+    ):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self.writer = writer
+
+    def path_for(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"{self.PREFIX}{epoch:06d}")
+
+    def snapshots(self):
+        """Committed (promoted-name) snapshots as sorted (epoch, path)
+        tuples — in-flight `.tmp` and demoted `.prev` trees excluded."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if not name.startswith(self.PREFIX):
+                continue
+            if name.endswith(".tmp") or name.endswith(".prev"):
+                continue
+            try:
+                epoch = int(name[len(self.PREFIX):])
+            except ValueError:
+                continue
+            out.append((epoch, os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def latest_good(self):
+        """Newest retained (epoch, path), or None."""
+        snaps = self.snapshots()
+        return snaps[-1] if snaps else None
+
+    def prune(self) -> int:
+        """Delete the oldest snapshots beyond `keep`; returns how many.
+        Never touches the newest `keep` — in particular never the only
+        one."""
+        snaps = self.snapshots()
+        drop = snaps[: max(0, len(snaps) - self.keep)]
+        for _, p in drop:
+            shutil.rmtree(p, ignore_errors=True)
+            for suffix in (".tmp", ".prev"):  # any stale swap leftovers
+                if os.path.exists(p + suffix):
+                    shutil.rmtree(p + suffix, ignore_errors=True)
+        return len(drop)
+
+    def save_good(self, epoch: int, payload: Any) -> str:
+        """Retain one validated snapshot. Prunes FIRST (committed
+        snapshots only, keeping `keep`), then writes `payload` to
+        `path_for(epoch)` — async when a writer was given. The sync
+        path prunes once more after the commit (safe: the new snapshot
+        is already promoted); under a writer the extra snapshot rides
+        until the next call — pruning concurrently with the in-flight
+        promote could delete the only committed snapshot."""
+        os.makedirs(self.directory, exist_ok=True)
+        self.prune()
+        path = self.path_for(epoch)
+        if self.writer is not None:
+            self.writer.save(path, payload)
+        else:
+            save(path, payload)
+            self.prune()
+        return path
+
+
 def latest(path: str) -> Optional[str]:
     """The newest complete snapshot for `path` (the primary, or the .prev
     left by a save interrupted mid-swap); None if neither exists."""
@@ -149,10 +276,28 @@ def peek(path: str) -> Any:
     shape of the snapshot is itself unknown — e.g. a membership-elastic
     resume must read the saved epoch before it can size the state
     template (the rank count at that epoch follows from the membership
-    schedule; train/loop.py)."""
+    schedule; train/loop.py).
+
+    A truncated or corrupted snapshot fails LOUDLY with the offending
+    path and the recovery options — never half-restores: a resume that
+    silently proceeded from garbage would train on it."""
     path = os.path.abspath(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(path)
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(path)
+    except Exception as exc:
+        prev = path + ".prev"
+        hint = (
+            f"a demoted twin exists at {prev} — pass it instead"
+            if os.path.exists(prev)
+            else "no .prev twin exists; restore from a retained "
+                 "last-known-good snapshot (RollingRetention) or an "
+                 "earlier backup"
+        )
+        raise RuntimeError(
+            f"checkpoint at {path} is unreadable (truncated or "
+            f"corrupted): {type(exc).__name__}: {exc}. {hint}"
+        ) from exc
 
 
 def restore(path: str, template: Any, raw: Any = None) -> Any:

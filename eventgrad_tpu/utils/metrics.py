@@ -11,9 +11,37 @@ this stream behind the versioned telemetry schema (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+
+def _scrub_nonfinite(obj: Any, path: str, bad: List[str]) -> Any:
+    """Copy `obj` with NaN/Inf number leaves replaced by None, recording
+    each replaced leaf's dotted path in `bad`. Python and numpy scalars
+    both; containers recurse; everything else passes through untouched
+    (json's `default=` hook still sees it)."""
+    if isinstance(obj, dict):
+        return {
+            k: _scrub_nonfinite(v, f"{path}.{k}" if path else str(k), bad)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple)):
+        return [
+            _scrub_nonfinite(v, f"{path}[{i}]", bad)
+            for i, v in enumerate(obj)
+        ]
+    v = obj
+    if not isinstance(v, (bool, int, float, str, type(None))):
+        try:
+            v = float(v)  # numpy floating scalars and friends
+        except (TypeError, ValueError):
+            return obj
+    if isinstance(v, float) and not math.isfinite(v):
+        bad.append(path)
+        return None
+    return obj
 
 
 class JsonlLogger:
@@ -35,7 +63,18 @@ class JsonlLogger:
 
     def log(self, record: Dict[str, Any]) -> None:
         record = {"ts": round(time.time(), 3), **record}
-        line = json.dumps(record, default=float)
+        try:
+            line = json.dumps(record, default=float, allow_nan=False)
+        except ValueError:
+            # a NaN/Inf metric (a diverging loss — exactly the record an
+            # operator most needs) must neither crash the run mid-stream
+            # nor emit the bare `NaN` token json.loads rejects: serialize
+            # the offenders as null and name them in a rider, so the
+            # line stays valid JSON and the divergence stays visible
+            bad: List[str] = []
+            record = _scrub_nonfinite(record, "", bad)
+            record["nonfinite_fields"] = bad
+            line = json.dumps(record, default=float, allow_nan=False)
         if self._fh:
             self._fh.write(line + "\n")
             self._fh.flush()

@@ -1,0 +1,34 @@
+"""Shared SPMD test helpers: the one shard_map skip definition.
+
+The mesh lift needs `jax.shard_map`; some CPU-only environments run a
+jax without it, where the SEED's shard_map tests fail outright (the
+known pre-existing tier-1 failures). Tests added since skip instead —
+via this ONE marker, so the reason string and the condition live in a
+single place. A tier-1 lint test (tests/test_lint_spmd.py) enforces
+that every new test touching shard_map imports `requires_shard_map`
+from here rather than re-spelling the skipif — the debt stops
+spreading while ROADMAP Open item 1 (real-mesh SPMD: retire the
+single-chip vmap lift) is pending.
+
+Usage:
+
+    from _spmd import requires_shard_map
+
+    @requires_shard_map
+    def test_something_shard_map(): ...
+
+    BACKENDS = ["vmap", pytest.param("shard_map", marks=requires_shard_map)]
+"""
+
+import jax
+import pytest
+
+#: single source of truth for "this test needs the shard_map mesh lift"
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason=(
+        "jax.shard_map unavailable in this environment (the vmap lift "
+        "covers the semantics until ROADMAP Open item 1 — real-mesh "
+        "SPMD — retires the single-chip vmap path)"
+    ),
+)

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+
+from _spmd import requires_shard_map
 from jax.flatten_util import ravel_pytree
 
 from eventgrad_tpu.chaos import monitor as chaos_monitor
@@ -187,9 +189,7 @@ def test_arena_bitwise_matches_tree(name):
         _assert_metrics_bitwise(mt, ma)
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
-)
+@requires_shard_map
 def test_arena_bitwise_matches_tree_shard_map():
     """Same contract under the real-mesh lift (one device per rank)."""
     if len(jax.devices()) < N_RANKS:

@@ -31,6 +31,10 @@ def test_repo_artifacts_all_valid():
     # the elastic-membership soak proof (ISSUE 6): >= 6 transitions,
     # zero escalations, bounded recovery, bitwise replay, <= 0.5 pt gap
     assert "soak_cpu.json" in names
+    # the integrity-engine proof (ISSUE 7): zero silent acceptances,
+    # <= 1 hardened rollback, <= 0.5 pt gap, bitwise replay, off ==
+    # today's step, <= 2% in-step overhead
+    assert "integrity_cpu.json" in names
     assert out["errors"] == []
 
 

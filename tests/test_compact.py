@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
+
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.models import MLP
 from eventgrad_tpu.parallel import collectives
@@ -26,14 +28,11 @@ from eventgrad_tpu.parallel.spmd import build_mesh, spmd
 from eventgrad_tpu.parallel.topology import Ring, Torus
 from eventgrad_tpu.train.loop import train
 
-# the mesh lift needs jax.shard_map; some CPU-only environments run a
-# jax without it (the seed's shard_map tests fail there for the same
-# reason) — the equivalence still gets proven on the vmap lift
+# the equivalence still gets proven on the vmap lift where the mesh
+# lift is unavailable (tests/_spmd.py)
 BACKENDS = [
     "vmap",
-    pytest.param("shard_map", marks=pytest.mark.skipif(
-        not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
-    )),
+    pytest.param("shard_map", marks=requires_shard_map),
 ]
 
 

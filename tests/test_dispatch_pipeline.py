@@ -15,6 +15,8 @@ import jax
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
+
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.models import MLP
 from eventgrad_tpu.obs import bubble
@@ -194,10 +196,7 @@ def test_pipeline_spans_decompose(tmp_path):
             assert "checkpoint" in names
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="jax.shard_map unavailable in this environment",
-)
+@requires_shard_map
 def test_pipeline_parity_shard_map():
     """The pipelined schedule is lift-agnostic: shard_map-lifted runs
     match their serial twins bitwise too."""

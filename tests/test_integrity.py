@@ -1,0 +1,546 @@
+"""Integrity engine: wire checksums, quarantine, rollback — guarantees.
+
+The load-bearing contracts (ISSUE 7 acceptance):
+  * a checksum-failed payload is BITWISE an event that did not fire
+    (the stale buffer survives; rejection == drop at the params level);
+  * with integrity OFF the same injected corruption lands SILENTLY —
+    the measured counterfactual;
+  * a nanstep-poisoned rank quarantines (update skipped, sends
+    suppressed) and the run stays finite;
+  * integrity="off" resolves to None — the traced step IS today's step;
+    integrity ON with no faults firing is bitwise-unchanged;
+  * the divergence sentinel trips on a landed fault, the loop restores
+    last-known-good, hardens, replays — and the whole run (faults,
+    trip, rollback, replay) is bitwise-reproducible from the seed;
+  * a trip beyond the budget raises IntegrityEscalation (exit 77; the
+    supervisor gives up without a restart — tests/test_supervise.py).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _spmd import requires_shard_map
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from eventgrad_tpu.chaos import inject
+from eventgrad_tpu.chaos.integrity import (
+    INTEGRITY_ABORT_EXIT, DivergenceSentinel, IntegrityConfig,
+    IntegrityEscalation, resolve,
+)
+from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import build_mesh, spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.utils import checkpoint
+
+
+def _params_equal_bitwise(a, b) -> bool:
+    return all(
+        bool(np.array_equal(np.asarray(x), np.asarray(y)))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _params_finite(tree) -> bool:
+    return all(
+        bool(np.isfinite(np.asarray(l)).all()) for l in jax.tree.leaves(tree)
+    )
+
+
+# --- (a) config + sentinel units ---------------------------------------
+
+
+def test_integrity_config_parse_and_resolve():
+    assert IntegrityConfig.parse("on") == IntegrityConfig()
+    off = IntegrityConfig.parse("off")
+    assert off.is_noop
+    # "off" IS today's step: it resolves to None, so train() builds the
+    # exact same traced program as no flag at all
+    assert resolve("off") is None
+    assert resolve(None) is None
+    assert resolve("on") == IntegrityConfig()
+    kv = resolve("checksum=0,quarantine=1,max_rollbacks=2,loss_spike=8.5")
+    assert kv == IntegrityConfig(
+        checksum=False, quarantine=True, max_rollbacks=2, loss_spike=8.5
+    )
+    # dict round trip (the first-record replayability rider)
+    assert resolve(kv.to_dict()) == kv
+    assert kv.hardened().checksum and kv.hardened().quarantine
+    with pytest.raises(ValueError, match="integrity clause"):
+        IntegrityConfig.parse("bogus")
+    with pytest.raises(ValueError, match="0/1/true/false"):
+        IntegrityConfig.parse("checksum=maybe")
+    with pytest.raises(ValueError, match="max_rollbacks"):
+        IntegrityConfig(max_rollbacks=-1)
+    with pytest.raises(ValueError, match="loss_spike"):
+        IntegrityConfig(loss_spike=0.5)
+    with pytest.raises(TypeError):
+        resolve(42)
+
+
+def test_divergence_sentinel_trips_and_rewinds():
+    cfg = IntegrityConfig(loss_spike=4.0, loss_floor=1.0,
+                          consensus_spike=100.0, consensus_floor=10.0)
+    s = DivergenceSentinel(cfg)
+    # baselines establish; healthy blocks advance them
+    assert s.observe(2.0, 0.5) is None
+    assert s.observe(1.5, 0.4) is None
+    snap = s.snapshot()
+    # a spike above loss_spike x best AND the floor trips
+    reason = s.observe(1.5 * 4.0 + 0.1, 0.4)
+    assert reason is not None and "loss spike" in reason
+    # a tripped block must NOT become the yardstick
+    assert s.best_loss == 1.5
+    # below the floor never trips (early high-loss epochs), even at a
+    # large ratio over a tiny best
+    s2 = DivergenceSentinel(cfg)
+    assert s2.observe(0.001) is None
+    assert s2.observe(0.9) is None  # 900x best, but under loss_floor
+    # non-finite always trips (NaN's compare-False must not slip through)
+    assert "non-finite" in s2.observe(float("nan"))
+    s3 = DivergenceSentinel(cfg)
+    assert s3.observe(2.0, 1.0) is None
+    assert "consensus" in s3.observe(1.9, 1.0 * 100.0 + 11.0)
+    assert "non-finite consensus" in s3.observe(1.9, float("inf"))
+    # rewind restores the judged-healthy baseline (deterministic replay)
+    s.rewind(snap)
+    assert s.best_loss == snap["best_loss"]
+    assert s.best_cerr == snap["best_cerr"]
+
+
+# --- (b) wire primitives -----------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int8])
+def test_wire_checksum_catches_single_bitflip(dtype):
+    """Any single flipped bit changes the int32 wire checksum, for every
+    wire dtype; an un-flipped buffer checksums identically."""
+    if dtype == jnp.int8:
+        buf = jnp.arange(-16, 16, dtype=dtype).reshape(4, 8)
+    else:
+        buf = (jnp.arange(32, dtype=jnp.float32) / 7.0).astype(dtype)
+    base = collectives.wire_checksum(buf)
+    same = collectives.wire_checksum(
+        inject.flip_one_bit(buf, jnp.asarray(False), jnp.int32(11))
+    )
+    assert int(base) == int(same)
+    for salt in (0, 7, 31, 2**30):
+        flipped = inject.flip_one_bit(buf, jnp.asarray(True), jnp.int32(salt))
+        assert not bool(jnp.all(flipped == buf))
+        assert int(collectives.wire_checksum(flipped)) != int(base), salt
+
+
+def test_corrupt_mask_independent_of_drop_draws():
+    """Adding bitflip= clauses never perturbs a schedule's drop draws
+    (independent fold_in tags), and the host corruption_table replays
+    the in-step draws deterministically."""
+    topo = Ring(4)
+    plain = ChaosSchedule(seed=7, drop_p=0.3)
+    flipped = ChaosSchedule(
+        seed=7, drop_p=0.3, bitflip=(FlakyWindow(0, 100, 0.5),)
+    )
+    np.testing.assert_array_equal(
+        inject.delivery_table(plain, topo, 12),
+        inject.delivery_table(flipped, topo, 12),
+    )
+    t1 = inject.corruption_table(flipped, topo, 12)
+    t2 = inject.corruption_table(flipped, topo, 12)
+    np.testing.assert_array_equal(t1, t2)
+    assert t1.any(), "p=0.5 over 12 passes x 4 ranks x 2 edges must hit"
+    assert not t1.all()
+    # outside the window nothing corrupts; p=0 never corrupts
+    late = ChaosSchedule(seed=7, bitflip=(FlakyWindow(50, 60, 1.0),))
+    assert not inject.corruption_table(late, topo, 10).any()
+    assert not inject.corruption_table(
+        ChaosSchedule(seed=7, bitflip=(FlakyWindow(0, 100, 0.0),)), topo, 10
+    ).any()
+
+
+def test_nanstep_helpers():
+    topo = Ring(4)
+    s = ChaosSchedule(seed=0, nanstep=((2, 5), (0, 7), (3, 99)))
+    assert inject.nansteps_in_range(s, n_ranks=4, n_passes=10) == 2
+    assert inject.nansteps_in_range(s, n_ranks=4, n_passes=200) == 3
+    # rank-indexed, pass-exact
+    for pass_num, expect in ((5, [False, False, True, False]),
+                             (7, [True, False, False, False])):
+        def fn(_x, _p=pass_num):
+            return inject.nanstep_mask(s, topo, jnp.int32(_p))
+
+        got = np.asarray(spmd(fn, topo)(jnp.zeros(4)))
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_schedule_round_trip_with_integrity_faults():
+    s = ChaosSchedule(
+        seed=9, drop_p=0.1, bitflip=(FlakyWindow(10, 20, 0.5),),
+        nanstep=((2, 15), (0, 3)),
+    )
+    assert ChaosSchedule.parse(s.to_spec()) == s
+    assert ChaosSchedule.from_dict(s.to_dict()) == s
+    assert s.has_bitflips and s.has_nansteps
+    assert not s.is_noop
+    # bare bitflip=p covers the whole run — including scientific
+    # notation, whose '-' must not be misread as a pass range
+    bare = ChaosSchedule.parse("bitflip=0.25")
+    assert bare.bitflip[0].drop_p == 0.25
+    assert bare.bitflip[0].end_pass > 10**6
+    sci = ChaosSchedule.parse("bitflip=1e-3")
+    assert sci.bitflip[0].drop_p == 1e-3
+    assert sci.bitflip[0].end_pass > 10**6
+    # legacy schedules round-trip unchanged (absent keys stay absent)
+    legacy = ChaosSchedule(seed=1, drop_p=0.2)
+    assert "bitflip" not in legacy.to_dict()
+    assert "nanstep" not in legacy.to_dict()
+    with pytest.raises(ValueError, match="nanstep"):
+        ChaosSchedule(nanstep=((-1, 5),))
+
+
+# --- (c) rejection is BITWISE the not-fired path -----------------------
+
+
+@pytest.mark.parametrize("wire", [None, "int8"])
+def test_rejected_payload_bitwise_equals_dropped(wire):
+    """A checksum-failed payload keeps the stale buffer EXACTLY like an
+    injected drop (and like an event that did not fire) — masked and
+    compact wires, float and int8."""
+    topo = Ring(4)
+    p = {"w": jnp.arange(4.0) + 1.0, "b": 10.0 + jnp.arange(8.0).reshape(4, 2)}
+    fire = {"w": jnp.ones(4, bool), "b": jnp.ones(4, bool)}
+    last = {"w": jnp.full(4, -7.0), "b": jnp.full((4, 2), -9.0)}
+    corrupt = lambda i, buf: inject.flip_one_bit(
+        buf, jnp.asarray(True), jnp.int32(3 + i)
+    )
+
+    def rejected(pp, ff, ll):
+        bufs, _, oks = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo, wire,
+            checksum=True, corrupt=corrupt,
+        )
+        return bufs, oks
+
+    def dropped(pp, ff, ll):
+        bufs, _ = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo, wire,
+            deliver=jnp.zeros((2,), bool),
+        )
+        return bufs
+
+    got_rej, oks = spmd(rejected, topo)(p, fire, last)
+    got_drop = spmd(dropped, topo)(p, fire, last)
+    assert not np.asarray(oks).any(), "every corrupted payload rejected"
+    assert _params_equal_bitwise(got_rej, got_drop)
+    assert _params_equal_bitwise(got_rej, (last, last))
+
+    def rejected_compact(pp, ff, ll):
+        bufs, _, oks = collectives.compact_neighbor_vals(
+            pp, ff, (ll, ll), topo, 12, wire,
+            checksum=True, corrupt=corrupt,
+        )
+        return bufs, oks
+
+    got_c, oks_c = spmd(rejected_compact, topo)(p, fire, last)
+    assert not np.asarray(oks_c).any()
+    assert _params_equal_bitwise(got_c, (last, last))
+
+    # an UNcorrupted wire passes verification and delivers normally
+    def clean(pp, ff, ll):
+        bufs, _, oks = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo, wire, checksum=True,
+        )
+        return bufs, oks
+
+    def plain(pp, ff, ll):
+        bufs, _ = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo, wire,
+        )
+        return bufs
+
+    got_clean, oks_ok = spmd(clean, topo)(p, fire, last)
+    assert np.asarray(oks_ok).all()
+    assert _params_equal_bitwise(got_clean, spmd(plain, topo)(p, fire, last))
+
+
+def test_finite_guard_rejects_nan_payload():
+    """`finite=True` rejects a payload carrying NaN even with a valid
+    checksum (the sender-side guard's belt-and-suspenders twin): only
+    the edges sourced at the sick rank reject, and the NaN is never
+    committed anywhere."""
+    topo = Ring(4)
+    # rank 1's payload goes NaN (leaf shapes per rank: w scalar, b [2])
+    p = {"w": jnp.array([1.0, jnp.nan, 3.0, 4.0]), "b": jnp.ones((4, 2))}
+    fire = {"w": jnp.ones(4, bool), "b": jnp.ones(4, bool)}
+    last = {"w": jnp.full(4, -7.0), "b": jnp.full((4, 2), -9.0)}
+
+    def fn(pp, ff, ll):
+        bufs, _, oks = collectives.masked_neighbor_vals(
+            pp, ff, (ll, ll), topo, checksum=True, finite=True,
+        )
+        return bufs, oks
+
+    bufs, oks = spmd(fn, topo)(p, fire, last)
+    oks = np.asarray(oks)  # [rank, edge]
+    expect = np.array([
+        [topo.neighbor_source(r, nb) != 1 for nb in topo.neighbors]
+        for r in range(4)
+    ])
+    np.testing.assert_array_equal(oks, expect)
+    assert _params_finite(bufs)  # the NaN never reached a buffer
+    # rejected edges kept the stale value; clean edges delivered
+    w_bufs = np.asarray(bufs[0]["w"]), np.asarray(bufs[1]["w"])
+    for r in range(4):
+        for e in range(2):
+            src = topo.neighbor_source(r, topo.neighbors[e])
+            assert w_bufs[e][r] == (-7.0 if src == 1 else float(src + 1))
+
+
+# --- (d) train-level: rejection, silence, quarantine -------------------
+
+
+def _data():
+    (x, y) = synthetic_dataset(512, (8, 8, 1), seed=1)
+    (xt, yt) = synthetic_dataset(128, (8, 8, 1), seed=1, split="test")
+    return x, y, xt, yt
+
+
+_MODEL = dict(hidden=16)
+_CFG = dict(adaptive=False, constant=0.0)  # fire always -> wire active
+
+
+def _train(x, y, **kw):
+    return train(
+        MLP(**_MODEL), Ring(4), x, y, algo="eventgrad", batch_size=32,
+        event_cfg=EventConfig(**_CFG), seed=0, log_every_epoch=True, **kw,
+    )
+
+
+#: in-step defenses only: the host-side engine stays out of the way so
+#: the equivalences below compare pure step semantics
+_INSTEP = IntegrityConfig(sentinel=False, rollback=False)
+
+
+def test_train_bitflip_rejected_counted_and_drop_equivalent():
+    """End-to-end: every all-edges bitflip window payload is rejected at
+    the wire (counted per edge), and the parameters are BITWISE a run
+    whose same window was simply dropped (flaky@1.0): rejection == one
+    more event that did not fire."""
+    x, y, xt, yt = _data()
+    st_rej, hist = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt,
+        chaos=ChaosSchedule.parse("seed=5,bitflip=4-12@1.0"),
+        integrity=_INSTEP,
+    )
+    wr = sum(r.get("wire_rejects", 0) for r in hist)
+    # passes 4..11, 4 ranks x 2 edges, every payload corrupt: all
+    # rejected. (16 steps/epoch; warmup fires dense through it all.)
+    assert wr == 8 * 4 * 2
+    assert hist[0]["integrity"] == _INSTEP.to_dict()  # replay rider
+    st_drop, _ = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt,
+        chaos=ChaosSchedule.parse("seed=5,flaky=4-12@1.0"),
+    )
+    assert _params_equal_bitwise(st_rej.params, st_drop.params)
+
+
+def test_train_bitflip_lands_silently_without_integrity():
+    """The counterfactual: the SAME corruption schedule with integrity
+    off reaches the parameters (no rejection, trajectories diverge) —
+    exactly what the wire checksum exists to stop."""
+    x, y, xt, yt = _data()
+    chaos = ChaosSchedule.parse("seed=5,bitflip=4-12@1.0")
+    st_silent, hist = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt, chaos=chaos,
+    )
+    assert not any("wire_rejects" in r for r in hist)
+    st_rej, _ = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt, chaos=chaos,
+        integrity=_INSTEP,
+    )
+    assert not _params_equal_bitwise(st_silent.params, st_rej.params)
+
+
+def test_train_nanstep_quarantines_and_stays_finite():
+    """A poisoned rank skips its update and suppresses its sends; the
+    run stays finite and the quarantine is counted."""
+    x, y, xt, yt = _data()
+    st, hist = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt,
+        chaos=ChaosSchedule.parse("seed=5,nanstep=2@6,nanstep=0@9"),
+        integrity=_INSTEP,
+    )
+    qs = sum(r.get("quarantined_steps", 0) for r in hist)
+    assert qs == 2  # exactly the scheduled poisonings, nothing else
+    assert _params_finite(st.params)
+    # without quarantine the same schedule reaches the parameters
+    st_off, _ = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt,
+        chaos=ChaosSchedule.parse("seed=5,nanstep=2@6,nanstep=0@9"),
+    )
+    assert not _params_finite(st_off.params)
+
+
+def test_integrity_on_without_faults_is_bitwise_unchanged():
+    """Armed-but-idle defenses are free: gates that never trip select
+    the same values, so the trajectory is bitwise the plain run's."""
+    x, y, xt, yt = _data()
+    st_plain, _ = _train(x, y, epochs=2, x_test=xt, y_test=yt)
+    st_on, hist = _train(
+        x, y, epochs=2, x_test=xt, y_test=yt, integrity="on",
+    )
+    assert _params_equal_bitwise(st_plain.params, st_on.params)
+    assert sum(r.get("wire_rejects", 0) for r in hist) == 0
+    assert sum(r.get("quarantined_steps", 0) for r in hist) == 0
+    assert all(r["integrity_rollbacks"] == 0 for r in hist)
+    # integrity="off" resolves to None: literally the same build
+    st_off, hist_off = _train(x, y, epochs=2, x_test=xt, y_test=yt,
+                              integrity="off")
+    assert _params_equal_bitwise(st_plain.params, st_off.params)
+    assert not any("integrity" in r for r in hist_off)
+
+
+def test_arena_on_off_bitwise_with_integrity():
+    """The integrity gates are layout-agnostic: arena and tree paths
+    reject/quarantine bit-identically under the same fault schedule."""
+    x, y, xt, yt = _data()
+    chaos = ChaosSchedule.parse("seed=5,bitflip=4-10@0.7,nanstep=2@6")
+    st_tree, h_tree = _train(
+        x, y, epochs=2, x_test=xt, y_test=yt, chaos=chaos,
+        integrity=_INSTEP, arena=False,
+    )
+    st_arena, h_arena = _train(
+        x, y, epochs=2, x_test=xt, y_test=yt, chaos=chaos,
+        integrity=_INSTEP, arena=True,
+    )
+    assert _params_equal_bitwise(st_tree.params, st_arena.params)
+    assert (
+        [r.get("wire_rejects") for r in h_tree]
+        == [r.get("wire_rejects") for r in h_arena]
+    )
+    assert (
+        [r.get("quarantined_steps") for r in h_tree]
+        == [r.get("quarantined_steps") for r in h_arena]
+    )
+
+
+# --- (e) rollback engine -----------------------------------------------
+
+
+def test_sentinel_trip_rolls_back_hardens_and_replays_bitwise():
+    """A nanstep landing with quarantine OFF poisons the ring; the
+    sentinel trips on the divergence, the loop restores last-known-good,
+    hardens the step (quarantine now ON), and the replay survives the
+    same scheduled fault. The whole run replays bitwise from the seed."""
+    x, y, xt, yt = _data()
+    chaos = ChaosSchedule.parse("seed=5,nanstep=2@20")
+    icfg = IntegrityConfig(checksum=False, quarantine=False, escalate=True)
+    st, hist = _train(
+        x, y, epochs=5, x_test=xt, y_test=yt, chaos=chaos, integrity=icfg,
+    )
+    rb = [r for r in hist if "integrity_rollback" in r]
+    assert len(rb) == 1, "exactly one rollback"
+    info = rb[0]["integrity_rollback"]
+    assert info["hardened"] is True
+    assert "non-finite" in info["reason"]
+    assert info["restored_epoch"] < info["tripped_epoch"]
+    assert hist[-1]["integrity_rollbacks"] == 1
+    assert _params_finite(st.params)
+    # the hardened replay quarantined the replayed nanstep
+    assert sum(r.get("quarantined_steps", 0) for r in hist) >= 1
+    # bitwise replay: faults + trip + rollback + hardened replay, all
+    # reproduced from the seed
+    st2, hist2 = _train(
+        x, y, epochs=5, x_test=xt, y_test=yt, chaos=chaos, integrity=icfg,
+    )
+    assert _params_equal_bitwise(st.params, st2.params)
+    assert [r.get("integrity_rollbacks") for r in hist] == [
+        r.get("integrity_rollbacks") for r in hist2
+    ]
+
+
+def test_rollback_budget_spent_escalates():
+    """rollback disarmed or budget spent -> IntegrityEscalation (the
+    CLI maps it to exit 77; the supervisor gives up without restart)."""
+    x, y, xt, yt = _data()
+    chaos = ChaosSchedule.parse("seed=5,nanstep=2@20")
+    with pytest.raises(IntegrityEscalation, match="budget spent"):
+        _train(
+            x, y, epochs=5, x_test=xt, y_test=yt, chaos=chaos,
+            integrity=IntegrityConfig(
+                checksum=False, quarantine=False, max_rollbacks=0,
+            ),
+        )
+    with pytest.raises(IntegrityEscalation, match="disarmed"):
+        _train(
+            x, y, epochs=5, x_test=xt, y_test=yt, chaos=chaos,
+            integrity=IntegrityConfig(
+                checksum=False, quarantine=False, rollback=False,
+            ),
+        )
+
+
+def test_rollback_disk_retention(tmp_path):
+    """With a checkpoint_dir the engine retains validated last-known-
+    good snapshots on disk (RollingRetention under <dir>/good), each
+    individually restorable."""
+    x, y, xt, yt = _data()
+    ckdir = str(tmp_path / "ck")
+    st, hist = _train(
+        x, y, epochs=3, x_test=xt, y_test=yt,
+        integrity=IntegrityConfig(keep_good=2),
+        checkpoint_dir=ckdir, save_every=1,
+    )
+    ret = checkpoint.RollingRetention(os.path.join(ckdir, "good"), keep=2)
+    snaps = ret.snapshots()
+    assert 1 <= len(snaps) <= 2
+    epoch, path = snaps[-1]
+    got = checkpoint.peek(path)
+    assert int(np.asarray(got["epoch"])) == epoch
+
+
+def test_train_validation_errors():
+    x, y, _, _ = _data()
+    with pytest.raises(ValueError, match="event exchange"):
+        train(
+            MLP(hidden=16), Ring(4), x, y, algo="dpsgd", epochs=1,
+            batch_size=32, seed=0, integrity="on",
+        )
+    with pytest.raises(ValueError, match="membership"):
+        _train(x, y, epochs=2, integrity="on",
+               membership="leave=1@1")
+    with pytest.raises(ValueError, match="pipeline"):
+        _train(x, y, epochs=2, integrity="on", pipeline=True)
+    # the CLI-reserved exit code is pinned in both modules (supervise
+    # must stay jax-free, so it re-declares rather than imports)
+    from eventgrad_tpu import supervise
+    assert supervise.INTEGRITY_ABORT_EXIT == INTEGRITY_ABORT_EXIT == 77
+
+
+# --- (f) the mesh lift -------------------------------------------------
+
+
+@requires_shard_map
+def test_integrity_bitwise_shard_map():
+    """The in-step defenses are lift-agnostic: the shard_map mesh run
+    rejects and quarantines bit-identically to the vmap simulator."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    x, y, xt, yt = _data()
+    chaos = ChaosSchedule.parse("seed=5,bitflip=4-10@0.7,nanstep=2@6")
+    st_vmap, _ = _train(
+        x, y, epochs=2, x_test=xt, y_test=yt, chaos=chaos, integrity=_INSTEP,
+    )
+    st_mesh, _ = _train(
+        x, y, epochs=2, x_test=xt, y_test=yt, chaos=chaos, integrity=_INSTEP,
+        mesh=build_mesh(Ring(4)),
+    )
+    assert _params_equal_bitwise(st_vmap.params, st_mesh.params)

@@ -1,0 +1,95 @@
+"""Tier-1 lint: the shard_map skip-pattern must not spread.
+
+Some CPU-only environments run a jax without `jax.shard_map`, where the
+SEED's shard_map tests fail outright (the known pre-existing tier-1
+failures). Every test added SINCE skips instead — through the ONE
+`requires_shard_map` marker in tests/_spmd.py, so the condition and the
+reason string live in a single place while ROADMAP Open item 1
+(real-mesh SPMD: retire the single-chip vmap lift) is pending. This
+lint walks the test tree and enforces it:
+
+  * a test file that touches `shard_map` must import the shared marker
+    (no hand-rolled `pytest.mark.skipif(not hasattr(jax, "shard_map"))`
+    copies — ~10 of those accumulated across PRs 2-6 before the
+    consolidation);
+  * the three SEED files are exempt BY NAME: their shard_map tests
+    predate the helper and intentionally FAIL (not skip) in
+    shard_map-less environments — they are the recorded tier-1
+    baseline, and converting them would silently move it.
+"""
+
+import os
+import re
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: the seed's shard_map test files: the pre-existing tier-1 baseline
+#: failures in shard_map-less environments. Frozen — new entries mean
+#: new un-skipped debt, which is exactly what this lint exists to stop.
+SEED_EXEMPT = {
+    "test_collectives.py",
+    "test_ring_attention.py",
+    "test_train_equivalence.py",
+}
+
+_IMPORT_RE = re.compile(
+    r"^\s*from\s+_spmd\s+import\s+.*\brequires_shard_map\b", re.MULTILINE
+)
+# a hand-rolled respelling: a skipif whose condition mentions shard_map
+# (the helper file itself holds the one allowed instance)
+_RESPELL_RE = re.compile(r"skipif\s*\([^)]*shard_map", re.DOTALL)
+
+
+def _test_files():
+    this = os.path.basename(__file__)
+    for name in sorted(os.listdir(TESTS_DIR)):
+        if name == this:  # the lint's own docstrings quote the patterns
+            continue
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(TESTS_DIR, name)) as f:
+                yield name, f.read()
+
+
+def test_shard_map_tests_use_shared_marker():
+    """Any non-seed test file touching shard_map imports the single
+    `requires_shard_map` definition from tests/_spmd.py."""
+    offenders = [
+        name
+        for name, src in _test_files()
+        if "shard_map" in src
+        and name not in SEED_EXEMPT
+        and not _IMPORT_RE.search(src)
+    ]
+    assert not offenders, (
+        f"{offenders} touch shard_map without importing the shared "
+        "`requires_shard_map` marker from tests/_spmd.py (ROADMAP Open "
+        "item 1); add `from _spmd import requires_shard_map` instead of "
+        "re-spelling the skipif"
+    )
+
+
+def test_no_respelled_shard_map_skipif():
+    """Nobody — seed files included — re-spells the skipif condition:
+    the definition lives in tests/_spmd.py and nowhere else."""
+    offenders = [
+        name for name, src in _test_files() if _RESPELL_RE.search(src)
+    ]
+    assert not offenders, (
+        f"{offenders} re-spell the shard_map skipif; use "
+        "`requires_shard_map` from tests/_spmd.py (single definition, "
+        "single reason string)"
+    )
+
+
+def test_seed_exemption_list_matches_reality():
+    """The exemption list stays honest: every exempt file still exists
+    and still touches shard_map (a renamed/retired file must leave the
+    list, or the lint silently covers nothing)."""
+    for name in sorted(SEED_EXEMPT):
+        path = os.path.join(TESTS_DIR, name)
+        assert os.path.exists(path), f"exempt file {name} no longer exists"
+        with open(path) as f:
+            assert "shard_map" in f.read(), (
+                f"exempt file {name} no longer touches shard_map — drop "
+                "it from SEED_EXEMPT"
+            )

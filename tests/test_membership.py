@@ -27,6 +27,8 @@ import numpy as np
 import optax
 import pytest
 
+from _spmd import requires_shard_map
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from eventgrad_tpu.chaos.membership import (
@@ -161,9 +163,7 @@ def test_round_trip_through_ring2():
     _assert_bitwise_except_salted(baseline, transitioned)
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
-)
+@requires_shard_map
 def test_round_trip_bitwise_shard_map():
     """The membership round trip composes with the real-mesh shard_map
     lift exactly like the vmap simulator (usual env skipif)."""

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
+
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.models import MLP
 from eventgrad_tpu.obs import (
@@ -283,6 +285,37 @@ def test_jsonl_logger_context_manager_and_fsync(tmp_path):
     flog.close()
 
 
+def test_jsonl_logger_nonfinite_values_stay_valid_json(tmp_path):
+    """Satellite: NaN/Inf metric values (a diverging loss — exactly the
+    record an operator most needs) serialize as null plus a
+    `nonfinite_fields` rider instead of the bare `NaN` token
+    `json.loads` rejects (or a mid-run ValueError from allow_nan=False).
+    Finite records stay byte-for-byte the legacy serialization."""
+    path = tmp_path / "log.jsonl"
+    with JsonlLogger(str(path), echo=False) as log:
+        log.log({"epoch": 1, "loss": 0.5})  # finite: legacy path
+        log.log({
+            "epoch": 2,
+            "loss": float("nan"),
+            "per_edge": [1.0, float("inf"), 2.0],
+            "nested": {"acc": float("-inf"), "ok": 3.0},
+            "npval": np.float32("nan"),  # numpy scalars scrub too
+            "label": "diverged",
+        })
+    lines = path.read_text().splitlines()
+    finite = json.loads(lines[0])  # every line must parse
+    assert finite["loss"] == 0.5 and "nonfinite_fields" not in finite
+    rec = json.loads(lines[1])
+    assert rec["loss"] is None
+    assert rec["per_edge"] == [1.0, None, 2.0]
+    assert rec["nested"]["acc"] is None and rec["nested"]["ok"] == 3.0
+    assert rec["npval"] is None
+    assert rec["label"] == "diverged" and rec["epoch"] == 2
+    assert sorted(rec["nonfinite_fields"]) == [
+        "loss", "nested.acc", "npval", "per_edge[1]",
+    ]
+
+
 def test_profiling_trace_warns_and_still_yields(monkeypatch):
     """Satellite: the no-op path emits a capturable `warnings` warning
     (not a bare stderr print) and the context still runs its body."""
@@ -407,12 +440,9 @@ def test_docs_cover_every_schema_field():
     assert not missing, f"fields undocumented in OBSERVABILITY.md: {missing}"
 
 
-# the mesh lift needs jax.shard_map; some CPU-only environments run a
-# jax without it (the seed's shard_map tests fail there for the same
-# reason) — the vmap lift proves the telemetry math either way
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable"
-)
+# the vmap lift proves the telemetry math even where the mesh lift is
+# unavailable (tests/_spmd.py)
+@requires_shard_map
 def test_telemetry_matches_across_lifts():
     """Telemetry counters under the shard_map lift equal the vmap
     simulation's, like every other state leaf."""

@@ -221,6 +221,27 @@ def test_supervise_window_burst_escalates(monkeypatch):
     assert rc == 9 and len(launches) == 3
 
 
+def test_supervise_integrity_abort_gives_up_without_restart(monkeypatch):
+    """Exit 77 (INTEGRITY_ABORT_EXIT) is a PERMANENT escalation: the
+    divergence sentinel tripped beyond the rollback budget, so a
+    relaunch would restore the same last-known-good snapshot and replay
+    the same divergence. The supervisor must give up immediately —
+    restart budget notwithstanding — and the constant must stay pinned
+    to chaos.integrity's (supervise stays jax-free, so it re-declares
+    rather than imports)."""
+    from eventgrad_tpu import supervise as sup
+    from eventgrad_tpu.chaos.integrity import INTEGRITY_ABORT_EXIT
+
+    assert sup.INTEGRITY_ABORT_EXIT == INTEGRITY_ABORT_EXIT == 77
+    rc, launches, sleeps = _run_fake_supervise(
+        monkeypatch, [INTEGRITY_ABORT_EXIT, 0], max_restarts=5,
+        backoff_base=0.0,
+    )
+    assert rc == INTEGRITY_ABORT_EXIT
+    assert len(launches) == 1  # no restart, budget untouched
+    assert sleeps == []
+
+
 def test_crash_recovery_hybrid_lm(tmp_path):
     """Elastic recovery composes with hybrid meshes: a dp x sp
     ring-attention LM run crash-injected after epoch 1 is restarted from

@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.data.sharding import batched_epoch
@@ -51,6 +52,84 @@ def test_checkpoint_roundtrip_midtraining():
     s2, _ = step(restored, (jnp.asarray(xb[:, 2]), jnp.asarray(yb[:, 2])))
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_peek_corrupted_checkpoint_fails_loudly():
+    """Satellite: `peek` on a truncated/corrupted snapshot raises an
+    actionable RuntimeError naming the path and the recovery options —
+    never half-restores (a resume that silently proceeded from garbage
+    would train on it)."""
+    payload = {"a": np.arange(5.0), "epoch": np.int64(3)}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt")
+        checkpoint.save(path, payload)
+        # sanity: intact snapshot peeks fine
+        assert int(checkpoint.peek(path)["epoch"]) == 3
+        # a host crash mid-write without the fsync fix: promoted names
+        # pointing at zero-length files
+        for dirpath, _, files in os.walk(path):
+            for f in files:
+                open(os.path.join(dirpath, f), "w").close()
+        with pytest.raises(RuntimeError, match="unreadable"):
+            checkpoint.peek(path)
+        try:
+            checkpoint.peek(path)
+        except RuntimeError as e:
+            msg = str(e)
+            assert path in msg  # the offending path
+            assert "last-known-good" in msg  # the recovery option
+        # with a demoted .prev twin present, the hint points there
+        checkpoint.save(path + ".prev", payload)
+        try:
+            checkpoint.peek(path)
+        except RuntimeError as e:
+            assert ".prev" in str(e) and "pass it instead" in str(e)
+
+
+def test_rolling_retention_never_deletes_only_validated_snapshot():
+    """Satellite: `RollingRetention` prunes BEFORE dispatching a new
+    save, so the newest `keep` committed snapshots — in particular the
+    ONLY one — survive at every instant, even if an in-flight save dies
+    mid-write."""
+    payload = {"w": np.arange(3.0)}
+    with tempfile.TemporaryDirectory() as d:
+        ret = checkpoint.RollingRetention(os.path.join(d, "good"), keep=1)
+        assert ret.latest_good() is None
+        ret.save_good(1, payload)
+        assert [e for e, _ in ret.snapshots()] == [1]
+
+        # keep=1 with one snapshot: prune must delete nothing
+        assert ret.prune() == 0
+        assert [e for e, _ in ret.snapshots()] == [1]
+
+        # a newer save supersedes; the old one goes only AFTER commit
+        ret.save_good(2, payload)
+        assert [e for e, _ in ret.snapshots()] == [2]
+
+        # an in-flight save dying mid-write (stale .tmp tree) is not a
+        # committed snapshot: it neither counts nor endangers the last
+        # good one
+        stale = ret.path_for(3) + ".tmp"
+        os.makedirs(stale)
+        with open(os.path.join(stale, "junk"), "w") as f:
+            f.write("partial")
+        assert [e for e, _ in ret.snapshots()] == [2]
+        assert ret.prune() == 0
+        assert os.path.exists(ret.path_for(2))
+
+        # the retained snapshot restores (each rides save's atomic swap)
+        epoch, path = ret.latest_good()
+        got = checkpoint.peek(path)
+        np.testing.assert_array_equal(np.asarray(got["w"]), payload["w"])
+
+        # keep=2 retains the newest two, drops the third
+        ret2 = checkpoint.RollingRetention(os.path.join(d, "good2"), keep=2)
+        for e in (10, 11, 12):
+            ret2.save_good(e, payload)
+        assert [e for e, _ in ret2.snapshots()] == [11, 12]
+
+        with pytest.raises(ValueError, match="keep"):
+            checkpoint.RollingRetention(d, keep=0)
 
 
 def test_msgs_saved_pct():

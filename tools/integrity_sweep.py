@@ -1,0 +1,347 @@
+"""Integrity sweep: the end-to-end proof that corruption cannot pass silently.
+
+The robustness analogue of chaos_sweep's drop curve, for LYING peers and
+SICK ranks (docs/chaos.md "Integrity & rollback"): run a seeded
+bitflip+nanstep chaos schedule against the integrity engine and account
+for every injected corruption. A corruption is SILENTLY ACCEPTED when it
+enters the final committed training trajectory without ever being
+detected — i.e. it was neither rejected at the wire (checksum), nor
+quarantined at the step (finite guard), nor erased by a
+rollback-to-last-good. The artifact proves that number is ZERO.
+
+Five legs, one JSON artifact (artifacts/integrity_cpu.json, schema-gated
+by INTEGRITY_SCHEMA in tools/validate_artifacts.py):
+
+  * baseline  — the fault-free run (no chaos, no integrity): the
+                accuracy yardstick.
+  * faulted   — the same op-point under `bitflip=` (wire corruption on a
+                mid-run window) + `nanstep=` (one rank's grads poisoned)
+                with checksums ON but quarantine OFF (escalate=True):
+                every bitflip is rejected at the wire; the nanstep lands
+                — detection comes too late by construction — the
+                divergence sentinel trips, the loop restores
+                last-known-good, HARDENS (quarantine on) and replays,
+                where the same pass-keyed nanstep is quarantined. The
+                zero-silent-acceptance ledger reconciles observed
+                wire_rejects / quarantined_steps / the rollback against
+                the host-replayed ground truth
+                (chaos.inject.corruption_table, pass-exact — the
+                replayed segment's draws counted twice, exactly like
+                the engine meets them).
+  * replay    — the faulted leg re-run from the seed: parameters and
+                every integrity counter must be bitwise/equal —
+                faults, trip, rollback and hardened replay are all
+                deterministic.
+  * off       — integrity="off" vs no flag at all: bitwise-identical
+                parameters (resolve("off") -> None; the traced step IS
+                today's step).
+  * overhead  — checksum+quarantine cost on the traced step: the
+                overhead_ablation protocol (one jitted scan-of-K
+                program per variant — the production dispatch shape —
+                interleaved rounds, MEDIAN PAIRED per-round ratios; the
+                only stable step-time estimator on a noisy shared CPU).
+                Acceptance: p50 ratio <= 1.02.
+
+Runs on CPU in ~2 min. Usage:
+    python tools/integrity_sweep.py [--epochs 6] [--seed 0]
+                                    [--rounds 8] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgrad_tpu.utils import compile_cache
+
+compile_cache.honor_cpu_pin()
+compile_cache.enable()
+
+import optax
+
+from eventgrad_tpu.chaos import inject
+from eventgrad_tpu.chaos.integrity import IntegrityConfig
+from eventgrad_tpu.chaos.schedule import ChaosSchedule
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+# the chaos_sweep miniature op-point (trains to >50% in seconds/CPU);
+# constant-threshold events keep the wire active from pass one, so the
+# bitflip window has payloads to corrupt on every edge it draws
+N_RANKS = 4
+BATCH = 16
+LR = 0.1
+EVENT_CFG = EventConfig(adaptive=True, horizon=0.95, warmup_passes=5,
+                        max_silence=5)
+
+#: the faulted leg's integrity config: checksums on, quarantine OFF —
+#: the nanstep must LAND so the sentinel/rollback path is exercised;
+#: escalate=True hardens the replay (quarantine on) so the replayed
+#: nanstep is caught at the step instead of burning the budget
+FAULT_CFG = IntegrityConfig(checksum=True, quarantine=False,
+                            escalate=True, max_rollbacks=1)
+
+
+def _params_equal_bitwise(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(la), np.asarray(lb))
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _data(n_train=2048, n_test=256):
+    x, y = synthetic_dataset(n_train, (8, 8, 1), seed=1)
+    xt, yt = synthetic_dataset(n_test, (8, 8, 1), seed=1, split="test")
+    return x, y, xt, yt
+
+
+def _run(x, y, xt, yt, epochs, seed, chaos=None, integrity=None):
+    return train(
+        MLP(hidden=32), Ring(N_RANKS), x, y,
+        algo="eventgrad", epochs=epochs, batch_size=BATCH,
+        learning_rate=LR, event_cfg=EVENT_CFG, seed=seed,
+        x_test=xt, y_test=yt, chaos=chaos, integrity=integrity,
+        log_every_epoch=True,
+    )
+
+
+def _fault_schedule(seed: int, spe: int, epochs: int) -> ChaosSchedule:
+    """bitflip window across the middle third; one nanstep at ~2/3 of
+    the run, AFTER the window (the NaN segment must not eat the window's
+    rejection accounting) and early enough that the post-rollback replay
+    still has epochs left to converge."""
+    total = spe * epochs
+    return ChaosSchedule.parse(
+        f"seed={seed + 13},"
+        f"bitflip={total // 3}-{2 * total // 3}@0.15,"
+        f"nanstep=2@{2 * total // 3 + spe // 2}"
+    )
+
+
+def _silent_acceptance_ledger(sched, epochs, spe, hist):
+    """Reconcile observed integrity counters against the host-replayed
+    injection ground truth; returns the ledger dict (silent == 0 is the
+    headline). The replayed segment (restored_epoch, tripped_epoch]
+    executes twice — replay is pass-keyed, so its scheduled draws are
+    met twice and must be expected twice."""
+    topo = Ring(N_RANKS)
+    total = spe * epochs
+    per_pass = inject.corruption_table(sched, topo, total).sum(axis=(1, 2))
+
+    rbs = [r["integrity_rollback"] for r in hist if "integrity_rollback" in r]
+    expected_flips = int(per_pass.sum())
+    replayed_nansteps = 0
+    for rb in rbs:
+        lo, hi = rb["restored_epoch"] * spe, rb["tripped_epoch"] * spe
+        expected_flips += int(per_pass[lo:hi].sum())
+        replayed_nansteps += sum(
+            1 for _r, t in sched.nanstep if lo < t <= hi
+        )
+
+    wire_rejects = sum(r.get("wire_rejects", 0) for r in hist)
+    quarantined = sum(r.get("quarantined_steps", 0) for r in hist)
+    nominal_nansteps = inject.nansteps_in_range(sched, N_RANKS, total)
+    nanstep_visits = nominal_nansteps + replayed_nansteps
+    # every nanstep visit is either quarantined at the step or landed
+    # inside a segment a rollback later erased
+    rollback_covered = replayed_nansteps
+    silent = (expected_flips - wire_rejects) + (
+        nanstep_visits - quarantined - rollback_covered
+    )
+    return {
+        "injected_bitflips": expected_flips,
+        "injected_nansteps": nanstep_visits,
+        "wire_rejects": wire_rejects,
+        "quarantined_steps": quarantined,
+        "rollback_covered_nansteps": rollback_covered,
+        "silent_acceptances": silent,
+    }
+
+
+def _overhead_leg(seed: int, n_rounds: int, K: int = 16):
+    """Traced-step cost of the in-step defenses (checksum + quarantine,
+    no faults): the overhead_ablation protocol AND op-point (LeNetCifar
+    on Ring(8), the bench production shape — a step where compute
+    amortizes the per-exchange integer reduction; the MLP miniature's
+    sub-ms steps would price the checksum against nothing) — one jitted
+    scan-of-K-steps program per variant, interleaved rounds, median
+    paired per-round ratio."""
+    from eventgrad_tpu.data.datasets import load_or_synthesize
+    from eventgrad_tpu.models import LeNetCifar
+
+    topo = Ring(8)
+    per_rank = 8
+    model = LeNetCifar()
+    tx = optax.sgd(1e-2, momentum=0.9)
+    cfg = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    xb, yb = batched_epoch(x, y, topo.n_ranks, per_rank)
+    xs = jnp.asarray(np.stack([xb[:, s % xb.shape[1]] for s in range(K)], 0))
+    ys = jnp.asarray(np.stack([yb[:, s % yb.shape[1]] for s in range(K)], 0))
+
+    variants = {}
+    for name, integ in (
+        ("off", None),
+        ("on", IntegrityConfig(sentinel=False, rollback=False)),
+    ):
+        state = init_train_state(
+            model, x.shape[1:], tx, topo, "eventgrad", cfg, seed=seed
+        )
+        lifted = spmd(make_train_step(
+            model, tx, topo, "eventgrad", event_cfg=cfg,
+            integrity=integ,
+        ), topo)
+
+        def run(s, xs, ys, _l=lifted):
+            return jax.lax.scan(lambda s, b: _l(s, b), s, (xs, ys))
+
+        run = jax.jit(run)
+        out, _ = run(state, xs, ys)  # compile + warm
+        jax.block_until_ready(out.params)
+        variants[name] = (state, run)
+
+    times = {k: [] for k in variants}
+    for _ in range(n_rounds):
+        for k, (state, run) in variants.items():
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(out.params)
+            times[k].append((time.perf_counter() - t0) / K * 1000)
+
+    def _median(v):
+        s = sorted(v)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    paired = [on / off for on, off in zip(times["on"], times["off"])]
+    return {
+        "protocol": "scan-of-%d, %d interleaved rounds, median paired "
+                    "per-round on/off ratios" % (K, n_rounds),
+        "step_ms_off_p50": round(_median(times["off"]), 4),
+        "step_ms_on_p50": round(_median(times["on"]), 4),
+        "overhead_ratio_p50": round(_median(paired), 4),
+        "n_rounds": n_rounds,
+    }
+
+
+def run_sweep(epochs: int, seed: int, n_rounds: int, out_path: str):
+    t_start = time.time()
+    x, y, xt, yt = _data()
+
+    # --- baseline: the fault-free yardstick ----------------------------
+    st_base, hist_base = _run(x, y, xt, yt, epochs, seed)
+    spe = int(hist_base[0]["steps"])
+    acc_base = float(hist_base[-1]["test_accuracy"])
+    print(json.dumps({"leg": "baseline", "acc": acc_base, "steps_per_epoch":
+                      spe}), flush=True)
+
+    # --- faulted: bitflips rejected, nanstep -> rollback -> hardened ---
+    sched = _fault_schedule(seed, spe, epochs)
+    st_f, hist_f = _run(
+        x, y, xt, yt, epochs, seed, chaos=sched, integrity=FAULT_CFG,
+    )
+    rbs = [r["integrity_rollback"] for r in hist_f
+           if "integrity_rollback" in r]
+    rollbacks = hist_f[-1]["integrity_rollbacks"]
+    ledger = _silent_acceptance_ledger(sched, epochs, spe, hist_f)
+    acc_f = float(hist_f[-1]["test_accuracy"])
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(st_f.params))
+    print(json.dumps({"leg": "faulted", "acc": acc_f,
+                      "rollbacks": rollbacks, **ledger}), flush=True)
+    assert ledger["silent_acceptances"] == 0, ledger
+    assert rollbacks == 1 and rbs and rbs[0]["hardened"], (
+        "the sweep schedule is built to trip exactly one hardened "
+        "rollback; got %r" % (rbs,)
+    )
+
+    # --- replay: the whole story is deterministic from the seed --------
+    st_r, hist_r = _run(
+        x, y, xt, yt, epochs, seed, chaos=sched, integrity=FAULT_CFG,
+    )
+    replay_bitwise = _params_equal_bitwise(st_f.params, st_r.params) and (
+        [(r.get("wire_rejects"), r.get("quarantined_steps"),
+          r.get("integrity_rollbacks")) for r in hist_f]
+        == [(r.get("wire_rejects"), r.get("quarantined_steps"),
+             r.get("integrity_rollbacks")) for r in hist_r]
+    )
+    print(json.dumps({"leg": "replay", "bitwise": replay_bitwise}),
+          flush=True)
+
+    # --- off: `--integrity off` IS today's traced step -----------------
+    st_off, _ = _run(x, y, xt, yt, 2, seed, integrity="off")
+    st_none, _ = _run(x, y, xt, yt, 2, seed)
+    off_bitwise = _params_equal_bitwise(st_off.params, st_none.params)
+    print(json.dumps({"leg": "off", "bitwise": off_bitwise}), flush=True)
+
+    # --- overhead ------------------------------------------------------
+    overhead = _overhead_leg(seed, n_rounds)
+    print(json.dumps({"leg": "overhead", **overhead}), flush=True)
+
+    out = {
+        "bench": "integrity",
+        "platform": jax.devices()[0].platform,
+        "op_point": {
+            "model": "mlp32", "n_ranks": N_RANKS, "batch": BATCH,
+            "epochs": epochs, "steps_per_epoch": spe, "lr": LR,
+            "event_cfg": "adaptive h=0.95 warmup=5 max_silence=5",
+        },
+        "schedule": sched.to_dict(),
+        "integrity": FAULT_CFG.to_dict(),
+        **ledger,
+        "rollbacks": rollbacks,
+        "rollback": rbs[0],
+        "final_acc_baseline": round(acc_base, 2),
+        "final_acc_faulted": round(acc_f, 2),
+        "acc_gap_pt": round(abs(acc_base - acc_f), 2),
+        "replay_bitwise": bool(replay_bitwise),
+        "integrity_off_bitwise": bool(off_bitwise),
+        "overhead": overhead,
+        "wall_s": round(time.time() - t_start, 1),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="overhead-leg interleaved rounds")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = args.out or os.path.join(
+        repo, "artifacts",
+        f"integrity_{jax.devices()[0].platform}.json",
+    )
+    out = run_sweep(args.epochs, args.seed, args.rounds, out_path)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

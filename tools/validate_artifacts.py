@@ -310,9 +310,68 @@ SOAK_SCHEMA = {
     },
 }
 
+INTEGRITY_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "op_point", "schedule", "integrity",
+        "injected_bitflips", "injected_nansteps", "wire_rejects",
+        "quarantined_steps", "silent_acceptances", "rollbacks",
+        "rollback", "final_acc_baseline", "final_acc_faulted",
+        "acc_gap_pt", "replay_bitwise", "integrity_off_bitwise",
+        "overhead", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["integrity"]},
+        "platform": {"type": "string"},
+        # the integrity-engine acceptance gates (ISSUE 7): a seeded
+        # bitflip+nanstep schedule actually injected faults, EVERY one
+        # was rejected at the wire / quarantined at the step / erased by
+        # the rollback (ZERO silent acceptances), the divergence
+        # sentinel tripped AT MOST one rollback, the post-rollback run
+        # converged within 0.5 pt of the fault-free baseline, the whole
+        # story replays bitwise from the seed, `--integrity off` is
+        # bitwise today's traced step, and the in-step defenses cost
+        # <= 2% p50 step time at the production-shape CPU proxy
+        "injected_bitflips": {"type": "integer", "minimum": 1},
+        "injected_nansteps": {"type": "integer", "minimum": 1},
+        "wire_rejects": {"type": "integer", "minimum": 1},
+        "quarantined_steps": {"type": "integer", "minimum": 1},
+        "silent_acceptances": {"enum": [0]},
+        "rollbacks": {"type": "integer", "minimum": 0, "maximum": 1},
+        "rollback": {
+            "type": "object",
+            "required": ["reason", "tripped_epoch", "restored_epoch",
+                         "hardened"],
+            "properties": {
+                "reason": {"type": "string"},
+                "tripped_epoch": {"type": "integer", "minimum": 1},
+                "restored_epoch": {"type": "integer", "minimum": 0},
+                "hardened": {"enum": [True]},
+            },
+        },
+        "acc_gap_pt": {"type": "number", "minimum": 0, "maximum": 0.5},
+        "replay_bitwise": {"enum": [True]},
+        "integrity_off_bitwise": {"enum": [True]},
+        "overhead": {
+            "type": "object",
+            "required": ["step_ms_off_p50", "step_ms_on_p50",
+                         "overhead_ratio_p50", "n_rounds"],
+            "properties": {
+                "step_ms_off_p50": {"type": "number", "minimum": 0},
+                "step_ms_on_p50": {"type": "number", "minimum": 0},
+                "overhead_ratio_p50": {"type": "number",
+                                       "maximum": 1.02},
+                "n_rounds": {"type": "integer", "minimum": 3},
+            },
+        },
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
+    ("integrity_", INTEGRITY_SCHEMA),
     ("obs_report_", OBS_REPORT_SCHEMA),
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
     ("arena_ablation_", ARENA_ABLATION_SCHEMA),
