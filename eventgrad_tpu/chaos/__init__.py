@@ -27,6 +27,14 @@ first-class, *measured* property instead of a hope:
     from a neighbor's snapshot streamed through the async checkpoint
     writer, and every transition force-fires the next exchange so
     buffers refresh in one cycle.
+  * `crashpoint` — PROCESS death drills and graceful preemption: a
+    registry of named, deterministically-armed kill sites at every
+    state-mutating seam (checkpoint swap, async writer thread, block
+    boundaries, bootstrap stream, rollback-restore) for the crash-
+    consistency matrix (tools/crash_matrix.py), plus the SIGTERM/SIGINT
+    drain + `preempt=EPOCH@STEP` clause that turns preemption into a
+    clean ≤-one-block loss (exit `exitcodes.PREEMPTED_EXIT`; the
+    supervisor relaunches without charging its budget).
   * `integrity` — LYING peers and SICK ranks (where the faults above are
     silent ones): wire checksums on every gossip payload (a failed check
     is an event that did not fire), non-finite quarantine inside the
@@ -42,6 +50,7 @@ curves), and `tools/soak.py` (the supervised long-running soak harness).
 Fault model and formats: docs/chaos.md.
 """
 
+from eventgrad_tpu.chaos.crashpoint import GracefulPreemption
 from eventgrad_tpu.chaos.schedule import ChaosSchedule, FlakyWindow
 from eventgrad_tpu.chaos.integrity import (
     INTEGRITY_ABORT_EXIT, DivergenceSentinel, IntegrityConfig,
@@ -58,6 +67,7 @@ __all__ = [
     "FlakyWindow",
     "INTEGRITY_ABORT_EXIT",
     "DivergenceSentinel",
+    "GracefulPreemption",
     "IntegrityConfig",
     "IntegrityEscalation",
     "MembershipEngine",

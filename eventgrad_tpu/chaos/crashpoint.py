@@ -1,0 +1,294 @@
+"""Crashpoint registry + graceful preemption: seeded kills, clean drains.
+
+The repo's durability story rests on a handful of state-mutating seams —
+`checkpoint.save`'s tmp-write/rename/fsync sequence, the `AsyncWriter`
+background thread, dispatch-block boundaries in `train/loop.py`, the
+membership bootstrap stream, the integrity rollback-restore. Every one
+claims to survive a kill at any instant; none had ever been killed there
+ON PURPOSE. This module makes that a first-class drill, and makes the
+dominant real-world failure — PREEMPTION — cheaper than a kill at all.
+
+Two mechanisms:
+
+  * **crashpoints** — a registry of named sites (`SITES`), each
+    instrumented at exactly ONE seam (a tier-1 lint enforces it). Arm
+    one with ``EG_CRASHPOINT=site[:hit_n]`` (or `arm()`): the n-th time
+    execution reaches `hit(site)` the process dies instantly via
+    `os._exit(CRASHPOINT_EXIT)` — no unwind, no atexit, no flush: the
+    honest model of SIGKILL/power loss. Deterministic by hit count, so
+    `tools/crash_matrix.py` can kill at every site under every
+    configuration, resume, and verify bitwise parity against the
+    uninterrupted run. Unarmed, `hit()` is a dict lookup — the loop's
+    hot path never pays for the drill it isn't running.
+
+  * **graceful preemption** — `PreemptGuard` installs SIGTERM/SIGINT
+    handlers that only SET A FLAG; the training loop checks it at each
+    dispatch-block boundary and, when set, drains the pipeline, joins
+    the checkpoint writer, force-snapshots, writes a ``PREEMPTED``
+    marker into the checkpoint dir, and raises `GracefulPreemption` —
+    the CLI exits `exitcodes.PREEMPTED_EXIT`, which the supervisor
+    treats as CLEAN (immediate relaunch, no restart-budget charge, no
+    backoff). EventGraD makes this nearly free: a rank that vanishes
+    between blocks is semantically an event that did not fire, so a
+    preemption loses at most one dispatch block of work — versus up to
+    a full `--save-every` interval for a hard kill. A second signal
+    while the drain is still running falls through to the previous
+    (usually default) handler: the escape hatch from a wedged drain.
+
+The scheduled twin of the signal path is the chaos clause
+``preempt=EPOCH@STEP`` (chaos/schedule.py): a deterministic, replayable
+preemption notice that "arrives" at that pass and drains at the
+enclosing block boundary — so the ≤-one-block loss bound is measurable
+in CI, not just claimed. See docs/chaos.md "Preemption & crash
+consistency".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from eventgrad_tpu.exitcodes import CRASHPOINT_EXIT
+
+#: environment variable arming one crashpoint for this process:
+#: ``site`` or ``site:hit_n`` (1-based; default 1 = the first hit)
+ENV_VAR = "EG_CRASHPOINT"
+
+#: marker file a graceful drain leaves in the checkpoint dir — the
+#: on-disk witness that the newest snapshot is a DRAINED one (nothing
+#: beyond it existed), consumed by the next incarnation's train()
+PREEMPT_MARKER = "PREEMPTED"
+
+#: every named crash site, and the seam it instruments. Each name
+#: appears at EXACTLY ONE `crashpoint.hit("<name>")` call in the
+#: package (tests/test_crashpoint.py lints it): a registered-but-dead
+#: site would silently hollow out the crash matrix, a duplicated one
+#: would make "kill at site X" ambiguous.
+SITES = {
+    "ckpt.tmp_written": (
+        "checkpoint.save: the tmp tree is fully serialized, BEFORE the "
+        "fsync durability point — on disk: old snapshot intact, tmp "
+        "complete but possibly volatile"
+    ),
+    "ckpt.mid_swap": (
+        "checkpoint.save: the old snapshot was demoted to .prev and the "
+        "new one is NOT yet promoted — the worst instant of the atomic "
+        "swap (latest() must find the .prev)"
+    ),
+    "ckpt.post_promote": (
+        "checkpoint.save: the new snapshot is promoted but .prev is not "
+        "yet dropped and the parent dir not yet fsynced"
+    ),
+    "writer.bg_save": (
+        "AsyncWriter: inside the background writer thread, before the "
+        "serialization/swap starts — kills the whole process from the "
+        "thread the pipeline hides checkpoint cost on"
+    ),
+    "loop.block_dispatched": (
+        "train loop: a dispatch block was just enqueued on device; none "
+        "of its host work (records, eval readback, checkpoint) has run"
+    ),
+    "loop.block_end": (
+        "train loop: a block boundary fully processed — host work "
+        "drained, any due checkpoint committed, transitions applied"
+    ),
+    "membership.bootstrap": (
+        "membership join: the neighbor snapshot was committed to the "
+        "on-disk bootstrap stream but the newcomer row is not yet "
+        "restored/inserted"
+    ),
+    "integrity.rollback": (
+        "integrity engine: mid rollback-restore — last-known-good state "
+        "restored in memory, replay not yet re-dispatched"
+    ),
+}
+
+
+class GracefulPreemption(RuntimeError):
+    """Raised by train() after a graceful preemption drain completed:
+    the pipeline is drained, the writer joined, the boundary snapshot
+    (when a checkpoint_dir exists) and the PREEMPTED marker are on
+    disk. The CLI converts it to `exitcodes.PREEMPTED_EXIT`; the
+    supervisor relaunches immediately without charging its budget."""
+
+    def __init__(self, info: Dict[str, Any]):
+        self.info = dict(info)
+        super().__init__(
+            f"graceful preemption ({info.get('reason')}) drained at "
+            f"epoch {info.get('epoch')}"
+        )
+
+
+def parse_spec(spec: str) -> Tuple[str, int]:
+    """``site`` or ``site:hit_n`` -> (site, hit_n); unknown sites and
+    non-positive hit counts fail fast (an armed typo that never fires
+    would read as 'survived the kill')."""
+    site, _, n = spec.partition(":")
+    site = site.strip()
+    if site not in SITES:
+        raise ValueError(
+            f"unknown crashpoint {site!r}; registered sites: "
+            f"{', '.join(sorted(SITES))}"
+        )
+    hit_n = int(n) if n else 1
+    if hit_n < 1:
+        raise ValueError(f"crashpoint hit count must be >= 1, got {hit_n}")
+    return site, hit_n
+
+
+_lock = threading.Lock()
+_armed: Optional[Tuple[str, int]] = None
+_hits: int = 0
+_env_read = False
+
+
+def _ensure_env() -> None:
+    global _env_read, _armed, _hits
+    if _env_read:
+        return
+    _env_read = True
+    spec = os.environ.get(ENV_VAR)
+    if spec:
+        _armed = parse_spec(spec)
+        _hits = 0
+
+
+def arm(spec: Optional[str]) -> None:
+    """Arm (or, with None, disarm) a crashpoint for this process —
+    the in-process face of the ``EG_CRASHPOINT`` env var (tests)."""
+    global _armed, _hits, _env_read
+    with _lock:
+        _env_read = True  # explicit arming overrides the environment
+        _armed = parse_spec(spec) if spec else None
+        _hits = 0
+
+
+def armed() -> Optional[Dict[str, Any]]:
+    """The armed site as ``{"site": ..., "hit": n}``, or None — the
+    replayability rider train() stamps on the run's first record."""
+    with _lock:
+        _ensure_env()
+        if _armed is None:
+            return None
+        return {"site": _armed[0], "hit": _armed[1]}
+
+
+def hit(site: str) -> None:
+    """Execution reached the named seam. Unarmed (the normal case):
+    validates the name and returns. Armed at this site: counts the hit
+    and, on the configured one, writes a one-line witness to stderr and
+    dies via `os._exit(CRASHPOINT_EXIT)` — no unwind, no atexit, no
+    buffer flush, exactly like a hard kill at this instant."""
+    if site not in SITES:
+        raise KeyError(
+            f"unregistered crashpoint {site!r} — add it to "
+            "chaos.crashpoint.SITES (the instrumentation lint indexes "
+            "the registry)"
+        )
+    with _lock:
+        _ensure_env()
+        if _armed is None or _armed[0] != site:
+            return
+        global _hits
+        _hits += 1
+        # capture under the lock: a concurrent arm(None) between lock
+        # release and the exit below must not turn the kill into a
+        # TypeError on a vanished tuple
+        hit_n = _armed[1]
+        if _hits < hit_n:
+            return
+    # outside the lock: nothing below returns
+    os.write(
+        2,
+        f"crashpoint {site} hit {hit_n}: killing process "
+        f"(exit {CRASHPOINT_EXIT})\n".encode(),
+    )
+    os._exit(CRASHPOINT_EXIT)
+
+
+# --- graceful preemption ---------------------------------------------------
+
+
+class PreemptGuard:
+    """Installs SIGTERM/SIGINT -> request-flag handlers for the duration
+    of a training run (context manager). The handler only records the
+    signal name; the loop performs the drain at its next block boundary.
+    After the first signal the PREVIOUS handlers are restored, so a
+    second signal interrupts a wedged drain the platform-default way.
+
+    Installs nothing when `enabled=False` or off the main thread
+    (signal.signal is main-thread-only); `requested` then just stays
+    None and the loop's check is inert."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.requested: Optional[str] = None
+        self._prev: Dict[int, Any] = {}
+
+    def _handler(self, signum, frame):
+        self.requested = signal.Signals(signum).name
+        self._restore()  # second signal: platform default (escape hatch)
+
+    def _restore(self) -> None:
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):  # pragma: no cover - teardown race
+                pass
+        self._prev = {}
+
+    def __enter__(self) -> "PreemptGuard":
+        if not self.enabled:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev[signum] = signal.signal(signum, self._handler)
+            except (ValueError, OSError):  # pragma: no cover - exotic host
+                pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+
+def marker_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, PREEMPT_MARKER)
+
+
+def write_marker(checkpoint_dir: str, info: Dict[str, Any]) -> str:
+    """Drop the PREEMPTED witness next to the drained snapshot, fsynced:
+    whoever inspects the checkpoint dir (an operator, tools/
+    crash_matrix.py) can tell a drained stop from a crash."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = marker_path(checkpoint_dir)
+    with open(path, "w") as f:
+        json.dump(info, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def consume_marker(checkpoint_dir: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Read-and-remove the PREEMPTED marker (train() calls this on
+    startup): the new incarnation supersedes the drained one, so a
+    stale marker must not outlive the resume it announced."""
+    if not checkpoint_dir:
+        return None
+    path = marker_path(checkpoint_dir)
+    try:
+        with open(path) as f:
+            info = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError):
+        info = None  # a torn marker still gets removed
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass  # multi-process startup: another rank consumed it first
+    return info

@@ -51,10 +51,13 @@ import dataclasses
 import math
 from typing import Any, Dict, Optional
 
-#: process exit code for "integrity engine gave up" (sentinel tripped
-#: beyond max_rollbacks): the supervisor treats it as PERMANENT and does
-#: not restart — a relaunch would replay the same divergence.
-INTEGRITY_ABORT_EXIT = 77
+# process exit code for "integrity engine gave up" (sentinel tripped
+# beyond max_rollbacks): the supervisor treats it as PERMANENT and does
+# not restart — a relaunch would replay the same divergence. The value
+# lives in the jax-free exit-code contract module (eventgrad_tpu/
+# exitcodes.py, shared with the supervisor); re-exported here for the
+# existing importers (cli, chaos.__init__, tests).
+from eventgrad_tpu.exitcodes import INTEGRITY_ABORT_EXIT  # noqa: F401
 
 
 class IntegrityEscalation(RuntimeError):

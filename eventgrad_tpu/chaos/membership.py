@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from eventgrad_tpu.chaos import crashpoint
 from eventgrad_tpu.chaos.policy import apply_ring_heal
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.topology import Ring, Topology
@@ -386,6 +387,10 @@ class MembershipEngine:
             return snap, False
         path = os.path.join(self.bootstrap_dir, "bootstrap")
         checkpoint.save(path, snap)
+        # seeded kill between the stream's commit and the newcomer's
+        # restore: the transition must be repeatable from the main
+        # snapshot (tools/crash_matrix.py proves it)
+        crashpoint.hit("membership.bootstrap")
         found = checkpoint.latest(path)
         return checkpoint.restore(found, snap), True
 

@@ -43,6 +43,19 @@ Fault vocabulary (all composable):
                      non-finite quarantine: the rank skips its update and
                      suppresses its sends for that step. Clauses
                      accumulate.
+  * `preempt`      — GRACEFUL PREEMPTION notice (chaos/crashpoint.py):
+                     `preempt=E@S` simulates the platform's "you have
+                     been preempted" signal arriving during epoch E at
+                     step S. Host-side like membership (never inside
+                     the traced step): the training loop drains at the
+                     enclosing dispatch-block boundary — pipeline
+                     drained, writer joined, force-snapshot, PREEMPTED
+                     marker — and exits `exitcodes.PREEMPTED_EXIT`, so
+                     the ≤-one-block loss bound is measurable
+                     deterministically (tools/crash_matrix.py). Clauses
+                     accumulate: later ones fire in later incarnations
+                     (a resume ignores notices at or before its start
+                     epoch).
   * `leave`/`join` — MEMBERSHIP events (chaos/membership.py): unlike the
                      wire faults above they are keyed by EPOCH, applied
                      between jit dispatch blocks on the host (a rank
@@ -56,10 +69,10 @@ Fault vocabulary (all composable):
 CLI spec grammar (comma-separated clauses, see `parse`):
 
     drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500,leave=1@3,join=1@5,
-    bitflip=40-60@0.5,nanstep=2@45
+    bitflip=40-60@0.5,nanstep=2@45,preempt=6@2
 
-Multiple `flaky=` / `die=` / `leave=` / `join=` / `bitflip=` / `nanstep=`
-clauses accumulate.
+Multiple `flaky=` / `die=` / `leave=` / `join=` / `bitflip=` /
+`nanstep=` / `preempt=` clauses accumulate.
 """
 
 from __future__ import annotations
@@ -103,6 +116,9 @@ class ChaosSchedule:
     bitflip: Tuple[FlakyWindow, ...] = ()
     #: gradient-poison events: ((rank, pass), ...) — rank's grads go NaN
     nanstep: Tuple[Tuple[int, int], ...] = ()
+    #: graceful-preemption notices: ((epoch, step), ...) — host-side
+    #: like membership; the loop drains at the enclosing block boundary
+    preempt: Tuple[Tuple[int, int], ...] = ()
 
     def __post_init__(self):
         if not 0.0 <= self.drop_p <= 1.0:
@@ -130,6 +146,13 @@ class ChaosSchedule:
         for r, t in self.nanstep:
             if r < 0 or t < 0:
                 raise ValueError(f"nanstep ({r}, {t}) invalid")
+        object.__setattr__(self, "preempt", tuple(sorted(self.preempt)))
+        for e, s in self.preempt:
+            if e < 1 or s < 1:
+                raise ValueError(
+                    f"preempt ({e}, {s}) invalid: epoch and step are "
+                    "1-based"
+                )
 
     @property
     def is_noop(self) -> bool:
@@ -145,6 +168,7 @@ class ChaosSchedule:
             and not self.membership
             and not self.bitflip
             and not self.nanstep
+            and not self.preempt
         )
 
     @property
@@ -189,6 +213,8 @@ class ChaosSchedule:
             ]
         if self.nanstep:
             d["nanstep"] = [list(e) for e in self.nanstep]
+        if self.preempt:
+            d["preempt"] = [list(e) for e in self.preempt]
         return d
 
     @classmethod
@@ -219,6 +245,9 @@ class ChaosSchedule:
             nanstep=tuple(
                 (int(r), int(t)) for r, t in d.get("nanstep", ())
             ),
+            preempt=tuple(
+                (int(e), int(s)) for e, s in d.get("preempt", ())
+            ),
         )
 
     # --- CLI spec round trip -------------------------------------------
@@ -235,6 +264,8 @@ class ChaosSchedule:
             parts.append(f"bitflip={w.start_pass}-{w.end_pass}@{w.drop_p:g}")
         for r, t in self.nanstep:
             parts.append(f"nanstep={r}@{t}")
+        for e, s in self.preempt:
+            parts.append(f"preempt={e}@{s}")
         if self.membership:
             from eventgrad_tpu.chaos.membership import format_event_clause
 
@@ -246,7 +277,7 @@ class ChaosSchedule:
         """Parse the CLI grammar, e.g. `drop=0.2,seed=7,flaky=10-20@0.8`."""
         kw: Dict[str, Any] = {
             "flaky": [], "death": [], "membership": [], "bitflip": [],
-            "nanstep": [],
+            "nanstep": [], "preempt": [],
         }
         for clause in spec.split(","):
             clause = clause.strip()
@@ -295,6 +326,11 @@ class ChaosSchedule:
                 elif key == "nanstep":
                     r, _, t = val.partition("@")
                     kw["nanstep"].append((int(r), int(t)))
+                elif key == "preempt":
+                    # `preempt=E@S`; a bare `preempt=E` means step 1
+                    # (the notice arrives as epoch E opens)
+                    e, _, s = val.partition("@")
+                    kw["preempt"].append((int(e), int(s) if s else 1))
                 elif key in ("leave", "join"):
                     from eventgrad_tpu.chaos.membership import (
                         parse_event_clause,
@@ -312,6 +348,7 @@ class ChaosSchedule:
         kw["membership"] = tuple(kw["membership"])
         kw["bitflip"] = tuple(kw["bitflip"])
         kw["nanstep"] = tuple(kw["nanstep"])
+        kw["preempt"] = tuple(kw["preempt"])
         return cls(**kw)
 
 

@@ -33,9 +33,11 @@ import sys
 import jax
 import numpy as np
 
+from eventgrad_tpu.chaos.crashpoint import GracefulPreemption
 from eventgrad_tpu.chaos.integrity import (
     INTEGRITY_ABORT_EXIT, IntegrityEscalation,
 )
+from eventgrad_tpu.exitcodes import PREEMPTED_EXIT
 from eventgrad_tpu.data.datasets import load_or_synthesize, synthetic_lm_dataset
 from eventgrad_tpu.models import MODEL_REGISTRY
 from eventgrad_tpu.parallel import multihost
@@ -275,10 +277,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="deterministic gossip fault injection (chaos/): "
                         "e.g. 'drop=0.2,seed=7,flaky=100-200@0.8,"
-                        "delay=3,die=3@500' — per-edge drop probability, "
-                        "flaky windows [start-end)@p, k-pass delivery "
-                        "thinning, permanent peer death; gossip algos "
-                        "(dpsgd/eventgrad) only. Replayable: the "
+                        "delay=3,die=3@500,preempt=6@2' — per-edge drop "
+                        "probability, flaky windows [start-end)@p, "
+                        "k-pass delivery thinning, permanent peer "
+                        "death, scheduled graceful preemption (drain + "
+                        f"snapshot + exit {PREEMPTED_EXIT}); gossip "
+                        "algos (dpsgd/eventgrad) only. Replayable: the "
                         "schedule is serialized into the first history "
                         "record")
     p.add_argument("--membership", default=None, metavar="SPEC",
@@ -646,6 +650,15 @@ def main(argv=None) -> int:
                     on_epoch=emit,  # records stream as epochs finish: live
                     # metrics for the user, a liveness signal for supervise.py
                 )
+        except GracefulPreemption as e:
+            # the loop already drained the pipeline, joined the writer,
+            # force-snapshotted at the block boundary, and left the
+            # PREEMPTED marker: exit the reserved code so the supervisor
+            # relaunches immediately without charging its restart budget
+            if primary:
+                emit({"preempted": True, **e.info})
+            print(f"preempted: {e}", file=sys.stderr, flush=True)
+            return PREEMPTED_EXIT
         except IntegrityEscalation as e:
             # the retained last-known-good state cannot outrun this
             # fault: exit the reserved code so the supervisor gives up

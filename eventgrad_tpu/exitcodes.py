@@ -1,0 +1,58 @@
+"""Process exit codes shared by the training CLI and the supervisor.
+
+One import-bare module, because the codes are a cross-process CONTRACT:
+the child picks one, the supervisor (`eventgrad_tpu.supervise`)
+switches on it. Before this module, `INTEGRITY_ABORT_EXIT` lived in
+`chaos/integrity.py` and was re-declared by value in `supervise.py`
+with only an equality-pin test holding the two together — every new
+code would have doubled that debt. Both now import from here
+(integrity re-exports its name for compatibility). This file itself
+imports nothing; reaching it through the package still runs
+`eventgrad_tpu/__init__` like any `python -m eventgrad_tpu.*`
+invocation always has.
+
+The vocabulary:
+
+  * 0                    — the run completed; the supervisor is done.
+  * ``PREEMPTED_EXIT``   — GRACEFUL PREEMPTION (chaos/crashpoint.py):
+    the child saw SIGTERM/SIGINT (or a scheduled ``preempt=`` clause),
+    drained the dispatch pipeline at the next block boundary, joined the
+    checkpoint writer, force-snapshotted, wrote a ``PREEMPTED`` marker,
+    and exited on purpose. The supervisor relaunches IMMEDIATELY with
+    ``--resume``: no restart-budget charge, no backoff — preemption is
+    the dominant *healthy* exit on spot/preemptible capacity, and at
+    most one dispatch block of work is at stake. 75 is sysexits.h
+    EX_TEMPFAIL: "temporary failure, retry".
+  * ``INTEGRITY_ABORT_EXIT`` — the divergence sentinel tripped beyond
+    the rollback budget (chaos/integrity.py): a relaunch would restore
+    the same last-known-good snapshot and replay the same divergence,
+    so the supervisor gives up WITHOUT a restart.
+  * ``CRASHPOINT_EXIT``  — an armed ``EG_CRASHPOINT`` site fired
+    (chaos/crashpoint.py): the process killed itself mid-mutation on
+    purpose, simulating a hard kill for the crash-consistency matrix
+    (tools/crash_matrix.py). Distinct from any organic failure so the
+    matrix can verify the kill landed at the armed site and nowhere
+    else.
+  * anything else nonzero — a crash; the supervisor restarts from the
+    latest snapshot under its sliding budget + backoff.
+"""
+
+#: graceful preemption: the child drained, snapshotted, and exited on
+#: purpose — relaunch immediately, charge nothing (EX_TEMPFAIL)
+PREEMPTED_EXIT = 75
+
+#: integrity engine gave up (divergence sentinel beyond max_rollbacks):
+#: permanent — restarting would replay the same divergence
+INTEGRITY_ABORT_EXIT = 77
+
+#: an armed EG_CRASHPOINT site killed the process on purpose (the
+#: crash-consistency matrix's seeded kill)
+CRASHPOINT_EXIT = 83
+
+#: name table for logs/docs (docs/chaos.md "Preemption & crash
+#: consistency" mirrors it)
+EXIT_CODE_NAMES = {
+    PREEMPTED_EXIT: "PREEMPTED",
+    INTEGRITY_ABORT_EXIT: "INTEGRITY_ABORT",
+    CRASHPOINT_EXIT: "CRASHPOINT",
+}

@@ -218,6 +218,38 @@ INTEGRITY_FIELDS = {
 }
 
 
+#: Preemption & crash-drill surfaces (chaos/crashpoint.py): terminal/
+#: first-record riders plus the Prometheus gauge
+#: `eventgrad_preemptions_total`. name -> (units, modes, description)
+PREEMPTION_FIELDS = {
+    "preempted": (
+        "record", "preemption runs",
+        "terminal record the CLI writes after a graceful drain: reason "
+        "(signal:SIGTERM|signal:SIGINT|schedule:E@S), epoch (the "
+        "drained block boundary), snapshot (a boundary snapshot is on "
+        "disk), drain_s, marker (the PREEMPTED file path) — the "
+        "process then exits exitcodes.PREEMPTED_EXIT and the "
+        "supervisor relaunches without charging its restart budget",
+    ),
+    "drain_s": (
+        "seconds", "preemption runs",
+        "time the graceful drain spent (pipeline drain + writer join + "
+        "boundary snapshot), inside the `preempted` record",
+    ),
+    "crashpoint": (
+        "rider", "crash-drill runs",
+        "the armed EG_CRASHPOINT as {site, hit}, stamped on the run's "
+        "first record (replayability rider, like `chaos`): the log of "
+        "a killed run names the site it died at",
+    ),
+    "preemptions_total": (
+        "count", "preemption runs",
+        "Prometheus gauge: graceful preemption drains this process "
+        "performed (0 normally, 1 after a drain)",
+    ),
+}
+
+
 #: derived series emitted by obs.report.build_report (tools/obs_report.py)
 REPORT_FIELDS = {
     "msgs_saved_pct_per_leaf": (
@@ -252,4 +284,5 @@ def all_field_names():
     names = set(TELEMETRY_FIELDS) | set(RECORD_FIELDS)
     names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
     names |= set(MEMBERSHIP_FIELDS) | set(INTEGRITY_FIELDS)
+    names |= set(PREEMPTION_FIELDS)
     return sorted(names)

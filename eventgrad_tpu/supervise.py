@@ -47,14 +47,19 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 
-#: exit code reserved by the integrity engine for "the divergence
-#: sentinel tripped beyond the rollback budget" — a relaunch from the
-#: latest snapshot would replay the same divergence, so the supervisor
-#: gives up immediately, restart budget notwithstanding. Kept equal to
-#: chaos.integrity.INTEGRITY_ABORT_EXIT (pinned by tests/
-#: test_supervise.py) without importing it: the supervisor must stay a
-#: jax-free process.
-INTEGRITY_ABORT_EXIT = 77
+# the process exit-code contract lives in ONE import-bare module
+# (eventgrad_tpu/exitcodes.py) shared with the children that pick the
+# codes — the value-pinning re-declaration this file used to carry is
+# gone. Honest caveat: importing it through the package runs
+# eventgrad_tpu/__init__ (which pulls jax) — exactly what every real
+# invocation (`python -m eventgrad_tpu.supervise`) already paid before
+# this import existed, so the supervisor's import cost is unchanged;
+# only a copied-out supervise.py on a jax-less host would notice.
+# INTEGRITY_ABORT_EXIT (sentinel tripped beyond the rollback budget:
+# give up, a relaunch would replay the same divergence) and
+# PREEMPTED_EXIT (graceful drain: relaunch immediately, charge nothing)
+# stay pinned by tests/test_supervise.py.
+from eventgrad_tpu.exitcodes import INTEGRITY_ABORT_EXIT, PREEMPTED_EXIT
 
 
 class RestartBudget:
@@ -208,6 +213,27 @@ def supervise(
         rc = proc.returncode
         if rc == 0:
             return 0
+        if rc == PREEMPTED_EXIT and reason is None:
+            # graceful preemption (chaos/crashpoint.py): the child
+            # drained its pipeline, snapshotted at a block boundary, and
+            # exited ON PURPOSE — the dominant healthy exit on spot/
+            # preemptible capacity. Relaunch immediately: no restart-
+            # budget charge (a once-an-hour preemption must never
+            # exhaust a crash budget) and no backoff (at most one
+            # dispatch block of work is waiting on the relaunch).
+            # `reason is None` guards the hang path: a heartbeat-stalled
+            # child that drains to 75 under the supervisor's OWN SIGTERM
+            # was still a hang — it keeps charging the budget, or a
+            # stall-loop would relaunch forever.
+            attempt += 1
+            consecutive = 0
+            print(
+                f"supervise: child preempted (exit {rc}); relaunching "
+                "immediately from its drained snapshot (no budget "
+                "charge, no backoff)",
+                file=sys.stderr, flush=True,
+            )
+            continue
         if rc == INTEGRITY_ABORT_EXIT:
             # permanent escalation from the integrity engine: restarting
             # would restore the same last-known-good snapshot and replay

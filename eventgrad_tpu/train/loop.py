@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from eventgrad_tpu.chaos import crashpoint
 from eventgrad_tpu.chaos import integrity as chaos_integrity
 from eventgrad_tpu.chaos import membership as chaos_membership
 from eventgrad_tpu.chaos import monitor as chaos_monitor
@@ -315,6 +316,25 @@ def train(
     ends; the first record carries the serialized schedule so the run is
     replayable from its log alone. See docs/chaos.md.
 
+    Preemption & crash drills (chaos/crashpoint.py, docs/chaos.md
+    "Preemption & crash consistency"): with a checkpoint_dir on the
+    single-process path, SIGTERM/SIGINT request a GRACEFUL drain — at
+    the next dispatch-block boundary the loop drains the pipeline,
+    joins the async writer, force-snapshots, writes a PREEMPTED marker,
+    and raises chaos.GracefulPreemption (the CLI exits
+    exitcodes.PREEMPTED_EXIT; the supervisor relaunches immediately
+    without charging its restart budget) — so a preemption replays at
+    most the one block that was in flight. The chaos clause
+    `preempt=EPOCH@STEP` is the deterministic, replayable twin of the
+    signal. Independently, EG_CRASHPOINT=site[:hit_n] arms a seeded
+    HARD kill at one named state-mutating seam (checkpoint swap, writer
+    thread, block boundaries, bootstrap stream, rollback-restore);
+    tools/crash_matrix.py kills at every site under every configuration
+    and proves bitwise resume. With nothing armed and no signal
+    delivered, the traced step and history are bit-identical to a
+    drill-free build (the armed run's first record carries a
+    `crashpoint` rider).
+
     membership (a chaos.MembershipSchedule, spec string like
     "leave=1@3,join=1@5", or serialized dict — also liftable from a
     chaos spec's join=/leave= clauses) runs the run under the ELASTIC
@@ -505,6 +525,14 @@ def train(
             raise ValueError(f"bad fault_inject spec {fault_inject!r}")
         fault_epoch = int(n)
     ckpt_path = os.path.join(checkpoint_dir, "ckpt") if checkpoint_dir else None
+    if checkpoint_dir:
+        # a PREEMPTED marker left by a drained predecessor is consumed
+        # here: this incarnation supersedes it (chaos/crashpoint.py)
+        crashpoint.consume_marker(checkpoint_dir)
+    # armed-crashpoint rider (chaos/crashpoint.py): stamped on the run's
+    # first record like the chaos schedule, so a crash-drill log names
+    # the kill it survived; None (the normal case) stamps nothing
+    crash_armed = crashpoint.armed()
 
     # --- elastic membership resolution (chaos/membership.py) -----------
     memb_sched = (
@@ -754,6 +782,15 @@ def train(
         found = checkpoint.latest(ckpt_path)
         if found:
             import warnings
+
+            if memb_raw is None:
+                # one template-free read serves every restore attempt
+                # below (raw= short-circuits their disk reads) and —
+                # the point — routes EVERY resume through peek's
+                # corrupt-primary -> .prev auto-fallback: a truncated
+                # snapshot with a complete demoted twin recovers loudly
+                # instead of failing the service
+                memb_raw = checkpoint.peek(found)
 
             def _restore(tmpl_state):
                 """(restored, trace_carry-or-None): a snapshot from before
@@ -1229,6 +1266,10 @@ def train(
                 if j == 0 and hw.get("memb_recs"):
                     # transitions applied at the previous block boundary
                     rec["membership_transitions"] = hw["memb_recs"]
+            if crash_armed is not None and not history:
+                # crash-drill rider: the log of a killed run names the
+                # armed site, so the matrix can verify WHERE it died
+                rec["crashpoint"] = dict(crash_armed)
             if chaos_sched is not None:
                 if not history:  # replayability: schedule rides record 1
                     rec["chaos"] = chaos_sched.to_dict()
@@ -1421,12 +1462,46 @@ def train(
             "passes_done": passes_done,
             "rank_passes_done": rank_passes_done,
         }
+    def _boundary_payload(blk_end: int) -> Dict[str, Any]:
+        """The snapshot payload at a block boundary — ONE definition
+        shared by the periodic serial save and the preemption drain, so
+        a drained snapshot can never diverge from a scheduled one.
+        Reads the loop's current state/trace_carry at call time."""
+        save_state = multihost.to_host(state) if multi else state
+        payload: Dict[str, Any] = {
+            "state": save_state, "epoch": np.int64(blk_end),
+        }
+        if not memb_on:
+            # the recv-trace carry is rank-shaped; the elastic run
+            # (trace_file unsupported there) omits it so a resume can
+            # re-shape the template from the membership log alone
+            payload["trace_carry"] = trace_carry
+        return payload
+
+    # --- graceful preemption (chaos/crashpoint.py) ---------------------
+    # scheduled notices: the first one strictly beyond this run's start
+    # epoch belongs to THIS incarnation (a resume ignores the notices
+    # its drained predecessor already honored)
+    preempt_at: Optional[Tuple[int, int]] = None
+    if chaos_sched is not None and chaos_sched.preempt:
+        preempt_at = next(
+            ((e, s) for e, s in chaos_sched.preempt if e > start_epoch),
+            None,
+        )
+    # SIGTERM/SIGINT handlers set a flag the block loop drains on; only
+    # installed where the drain can actually snapshot (a checkpoint_dir
+    # exists) and the process owns its signals (single-process) — every
+    # other run keeps today's default signal behavior, bit for bit
+    preempt_guard = crashpoint.PreemptGuard(
+        enabled=ckpt_path is not None and not multi
+    )
     _root_span = contextlib.ExitStack()
     pending: Optional[Dict[str, Any]] = None
     try:
         _root_span.enter_context(
             _span("train", cat="run", algo=algo, pipelined=pipeline_on)
         )
+        _root_span.enter_context(preempt_guard)
         bi = 0
         while bi < len(blocks):
             # index-based iteration: an integrity rollback REWINDS bi to
@@ -1492,6 +1567,9 @@ def train(
                     state, m = run_epoch(state, xb, yb)
                 if not pipeline_on:
                     jax.block_until_ready(state.params)
+            # seeded kill drill: the block is on device, none of its
+            # host work has run (pipeline on and off both pass here)
+            crashpoint.hit("loop.block_dispatched")
             # post-block device enqueues: every read of the NEW state is
             # dispatched HERE, before the next iteration's run_epoch
             # donates its buffers — in-order device execution sequences
@@ -1589,6 +1667,10 @@ def train(
                             for k, v in
                             integ_good["snap"]["trace_carry"].items()
                         }
+                        # seeded kill drill: state restored in memory,
+                        # replay not yet re-dispatched — a kill here
+                        # must resume into the same rollback
+                        crashpoint.hit("integrity.rollback")
                     hardened = False
                     if integ_cfg.escalate:
                         # harden the step: the replayed segment meets
@@ -1740,25 +1822,59 @@ def train(
                     # host; checkpoint.save coordinates the one-writer
                     # snapshot (checkpoint_dir visible to all processes)
                     with _span("checkpoint", cat="host", epoch=blk_end):
-                        save_state = (
-                            multihost.to_host(state) if multi else state
+                        checkpoint.save(
+                            ckpt_path, _boundary_payload(blk_end)
                         )
-                        payload = {
-                            "state": save_state,
-                            "epoch": np.int64(blk_end),
-                        }
-                        if not memb_on:
-                            # the recv-trace carry is rank-shaped; the
-                            # elastic run (trace_file unsupported there)
-                            # omits it so a resume can re-shape the
-                            # template from the membership log alone
-                            payload["trace_carry"] = trace_carry
-                        checkpoint.save(ckpt_path, payload)
+            # --- graceful preemption drain (chaos/crashpoint.py) -------
+            # a SIGTERM/SIGINT that landed since the last boundary, or a
+            # scheduled preempt= notice whose epoch this block reached:
+            # drain the pipeline, join the writer, force-snapshot at
+            # THIS boundary, leave the PREEMPTED marker, and raise — the
+            # CLI exits PREEMPTED_EXIT, the supervisor relaunches
+            # without charging its budget, and the resume replays at
+            # most the block that was in flight when the notice arrived
+            preempt_reason = None
+            if preempt_guard.requested is not None:
+                preempt_reason = f"signal:{preempt_guard.requested}"
+            elif preempt_at is not None and blk_end >= preempt_at[0]:
+                preempt_reason = f"schedule:{preempt_at[0]}@{preempt_at[1]}"
+            if preempt_reason is not None:
+                t_preempt = time.perf_counter()
+                with _span("preempt_drain", cat="host", epoch=blk_end):
+                    if pending is not None:
+                        _drain(pending)
+                        pending = None
+                    if ckpt_writer is not None:
+                        # joins the in-flight (possibly just-dispatched)
+                        # async save; re-raises its errors
+                        ckpt_writer.wait()
+                    if ckpt_path and not ckpt_due:
+                        # boundary snapshot: nothing past this block
+                        # existed, so the resume loses NOTHING that ran
+                        checkpoint.save(
+                            ckpt_path, _boundary_payload(blk_end)
+                        )
+                info = {
+                    "reason": preempt_reason,
+                    "epoch": int(blk_end),
+                    "snapshot": bool(ckpt_path),
+                    "drain_s": round(time.perf_counter() - t_preempt, 4),
+                }
+                if registry is not None:
+                    registry.gauge("preemptions_total", 1.0)
+                if checkpoint_dir and multihost.is_primary():
+                    info["marker"] = crashpoint.write_marker(
+                        checkpoint_dir, info
+                    )
+                raise crashpoint.GracefulPreemption(info)
             if blk_end == fault_epoch:  # pipeline off under fault_inject
                 if fault_mode == "crash":
                     os._exit(13)
                 while True:  # "hang": alive but no progress (no heartbeat)
                     time.sleep(3600)
+            # seeded kill drill: the boundary is fully processed (host
+            # work drained or deferred, due checkpoint committed)
+            crashpoint.hit("loop.block_end")
             bi += 1
         if pending is not None:
             _drain(pending)

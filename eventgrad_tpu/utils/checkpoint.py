@@ -7,14 +7,21 @@ so an interrupted decentralized run resumes with its exact gossip state.
 
 from __future__ import annotations
 
+import atexit
 import os
 import shutil
 import threading
+import warnings
 from typing import Any, Callable, ContextManager, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+# seeded kill sites bracketing every mutation below (chaos/crashpoint.py:
+# no-ops unless EG_CRASHPOINT arms one) — tools/crash_matrix.py kills at
+# each and proves the resume
+from eventgrad_tpu.chaos import crashpoint
 
 
 def _fsync_path(path: str) -> None:
@@ -65,6 +72,7 @@ def save(path: str, state: Any) -> None:
     # force=True clears a stale tmp itself, primary-only with internal syncs
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp, state, force=True)
+    crashpoint.hit("ckpt.tmp_written")
     if multihost.is_primary():
         # durability point: the tmp tree's bytes are on disk BEFORE any
         # rename makes them the snapshot of record
@@ -74,9 +82,11 @@ def save(path: str, state: Any) -> None:
             if os.path.exists(prev):
                 shutil.rmtree(prev)
             os.rename(path, prev)
+            crashpoint.hit("ckpt.mid_swap")
         # the promoted snapshot may be absent (first save, or resumed from
         # .prev); never touch a surviving .prev until the new one is in place
         os.rename(tmp, path)
+        crashpoint.hit("ckpt.post_promote")
         if os.path.exists(prev):
             shutil.rmtree(prev)
         # persist the rename-commit itself
@@ -106,13 +116,18 @@ class AsyncWriter:
     `<path>.prev` complete for `latest()`.
 
     Join barriers: `save()` joins any in-flight write first (two writers
-    must never race the tmp/prev swap), and `wait()`/`close()` join on
-    exit. A failed background save re-raises at the next barrier —
-    never silently."""
+    must never race the tmp/prev swap), `wait()`/`close()` join on
+    exit, and an `atexit` hook joins on INTERPRETER exit — a
+    KeyboardInterrupt or SIGTERM-turned-exception that unwinds past
+    every `finally` still cannot abandon a half-serialized tmp tree to
+    the daemon-thread kill (the atomic swap keeps even that case safe
+    on disk; the hook keeps it from being the normal path). A failed
+    background save re-raises at the next barrier — never silently."""
 
     def __init__(self) -> None:
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
+        self._atexit_hook: Optional[Callable[[], None]] = None
 
     def save(
         self,
@@ -125,11 +140,19 @@ class AsyncWriter:
         `span` (zero-arg context-manager factory) wraps the write for
         observability (obs.Registry spans are thread-safe)."""
         self.wait()
+        if self._atexit_hook is None:
+            # interrupt barrier: interpreter teardown joins the in-flight
+            # write (logged, not raised — close() unregisters on the
+            # orderly paths, so this only fires on an unwind that skipped
+            # them)
+            self._atexit_hook = lambda: self.close(raise_errors=False)
+            atexit.register(self._atexit_hook)
 
         def work() -> None:
             try:
                 import contextlib
 
+                crashpoint.hit("writer.bg_save")
                 with (span() if span is not None else contextlib.nullcontext()):
                     save(path, payload)
             except BaseException as e:  # re-raised at the next barrier
@@ -154,6 +177,9 @@ class AsyncWriter:
         paths: join without masking the primary exception — but a
         discarded save failure is still LOGGED (the snapshot on disk is
         the stale previous one; a resume would replay extra epochs)."""
+        if self._atexit_hook is not None:
+            atexit.unregister(self._atexit_hook)
+            self._atexit_hook = None
         if raise_errors:
             self.wait()
             return
@@ -276,28 +302,68 @@ def peek(path: str) -> Any:
     shape of the snapshot is itself unknown — e.g. a membership-elastic
     resume must read the saved epoch before it can size the state
     template (the rank count at that epoch follows from the membership
-    schedule; train/loop.py).
+    schedule; train/loop.py); the generic resume path (train/loop.py)
+    also routes through it so the fallback below covers every load.
 
-    A truncated or corrupted snapshot fails LOUDLY with the offending
-    path and the recovery options — never half-restores: a resume that
-    silently proceeded from garbage would train on it."""
+    A truncated or corrupted PRIMARY with a complete demoted `.prev`
+    twin (a kill between the swap's renames, torn metadata on a
+    non-fsynced filesystem) auto-recovers from the twin — LOUDLY, via a
+    RuntimeWarning naming both paths: the service keeps running at the
+    cost of one save interval instead of paging a human to type the
+    `.prev` path by hand. Anything less recoverable (no twin, or both
+    sides corrupt) fails loudly with the offending path(s) and the
+    remaining options — never half-restores: a resume that silently
+    proceeded from garbage would train on it."""
     path = os.path.abspath(path)
-    try:
+
+    def _read(p: str) -> Any:
         with ocp.PyTreeCheckpointer() as ckptr:
-            return ckptr.restore(path)
+            return ckptr.restore(p)
+
+    try:
+        return _read(path)
     except Exception as exc:
         prev = path + ".prev"
-        hint = (
-            f"a demoted twin exists at {prev} — pass it instead"
-            if os.path.exists(prev)
-            else "no .prev twin exists; restore from a retained "
-                 "last-known-good snapshot (RollingRetention) or an "
-                 "earlier backup"
-        )
-        raise RuntimeError(
+        if path.endswith(".prev") or not os.path.exists(prev):
+            raise RuntimeError(
+                f"checkpoint at {path} is unreadable (truncated or "
+                f"corrupted): {type(exc).__name__}: {exc}. No .prev twin "
+                "exists; restore from a retained last-known-good "
+                "snapshot (RollingRetention) or an earlier backup"
+            ) from exc
+        try:
+            out = _read(prev)
+        except Exception as prev_exc:
+            raise RuntimeError(
+                f"checkpoint at {path} AND its demoted twin {prev} are "
+                f"both unreadable (primary: {type(exc).__name__}: {exc}; "
+                f"twin: {type(prev_exc).__name__}: {prev_exc}); restore "
+                "from a retained last-known-good snapshot "
+                "(RollingRetention) or an earlier backup"
+            ) from exc
+        # sideline the corrupt primary BEFORE anyone saves again: the
+        # swap demotes an existing primary over .prev, so leaving the
+        # corrupt tree in place would destroy the only good snapshot
+        # the moment the recovered run checkpoints (and a kill inside
+        # that swap would strand the run unresumable). Rename, never
+        # delete — forensics keep the bytes; latest() ignores .corrupt.
+        corrupt = path + ".corrupt"
+        try:
+            if os.path.exists(corrupt):
+                shutil.rmtree(corrupt, ignore_errors=True)
+            os.rename(path, corrupt)
+        except OSError:  # multi-process peek race: another rank won
+            corrupt = "(already sidelined)"
+        warnings.warn(
             f"checkpoint at {path} is unreadable (truncated or "
-            f"corrupted): {type(exc).__name__}: {exc}. {hint}"
-        ) from exc
+            f"corrupted): {type(exc).__name__}: {exc} — RECOVERED from "
+            f"its demoted twin {prev}; up to one save interval of work "
+            f"replays, and the corrupt primary was sidelined to "
+            f"{corrupt} so the next save cannot demote it over the "
+            "good twin",
+            RuntimeWarning,
+        )
+        return out
 
 
 def restore(path: str, template: Any, raw: Any = None) -> Any:

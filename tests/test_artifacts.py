@@ -35,6 +35,11 @@ def test_repo_artifacts_all_valid():
     # <= 1 hardened rollback, <= 0.5 pt gap, bitwise replay, off ==
     # today's step, <= 2% in-step overhead
     assert "integrity_cpu.json" in names
+    # the crash-consistency proof (ISSUE 8): every crash site x config
+    # cell killed at the armed seam, resumed, bitwise final state and
+    # history; zero unresumable cells, zero silent data loss; graceful
+    # preemption <= 1 dispatch block
+    assert "crash_matrix_cpu.json" in names
     assert out["errors"] == []
 
 

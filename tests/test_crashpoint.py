@@ -1,0 +1,357 @@
+"""Preemption & crash-consistency engine (chaos/crashpoint.py).
+
+Three layers: registry mechanics (arming, hit counting, the
+instrumentation lint), graceful preemption (SIGTERM and the scheduled
+`preempt=` clause both drain at a block boundary, snapshot, mark, and
+resume BITWISE), and one end-to-end subprocess kill/resume cell of the
+crash matrix (the full matrix lives in tools/crash_matrix.py and ships
+as the schema-gated artifacts/crash_matrix_cpu.json).
+"""
+
+import glob
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from eventgrad_tpu import exitcodes
+from eventgrad_tpu.chaos import ChaosSchedule, GracefulPreemption, crashpoint
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.utils import checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "eventgrad_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed — a leaked arming would kill
+    later tests at their first checkpoint."""
+    crashpoint.arm(None)
+    yield
+    crashpoint.arm(None)
+
+
+def _train_kw():
+    return dict(
+        algo="eventgrad", epochs=4, batch_size=8, learning_rate=0.05,
+        event_cfg=EventConfig(adaptive=True, horizon=0.95, warmup_passes=2),
+        seed=5,
+    )
+
+
+def _data():
+    return synthetic_dataset(128, (8, 8, 1), seed=3)
+
+
+def _assert_params_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --- exit-code contract -----------------------------------------------------
+
+
+def test_exit_codes_centralized_and_distinct():
+    """One jax-free module holds the contract; every consumer imports
+    it (the old supervise re-declaration is gone) and the codes stay
+    distinct from each other, from 0, and from fault_inject's 13."""
+    from eventgrad_tpu import supervise
+    from eventgrad_tpu.chaos import integrity
+
+    assert exitcodes.INTEGRITY_ABORT_EXIT == 77
+    assert exitcodes.PREEMPTED_EXIT == 75
+    assert exitcodes.CRASHPOINT_EXIT == 83
+    codes = {
+        exitcodes.INTEGRITY_ABORT_EXIT, exitcodes.PREEMPTED_EXIT,
+        exitcodes.CRASHPOINT_EXIT,
+    }
+    assert len(codes) == 3 and 0 not in codes and 13 not in codes
+    assert supervise.INTEGRITY_ABORT_EXIT is exitcodes.INTEGRITY_ABORT_EXIT
+    assert supervise.PREEMPTED_EXIT is exitcodes.PREEMPTED_EXIT
+    assert integrity.INTEGRITY_ABORT_EXIT is exitcodes.INTEGRITY_ABORT_EXIT
+    assert set(exitcodes.EXIT_CODE_NAMES) == codes
+    # and the module really is import-bare (the supervisor's constraint)
+    import importlib.util
+
+    spec = importlib.util.find_spec("eventgrad_tpu.exitcodes")
+    with open(spec.origin) as f:
+        src = f.read()
+    assert "import" not in re.sub(r'""".*?"""', "", src, flags=re.DOTALL)
+
+
+# --- registry mechanics -----------------------------------------------------
+
+
+def test_parse_spec_and_arming():
+    assert crashpoint.parse_spec("loop.block_end") == ("loop.block_end", 1)
+    assert crashpoint.parse_spec("ckpt.mid_swap:3") == ("ckpt.mid_swap", 3)
+    with pytest.raises(ValueError, match="unknown crashpoint"):
+        crashpoint.parse_spec("no.such.site")
+    with pytest.raises(ValueError, match=">= 1"):
+        crashpoint.parse_spec("loop.block_end:0")
+    crashpoint.arm("loop.block_end:2")
+    assert crashpoint.armed() == {"site": "loop.block_end", "hit": 2}
+    crashpoint.arm(None)
+    assert crashpoint.armed() is None
+
+
+def test_hit_rejects_unregistered_site_and_noops_unarmed():
+    with pytest.raises(KeyError, match="unregistered crashpoint"):
+        crashpoint.hit("definitely.not.a.site")
+    # unarmed: every registered site is a no-op
+    for site in crashpoint.SITES:
+        crashpoint.hit(site)
+    # armed at another site: still a no-op here
+    crashpoint.arm("ckpt.mid_swap")
+    crashpoint.hit("loop.block_end")
+
+
+def test_every_crashpoint_instrumented_exactly_once():
+    """Tier-1 lint: each registered site name appears at EXACTLY one
+    `crashpoint.hit("<name>")` call in the package — a dead site would
+    hollow out the crash matrix silently, a duplicate would make "kill
+    at site X" ambiguous — and every hit() call in the package uses a
+    string literal naming a registered site (the lint can only count
+    what it can read)."""
+    sources = {}
+    for path in glob.glob(os.path.join(PKG, "**", "*.py"), recursive=True):
+        if os.path.basename(path) == "crashpoint.py":
+            continue
+        with open(path) as f:
+            sources[os.path.relpath(path, PKG)] = f.read()
+
+    call_re = re.compile(r"crashpoint\.hit\(\s*(.)")
+    name_re = re.compile(r'crashpoint\.hit\(\s*"([^"]+)"')
+    used = {}
+    for rel, src in sources.items():
+        for m in call_re.finditer(src):
+            assert m.group(1) == '"', (
+                f"{rel}: crashpoint.hit() must take a string literal "
+                "(the instrumentation lint counts literal sites)"
+            )
+        for name in name_re.findall(src):
+            used.setdefault(name, []).append(rel)
+
+    unregistered = set(used) - set(crashpoint.SITES)
+    assert not unregistered, (
+        f"unregistered crashpoint names instrumented: {unregistered}"
+    )
+    dead = set(crashpoint.SITES) - set(used)
+    assert not dead, (
+        f"registered crashpoints with NO instrumented site: {dead}"
+    )
+    dupes = {n: fs for n, fs in used.items() if len(fs) > 1}
+    assert not dupes, (
+        f"crashpoints instrumented at more than one site: {dupes}"
+    )
+
+
+def test_marker_write_and_consume(tmp_path):
+    d = str(tmp_path)
+    assert crashpoint.consume_marker(d) is None
+    assert crashpoint.consume_marker(None) is None
+    path = crashpoint.write_marker(d, {"reason": "signal:SIGTERM", "epoch": 3})
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert json.load(f)["epoch"] == 3
+    info = crashpoint.consume_marker(d)
+    assert info["reason"] == "signal:SIGTERM"
+    assert not os.path.exists(path)  # consumed exactly once
+    assert crashpoint.consume_marker(d) is None
+    # a torn marker is still removed (a half-written witness must not
+    # wedge every future startup)
+    with open(path, "w") as f:
+        f.write("{truncated")
+    assert crashpoint.consume_marker(d) is None
+    assert not os.path.exists(path)
+
+
+def test_preempt_clause_round_trips():
+    s = ChaosSchedule.parse("drop=0,seed=3,preempt=4@2,preempt=9")
+    assert s.preempt == ((4, 2), (9, 1))  # bare E means step 1, sorted
+    assert ChaosSchedule.parse(s.to_spec()) == s
+    assert ChaosSchedule.from_dict(s.to_dict()) == s
+    assert not s.is_noop  # a preemption notice IS an event
+    assert "preempt" not in ChaosSchedule().to_dict()  # legacy unchanged
+    with pytest.raises(ValueError, match="preempt"):
+        ChaosSchedule.parse("preempt=0@1")
+
+
+# --- graceful preemption (train-level) --------------------------------------
+
+
+def test_scheduled_preempt_drains_marks_and_resumes_bitwise(tmp_path):
+    """The `preempt=E@S` clause drains at the enclosing block boundary:
+    boundary snapshot + PREEMPTED marker on disk, GracefulPreemption
+    raised; the resume ignores the consumed notice and lands on the
+    never-preempted trajectory bitwise — preemption lost nothing."""
+    x, y = _data()
+    kw = _train_kw()
+    base_state, base_hist = train(
+        MLP(hidden=8), Ring(4), x, y, chaos="drop=0,seed=1", **kw
+    )
+    ck = str(tmp_path / "ck")
+    with pytest.raises(GracefulPreemption) as ei:
+        train(
+            MLP(hidden=8), Ring(4), x, y, checkpoint_dir=ck, save_every=2,
+            chaos="drop=0,seed=1,preempt=2@1", **kw
+        )
+    info = ei.value.info
+    assert info["reason"] == "schedule:2@1" and info["epoch"] == 2
+    assert info["snapshot"] is True
+    assert os.path.exists(os.path.join(ck, "PREEMPTED"))
+    # the drained snapshot is the boundary state (nothing past it ran)
+    raw = checkpoint.peek(checkpoint.latest(os.path.join(ck, "ckpt")))
+    assert int(np.asarray(raw["epoch"])) == 2
+
+    st, hist = train(
+        MLP(hidden=8), Ring(4), x, y, checkpoint_dir=ck, save_every=2,
+        resume=True, chaos="drop=0,seed=1,preempt=2@1", **kw
+    )
+    assert not os.path.exists(os.path.join(ck, "PREEMPTED"))  # consumed
+    assert [h["epoch"] for h in hist] == [3, 4]  # zero recomputed epochs
+    _assert_params_equal(base_state, st)
+    by_epoch = {r["epoch"]: r for r in base_hist}
+    for r in hist:  # history parity, value for value
+        assert r["loss"] == by_epoch[r["epoch"]]["loss"]
+        assert r["num_events"] == by_epoch[r["epoch"]]["num_events"]
+
+
+def test_sigterm_drains_at_next_boundary_and_resumes_bitwise(tmp_path):
+    """A real SIGTERM mid-run: the handler only sets a flag, the loop
+    drains at its next block boundary (pipeline drained, writer joined,
+    force-snapshot, marker), raises GracefulPreemption, and RESTORES
+    the previous signal disposition; the resume is bitwise."""
+    x, y = _data()
+    kw = _train_kw()
+    base_state, _ = train(MLP(hidden=8), Ring(4), x, y, **kw)
+    ck = str(tmp_path / "ck")
+
+    def deliver(rec):
+        if rec.get("epoch") == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    before = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(GracefulPreemption) as ei:
+        train(
+            MLP(hidden=8), Ring(4), x, y, checkpoint_dir=ck, save_every=2,
+            pipeline=True, on_epoch=deliver, **kw
+        )
+    assert ei.value.info["reason"] == "signal:SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) == before  # handler restored
+    assert os.path.exists(os.path.join(ck, "PREEMPTED"))
+
+    st, hist = train(
+        MLP(hidden=8), Ring(4), x, y, checkpoint_dir=ck, save_every=2,
+        resume=True, **kw
+    )
+    # <= 1 dispatch block of recomputation (here: zero — the drain
+    # snapshots the boundary the signal was noticed at)
+    assert [h["epoch"] for h in hist] == [3, 4]
+    _assert_params_equal(base_state, st)
+
+
+def test_unarmed_run_is_today_bitwise_and_armed_rider_stamps(tmp_path):
+    """off == absent: with no crashpoint armed and no signal delivered
+    the state and history carry no new fields and match a run made
+    before this engine existed (the baseline twin here); arming a site
+    whose hit count never fires stamps the `crashpoint` rider on record
+    1 and changes nothing else."""
+    x, y = _data()
+    kw = _train_kw()
+    st0, h0 = train(MLP(hidden=8), Ring(4), x, y, **kw)
+    assert all("crashpoint" not in r and "preempt" not in r for r in h0)
+
+    crashpoint.arm("loop.block_end:999")  # never reached in 4 blocks
+    st1, h1 = train(MLP(hidden=8), Ring(4), x, y, **kw)
+    crashpoint.arm(None)
+    assert h1[0]["crashpoint"] == {"site": "loop.block_end", "hit": 999}
+    _assert_params_equal(st0, st1)
+    for r0, r1 in zip(h0, h1):
+        assert r0["loss"] == r1["loss"]
+        assert r0["num_events"] == r1["num_events"]
+
+
+# --- one subprocess crash-matrix cell ---------------------------------------
+
+
+def _cli_cmd(tmp, tag, extra):
+    return [
+        sys.executable, "-m", "eventgrad_tpu.cli",
+        "--algo", "eventgrad", "--mesh", "ring:4", "--dataset",
+        "synthetic", "--model", "mlp", "--epochs", "4", "--batch-size",
+        "8", "--n-synth", "128", "--warmup-passes", "2", "--lr", "0.1",
+        "--save-every", "2",
+        "--log-file", os.path.join(tmp, f"{tag}.jsonl"),
+    ] + extra
+
+
+def _run_cli(tmp, tag, extra, crash=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "EG_CRASHPOINT")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if crash:
+        env["EG_CRASHPOINT"] = crash
+    return subprocess.run(
+        _cli_cmd(tmp, tag, extra), cwd=REPO, env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+
+
+def test_subprocess_kill_at_mid_swap_resumes_bitwise(tmp_path):
+    """One full crash-matrix cell at the atomic swap's worst instant
+    (old snapshot demoted, new one not yet promoted): the kill exits
+    CRASHPOINT_EXIT, leaves only the .prev twin, and the resume
+    reproduces the uninterrupted final metrics exactly. The full
+    site x config matrix is tools/crash_matrix.py -> the committed
+    artifacts/crash_matrix_cpu.json."""
+    tmp = str(tmp_path)
+    ck = os.path.join(tmp, "ck")
+    base = _run_cli(
+        tmp, "base", ["--checkpoint-dir", os.path.join(tmp, "ck0")]
+    )
+    assert base.returncode == 0, base.stderr[-2000:]
+
+    killed = _run_cli(
+        tmp, "crash", ["--checkpoint-dir", ck], crash="ckpt.mid_swap"
+    )
+    assert killed.returncode == exitcodes.CRASHPOINT_EXIT, (
+        killed.stderr[-2000:]
+    )
+    assert "crashpoint ckpt.mid_swap hit 1" in killed.stderr
+    # the worst-instant kill left the demoted twin as the newest
+    # complete snapshot
+    assert checkpoint.latest(os.path.join(ck, "ckpt")).endswith(".prev")
+    # the killed run's log names the armed site (rider on record 1)
+    with open(os.path.join(tmp, "crash.jsonl")) as f:
+        first = next(
+            json.loads(line) for line in f if "epoch" in json.loads(line)
+        )
+    assert first["crashpoint"] == {"site": "ckpt.mid_swap", "hit": 1}
+
+    resumed = _run_cli(
+        tmp, "resume", ["--checkpoint-dir", ck, "--resume"]
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+
+    def final(tag):
+        with open(os.path.join(tmp, f"{tag}.jsonl")) as f:
+            return next(
+                r for r in map(json.loads, f) if r.get("final")
+            )
+
+    fb, fr = final("base"), final("resume")
+    assert fb["accuracy"] == fr["accuracy"]
+    assert fb["loss"] == fr["loss"]

@@ -30,7 +30,7 @@ _TIMING_KEYS = {"wall_s"}
 
 
 def _run(algo="eventgrad", obs="off", pipeline=None, ck=None, resume=False,
-         epochs=6, mesh=None, **kw):
+         epochs=6, mesh=None, epochs_per_dispatch=2, **kw):
     x, y = synthetic_dataset(256, (8, 8, 1), seed=3)
     xt, yt = synthetic_dataset(64, (8, 8, 1), seed=3, split="test")
     cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=3)
@@ -39,7 +39,8 @@ def _run(algo="eventgrad", obs="off", pipeline=None, ck=None, resume=False,
         algo=algo, epochs=epochs, batch_size=8, learning_rate=0.05,
         event_cfg=cfg if algo != "dpsgd" else None,
         random_sampler=True, seed=5, x_test=xt, y_test=yt,
-        epochs_per_dispatch=2, obs=obs, pipeline=pipeline, mesh=mesh,
+        epochs_per_dispatch=epochs_per_dispatch, obs=obs,
+        pipeline=pipeline, mesh=mesh,
         checkpoint_dir=str(ck) if ck else None,
         save_every=2 if ck else 0, resume=resume, **kw,
     )
@@ -112,6 +113,86 @@ def test_resume_mid_pipeline_matches_uninterrupted(tmp_path):
         jax.tree.leaves(full[0].params), jax.tree.leaves(res[0].params)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+#: per-block bookkeeping keys that legitimately differ between a
+#: resumed run and its uninterrupted twin (block indices restart; the
+#: resumed first block pays its own compile)
+_RESUME_KEYS = _TIMING_KEYS | {"dispatch_block", "dispatch_cold"}
+
+
+def _assert_resumed_records_match(full_hist, resumed_hist):
+    by_epoch = {r["epoch"]: r for r in full_hist}
+    for r in resumed_hist:
+        ref = by_epoch[r["epoch"]]
+        _assert_value_equal(
+            {k: v for k, v in r.items() if k not in _RESUME_KEYS},
+            {k: v for k, v in ref.items() if k not in _RESUME_KEYS},
+            path=f"epoch{r['epoch']}",
+        )
+
+
+def test_resume_reproduces_pipelined_eval_history_bitwise(tmp_path):
+    """Resume-under-pipeline edge (ISSUE 8 satellite): with one-epoch
+    blocks, block N's eval readback drains one block late by design, so
+    the epoch-4 snapshot is written while an eval future is pending.
+    Resuming from it must reproduce the uninterrupted run's eval
+    history — test_accuracy/test_loss and every other record value —
+    bitwise, not approximately."""
+    full = _run(pipeline=True, ck=tmp_path / "a", epochs=6,
+                epochs_per_dispatch=1)
+    ck = tmp_path / "b"
+    _run(pipeline=True, ck=ck, epochs=4, epochs_per_dispatch=1)
+    res = _run(pipeline=True, ck=ck, epochs=6, resume=True,
+               epochs_per_dispatch=1)
+    assert [h["epoch"] for h in res[1]] == [5, 6]
+    # K=1 evaluates at every block end: both resumed records carry eval
+    assert all("test_accuracy" in r for r in res[1])
+    _assert_resumed_records_match(full[1], res[1])
+    for a, b in zip(jax.tree.leaves(full[0]), jax.tree.leaves(res[0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@requires_shard_map
+def test_resume_pipelined_eval_history_shard_map(tmp_path):
+    """The resume-under-pipeline eval edge is lift-agnostic: the
+    shard_map-lifted run reproduces its uninterrupted eval history
+    bitwise too."""
+    from eventgrad_tpu.parallel.spmd import build_mesh
+
+    mesh = build_mesh(Ring(4))
+    full = _run(pipeline=True, ck=tmp_path / "a", epochs=4, mesh=mesh,
+                epochs_per_dispatch=1)
+    ck = tmp_path / "b"
+    _run(pipeline=True, ck=ck, epochs=2, mesh=mesh, epochs_per_dispatch=1)
+    res = _run(pipeline=True, ck=ck, epochs=4, resume=True, mesh=mesh,
+               epochs_per_dispatch=1)
+    assert [h["epoch"] for h in res[1]] == [3, 4]
+    _assert_resumed_records_match(full[1], res[1])
+
+
+def test_interrupt_mid_run_joins_writer_and_leaves_complete_snapshot(
+    tmp_path,
+):
+    """AsyncWriter interrupt barrier (ISSUE 8 satellite): a
+    KeyboardInterrupt raised inside the training loop (the user's ^C)
+    unwinds through the join barrier, so a partially-serialized
+    snapshot can never be the newest file — the latest snapshot loads
+    completely and the run resumes from it."""
+    ck = tmp_path / "ck"
+
+    def interrupt(rec):
+        if rec.get("epoch") == 4:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        _run(pipeline=True, ck=ck, epochs=6, on_epoch=interrupt)
+    found = checkpoint.latest(str(ck / "ckpt"))
+    assert found is not None
+    raw = checkpoint.peek(found)  # a torn write would fail this loudly
+    assert int(np.asarray(raw["epoch"])) in (2, 4)
+    res = _run(pipeline=True, ck=ck, epochs=6, resume=True)
+    assert res[1][-1]["epoch"] == 6
 
 
 def test_pipeline_rejects_fault_inject_and_auto_disables():

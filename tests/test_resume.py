@@ -74,6 +74,49 @@ def test_interrupted_save_falls_back_to_prev(tmp_path):
     assert [h["epoch"] for h in hist_res] == [3, 4]
 
 
+def test_corrupt_primary_resume_recovers_from_prev_loudly(tmp_path):
+    """peek/load .prev auto-fallback (ISSUE 8 satellite): a TRUNCATED
+    primary snapshot with a complete demoted twin resumes from the twin
+    with a loud RuntimeWarning instead of failing the service; with the
+    twin also corrupt, the resume fails loudly naming both paths."""
+    import os
+    import shutil
+
+    import pytest
+
+    from eventgrad_tpu.utils import checkpoint
+
+    def corrupt(tree):
+        # the promoted name pointing at zero-length files (a torn write)
+        for dirpath, _, files in os.walk(tree):
+            for f in files:
+                open(os.path.join(dirpath, f), "w").close()
+
+    state_full, _ = _run(None, epochs=4, resume=False)
+    ck = tmp_path / "ck"
+    _run(ck, epochs=2, resume=False)
+    path = os.path.join(str(ck), "ckpt")
+    # a complete twin of the epoch-2 snapshot, then a torn primary
+    shutil.copytree(path, path + ".prev")
+    corrupt(path)
+
+    # both-corrupt leg first (the successful recovery below overwrites
+    # the scenario when its epoch-4 save prunes the .prev)
+    ck2 = tmp_path / "ck2"
+    shutil.copytree(str(ck), str(ck2))
+    corrupt(os.path.join(str(ck2), "ckpt.prev"))
+    with pytest.raises(RuntimeError, match="both unreadable"):
+        _run(ck2, epochs=4, resume=True)
+
+    with pytest.warns(RuntimeWarning, match="RECOVERED"):
+        state_res, hist_res = _run(ck, epochs=4, resume=True)
+    assert [h["epoch"] for h in hist_res] == [3, 4]
+    for a, b in zip(
+        jax.tree.leaves(state_full.params), jax.tree.leaves(state_res.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_hybrid_lm_resume_matches_uninterrupted(tmp_path):
     """Hybrid meshes persist too: an EventGraD dp x sp ring-attention LM run
     interrupted at epoch 2 and resumed matches the straight 4-epoch run."""

@@ -242,6 +242,40 @@ def test_supervise_integrity_abort_gives_up_without_restart(monkeypatch):
     assert sleeps == []
 
 
+def test_supervise_preemption_relaunches_without_budget_charge(monkeypatch):
+    """Exit 75 (PREEMPTED_EXIT) is a CLEAN preemption: the child
+    drained, snapshotted, and exited on purpose, so the supervisor must
+    relaunch immediately with --resume, sleep no backoff, and charge
+    nothing — a spot service preempted more often than max_restarts
+    must keep running forever. The constant stays pinned to the
+    jax-free exit-code contract module."""
+    from eventgrad_tpu import exitcodes
+    from eventgrad_tpu import supervise as sup
+
+    assert sup.PREEMPTED_EXIT == exitcodes.PREEMPTED_EXIT == 75
+    # 4 preemptions against max_restarts=0: every one relaunches anyway
+    rc, launches, sleeps = _run_fake_supervise(
+        monkeypatch, [75, 75, 75, 75, 0], max_restarts=0,
+        backoff_base=1.0, backoff_jitter=0.0,
+    )
+    assert rc == 0 and len(launches) == 5
+    assert sleeps == []  # no backoff between preemption relaunches
+    assert all("--resume" in cmd for cmd in launches[1:])
+
+
+def test_supervise_preemption_resets_crash_backoff(monkeypatch):
+    """A preemption between crashes resets the consecutive-failure
+    exponent: the relaunch after the post-preemption crash backs off
+    from the base again instead of continuing the doubling."""
+    rc, launches, sleeps = _run_fake_supervise(
+        monkeypatch, [7, 7, 75, 7, 0], max_restarts=5,
+        backoff_base=0.5, backoff_max=8.0, backoff_jitter=0.0,
+    )
+    assert rc == 0 and len(launches) == 5
+    # crash, crash (doubled), preemption (no sleep), crash (reset to base)
+    assert sleeps == [0.5, 1.0, 0.5]
+
+
 def test_crash_recovery_hybrid_lm(tmp_path):
     """Elastic recovery composes with hybrid meshes: a dp x sp
     ring-attention LM run crash-injected after epoch 1 is restarted from

@@ -78,12 +78,43 @@ def test_peek_corrupted_checkpoint_fails_loudly():
             msg = str(e)
             assert path in msg  # the offending path
             assert "last-known-good" in msg  # the recovery option
-        # with a demoted .prev twin present, the hint points there
+        # with a COMPLETE demoted .prev twin present, peek auto-recovers
+        # from it instead of only hinting — LOUDLY (RuntimeWarning
+        # naming both paths), and the recovered payload is the twin's
         checkpoint.save(path + ".prev", payload)
+        with pytest.warns(RuntimeWarning, match="RECOVERED"):
+            got = checkpoint.peek(path)
+        assert int(got["epoch"]) == 3
+        # the corrupt primary was SIDELINED, not left in place: the
+        # next save must never demote the corrupt tree over the good
+        # twin (a kill inside that swap would strand the run), and a
+        # kill before that save still resumes from the intact twin
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".corrupt")
+        assert checkpoint.latest(path) == path + ".prev"
+        assert int(checkpoint.peek(path + ".prev")["epoch"]) == 3
+        # the warning names the corrupt primary and the twin it used
+        with pytest.warns(RuntimeWarning) as rec:
+            checkpoint.peek(path)
+        assert path in str(rec[0].message)
+        assert path + ".prev" in str(rec[0].message)
+        # both sides corrupt: loud failure naming BOTH paths and the
+        # remaining options — never a half-restore
+        for dirpath, _, files in os.walk(path + ".prev"):
+            for f in files:
+                open(os.path.join(dirpath, f), "w").close()
+        with pytest.raises(RuntimeError, match="both unreadable"):
+            checkpoint.peek(path)
         try:
             checkpoint.peek(path)
         except RuntimeError as e:
-            assert ".prev" in str(e) and "pass it instead" in str(e)
+            assert path in str(e) and path + ".prev" in str(e)
+            assert "last-known-good" in str(e)
+        # and after all that wreckage a fresh save still commits a
+        # clean primary (the sidelined .corrupt tree never interferes)
+        checkpoint.save(path, {"a": np.arange(5.0), "epoch": np.int64(4)})
+        assert checkpoint.latest(path) == path
+        assert int(checkpoint.peek(path)["epoch"]) == 4
 
 
 def test_rolling_retention_never_deletes_only_validated_snapshot():

@@ -1,0 +1,513 @@
+"""Crash-consistency matrix: kill at every seam, resume, prove parity.
+
+The preemption & crash-consistency acceptance run (ISSUE 8): every
+registered crashpoint (chaos/crashpoint.py SITES — the checkpoint
+swap's three instants, the async writer thread, both dispatch-block
+boundaries, the membership bootstrap stream, the integrity
+rollback-restore) is armed under every configuration whose durability
+machinery differs (flat arena on/off x dispatch pipeline on/off x
+elastic membership x integrity rollback), the child is KILLED there
+(`os._exit`, no unwind — the honest model of SIGKILL/power loss),
+relaunched with `--resume`, and the recovered run must reproduce the
+uninterrupted run's final snapshot BITWISE and its per-epoch history
+value-for-value. Three verdicts per cell, measured not assumed
+(arXiv:1711.00705's discipline):
+
+  * crashed   — the child died at the armed site with CRASHPOINT_EXIT
+                (an unfired site would read as "survived" vacuously);
+  * resumed   — the relaunch found a loadable snapshot and completed;
+  * parity    — final state bitwise vs the uninterrupted twin, history
+                records value-equal epoch-for-epoch, and the recomputed
+                epochs bounded by one --save-every interval.
+
+Plus the GRACEFUL preemption legs: a scheduled `preempt=E@S` notice and
+a real SIGTERM, each expected to exit PREEMPTED_EXIT, leave a PREEMPTED
+marker next to a boundary snapshot, and lose at most ONE dispatch block
+(measured as re-computed epochs in the resumed log — the ISSUE 8 bound;
+with the boundary force-snapshot it is zero).
+
+Output: artifacts/crash_matrix_<platform>.json, validated against
+`tools/validate_artifacts.CRASH_MATRIX_SCHEMA` (tier-1 gated by
+tests/test_artifacts.py: zero unresumable cells, zero silent data loss,
+preemption within the one-block bound).
+
+Usage:
+    python tools/crash_matrix.py [--smoke] [--out artifacts/...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+# CPU proxy by design (the artifact is crash_matrix_cpu.json): pin THIS
+# process and every child to the CPU backend, and make the package
+# importable from the children regardless of install state
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PYTHONPATH"] = (
+    _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+).rstrip(os.pathsep)
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+compile_cache.enable()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from eventgrad_tpu.exitcodes import (  # noqa: E402
+    CRASHPOINT_EXIT, PREEMPTED_EXIT,
+)
+
+#: one shared op point: 4-rank ring MLP, 6 epochs x 6 steps, snapshots
+#: every 2 epochs — small enough that ~60 child runs stay in minutes,
+#: structured enough that every seam (async writer, bootstrap stream,
+#: retention, rollback) actually executes
+_OP = dict(
+    ranks=4, epochs=6, n_synth=192, batch=8, save_every=2, seed=0,
+)
+
+#: history keys compared value-for-value between the recovered and the
+#: uninterrupted log (host-timing and block-bookkeeping keys differ by
+#: construction: wall_s, dispatch_block/cold, riders)
+_VALUE_KEYS = (
+    "loss", "train_acc", "num_events", "num_deferred", "msgs_saved_pct",
+    "fired_frac", "sent_bytes_per_step_per_chip",
+    "sent_bytes_wire_real_per_step_per_chip", "active_ranks",
+    "wire_rejects", "quarantined_steps", "integrity_rollbacks",
+)
+
+#: the ckpt.*/loop.* sites fire in every configuration; the other three
+#: only where their subsystem runs
+_COMMON_SITES = {
+    # hit 2 = the epoch-4 save / the second block: mid-run progress
+    # exists on both sides of the kill
+    "ckpt.tmp_written": 2,
+    "ckpt.mid_swap": 1,     # first demotion = save #2 (epoch 4)
+    "ckpt.post_promote": 2,
+    "loop.block_dispatched": 2,
+    "loop.block_end": 2,
+}
+
+#: config name -> (extra CLI flags, {site: hit_n})
+_CONFIGS: Dict[str, Tuple[List[str], Dict[str, int]]] = {
+    "arena_pipe": (
+        ["--arena", "on", "--pipeline", "on"],
+        {**_COMMON_SITES, "writer.bg_save": 2},
+    ),
+    "tree_pipe": (
+        ["--arena", "off", "--pipeline", "on"],
+        {**_COMMON_SITES, "writer.bg_save": 2},
+    ),
+    "arena_serial": (
+        ["--arena", "on", "--pipeline", "off"],
+        dict(_COMMON_SITES),
+    ),
+    "membership": (
+        # leave at 2, join at 4: the join streams a neighbor snapshot
+        # through the bootstrap path mid-matrix
+        ["--membership", "leave=1@2,join=1@4"],
+        {**_COMMON_SITES, "membership.bootstrap": 1},
+    ),
+    "integrity": (
+        # quarantine OFF so the seeded nanstep LANDS (epoch 3, pass 14),
+        # trips the sentinel, and exercises the rollback-restore;
+        # escalate hardens the replay so it converges
+        ["--integrity",
+         "checksum=0,quarantine=0,sentinel=1,rollback=1,escalate=1,"
+         "max_rollbacks=1",
+         "--chaos", "drop=0,seed=3,nanstep=1@14"],
+        {**_COMMON_SITES, "integrity.rollback": 1},
+    ),
+}
+
+_SMOKE_CONFIGS = ("arena_pipe", "membership")
+
+
+def _cli(tmp: str, tag: str, extra: List[str]) -> List[str]:
+    return [
+        sys.executable, "-m", "eventgrad_tpu.cli",
+        "--algo", "eventgrad", "--mesh", f"ring:{_OP['ranks']}",
+        "--dataset", "synthetic", "--model", "mlp",
+        "--epochs", str(_OP["epochs"]), "--batch-size", str(_OP["batch"]),
+        "--n-synth", str(_OP["n_synth"]), "--warmup-passes", "2",
+        "--max-silence", "8", "--lr", "0.1", "--seed", str(_OP["seed"]),
+        "--save-every", str(_OP["save_every"]),
+        "--log-file", os.path.join(tmp, f"{tag}.jsonl"),
+    ] + extra
+
+
+def _run_child(
+    tmp: str, tag: str, extra: List[str],
+    crashpoint: Optional[str] = None, timeout: float = 300.0,
+) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("EG_CRASHPOINT", None)
+    if crashpoint:
+        env["EG_CRASHPOINT"] = crashpoint
+    return subprocess.run(
+        _cli(tmp, tag, extra), env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def _records(tmp: str, *tags: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for tag in tags:
+        path = os.path.join(tmp, f"{tag}.jsonl")
+        if os.path.exists(path):
+            with open(path) as f:
+                out += [json.loads(line) for line in f if line.strip()]
+    return out
+
+
+def _epoch_recs(recs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Training epoch records only: the terminal `preempted` record
+    carries an epoch too (the drained boundary) but no metrics."""
+    return [r for r in recs if "epoch" in r and "loss" in r]
+
+
+def _by_epoch(recs: List[Dict[str, Any]]) -> Dict[int, Dict[str, Any]]:
+    """Last record per epoch — an integrity replay (and a resumed
+    attempt) legitimately re-emits an epoch; the final word must match."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for r in _epoch_recs(recs):
+        out[int(r["epoch"])] = r
+    return out
+
+
+def _history_equal(
+    ref: List[Dict[str, Any]], got: List[Dict[str, Any]]
+) -> Tuple[bool, str]:
+    a, b = _by_epoch(ref), _by_epoch(got)
+    if set(a) != set(b):
+        return False, f"epoch sets differ: {sorted(set(a) ^ set(b))}"
+    for e in sorted(a):
+        for k in _VALUE_KEYS:
+            if (k in a[e]) != (k in b[e]):
+                return False, f"epoch {e}: key {k} presence differs"
+            if k in a[e] and a[e][k] != b[e][k]:
+                return False, f"epoch {e}: {k} {a[e][k]!r} != {b[e][k]!r}"
+    return True, ""
+
+
+def _final_state_equal(ck_ref: str, ck_got: str) -> bool:
+    from eventgrad_tpu.utils import checkpoint
+
+    ref = checkpoint.peek(checkpoint.latest(os.path.join(ck_ref, "ckpt")))
+    got = checkpoint.peek(checkpoint.latest(os.path.join(ck_got, "ckpt")))
+    if int(np.asarray(ref["epoch"])) != int(np.asarray(got["epoch"])):
+        return False
+    ra, rb = jax.tree.leaves(ref["state"]), jax.tree.leaves(got["state"])
+    return len(ra) == len(rb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(ra, rb)
+    )
+
+
+def _lost_epochs(
+    first_recs: List[Dict[str, Any]], resume_recs: List[Dict[str, Any]]
+) -> int:
+    """Epochs the recovery RECOMPUTED: logged by the killed attempt and
+    logged again by the resume (zero when the kill landed at/behind the
+    newest snapshot)."""
+    a = {int(r["epoch"]) for r in _epoch_recs(first_recs)}
+    b = {int(r["epoch"]) for r in _epoch_recs(resume_recs)}
+    return len(a & b)
+
+
+def _crash_cell(
+    workdir: str, config: str, extra: List[str], site: str, hit: int,
+    baseline_recs: List[Dict[str, Any]], ck_base: str,
+) -> Dict[str, Any]:
+    tmp = os.path.join(workdir, f"{config}--{site.replace('.', '_')}")
+    os.makedirs(tmp, exist_ok=True)
+    ck = os.path.join(tmp, "ck")
+    flags = extra + ["--checkpoint-dir", ck]
+    cell: Dict[str, Any] = {
+        "config": config, "site": site, "hit": hit,
+        "crashed": False, "resumed": False,
+        "final_state_bitwise": False, "history_bitwise": False,
+        "lost_epochs": -1,
+    }
+    killed = _run_child(tmp, "crash", flags, crashpoint=f"{site}:{hit}")
+    cell["crash_exit"] = killed.returncode
+    if killed.returncode != CRASHPOINT_EXIT or (
+        f"crashpoint {site} hit" not in killed.stderr
+    ):
+        cell["error"] = (
+            f"kill did not land: rc={killed.returncode} "
+            f"stderr={killed.stderr[-500:]}"
+        )
+        return cell
+    cell["crashed"] = True
+    resumed = _run_child(tmp, "resume", flags + ["--resume"])
+    if resumed.returncode != 0:
+        cell["error"] = (
+            f"resume failed: rc={resumed.returncode} "
+            f"stderr={resumed.stderr[-500:]}"
+        )
+        return cell
+    cell["resumed"] = True
+    crash_recs = _records(tmp, "crash")
+    resume_recs = _records(tmp, "resume")
+    cell["lost_epochs"] = _lost_epochs(crash_recs, resume_recs)
+    ok, why = _history_equal(baseline_recs, crash_recs + resume_recs)
+    cell["history_bitwise"] = ok
+    if not ok:
+        cell["error"] = f"history: {why}"
+    cell["final_state_bitwise"] = _final_state_equal(ck_base, ck)
+    if not cell["final_state_bitwise"]:
+        cell.setdefault("error", "final snapshot differs")
+    return cell
+
+
+def _preempt_cell(
+    workdir: str, kind: str, extra: List[str],
+    baseline_recs: List[Dict[str, Any]], ck_base: str,
+) -> Dict[str, Any]:
+    """One graceful-preemption leg: scheduled notice or a real SIGTERM.
+    Expected: exit PREEMPTED_EXIT, PREEMPTED marker next to a boundary
+    snapshot, resume bitwise, recomputed work <= one dispatch block
+    (with one-epoch blocks: <= 1 epoch; the boundary snapshot makes it
+    0)."""
+    tmp = os.path.join(workdir, f"preempt--{kind}")
+    os.makedirs(tmp, exist_ok=True)
+    ck = os.path.join(tmp, "ck")
+    flags = extra + ["--checkpoint-dir", ck]
+    cell: Dict[str, Any] = {
+        "kind": kind, "exit": None, "marker": False,
+        "final_state_bitwise": False, "history_bitwise": False,
+        "lost_blocks": -1,
+    }
+    env = dict(os.environ)
+    env.pop("EG_CRASHPOINT", None)
+    if kind == "schedule":
+        proc = subprocess.run(
+            _cli(tmp, "preempt", flags), env=env, capture_output=True,
+            text=True, timeout=300,
+        )
+        rc = proc.returncode
+    else:  # kind == "signal": SIGTERM once training visibly progresses
+        log = os.path.join(tmp, "preempt.jsonl")
+        # stderr to a FILE, not a pipe: nobody drains a pipe while the
+        # child runs, and a chatty child blocking on a full pipe buffer
+        # would never reach the block boundary the SIGTERM drains at
+        stderr_f = open(os.path.join(tmp, "preempt.stderr"), "w")
+        child = subprocess.Popen(
+            _cli(tmp, "preempt", flags), env=env,
+            stdout=subprocess.DEVNULL, stderr=stderr_f, text=True,
+        )
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if os.path.exists(log) and any(
+                "epoch" in r for r in _records(tmp, "preempt")
+            ):
+                break
+            if child.poll() is not None:
+                break
+            time.sleep(0.2)
+        if child.poll() is None:
+            child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=120)
+        stderr_f.close()
+    cell["exit"] = rc
+    if rc != PREEMPTED_EXIT:
+        cell["error"] = f"expected exit {PREEMPTED_EXIT}, got {rc}"
+        return cell
+    cell["marker"] = os.path.exists(os.path.join(ck, "PREEMPTED"))
+    first_recs = _records(tmp, "preempt")
+    pre = next((r for r in first_recs if r.get("preempted")), None)
+    if pre is not None:
+        cell["reason"] = pre.get("reason")
+        cell["drain_epoch"] = pre.get("epoch")
+        cell["drain_s"] = pre.get("drain_s")
+    resumed = _run_child(tmp, "resume", flags + ["--resume"])
+    if resumed.returncode != 0:
+        cell["error"] = f"resume failed: rc={resumed.returncode}"
+        return cell
+    resume_recs = _records(tmp, "resume")
+    # one-epoch dispatch blocks at this op point: recomputed epochs ARE
+    # recomputed blocks
+    cell["lost_blocks"] = _lost_epochs(first_recs, resume_recs)
+    ok, why = _history_equal(baseline_recs, first_recs + resume_recs)
+    cell["history_bitwise"] = ok
+    cell["final_state_bitwise"] = _final_state_equal(ck_base, ck)
+    if not ok:
+        cell["error"] = f"history: {why}"
+    elif not cell["final_state_bitwise"]:
+        cell["error"] = "final snapshot differs"
+    return cell
+
+
+def run_matrix(
+    out_path: str, smoke: bool = False, workdir: Optional[str] = None,
+) -> Dict[str, Any]:
+    import tempfile
+
+    t_start = time.perf_counter()
+    configs = {
+        k: v for k, v in _CONFIGS.items()
+        if not smoke or k in _SMOKE_CONFIGS
+    }
+    ctx = tempfile.TemporaryDirectory() if workdir is None else None
+    root = workdir if workdir is not None else ctx.name
+    os.makedirs(root, exist_ok=True)
+    cells: List[Dict[str, Any]] = []
+    preempt_cells: List[Dict[str, Any]] = []
+    try:
+        baselines: Dict[str, Tuple[List[Dict[str, Any]], str]] = {}
+        for config, (extra, _sites) in configs.items():
+            tmp = os.path.join(root, f"{config}--base")
+            os.makedirs(tmp, exist_ok=True)
+            ck = os.path.join(tmp, "ck")
+            base = _run_child(
+                tmp, "base", extra + ["--checkpoint-dir", ck]
+            )
+            if base.returncode != 0:
+                raise RuntimeError(
+                    f"uninterrupted {config} baseline failed: "
+                    f"{base.stderr[-1000:]}"
+                )
+            baselines[config] = (_records(tmp, "base"), ck)
+            print(f"[baseline] {config}: ok", flush=True)
+
+        for config, (extra, sites) in configs.items():
+            base_recs, ck_base = baselines[config]
+            for site, hit in sites.items():
+                cell = _crash_cell(
+                    root, config, extra, site, hit, base_recs, ck_base
+                )
+                cells.append(cell)
+                verdict = "OK" if (
+                    cell["crashed"] and cell["resumed"]
+                    and cell["final_state_bitwise"]
+                    and cell["history_bitwise"]
+                ) else f"FAIL ({cell.get('error')})"
+                print(
+                    f"[cell] {config} x {site}:{hit} -> {verdict} "
+                    f"(lost {cell['lost_epochs']} epochs)", flush=True,
+                )
+
+        # graceful preemption legs ride the pipeline-on arena config;
+        # the scheduled leg needs a chaos rider in BOTH legs (the chaos
+        # state is part of the traced step), so it gets its own baseline
+        if "arena_pipe" in configs:
+            extra = configs["arena_pipe"][0]
+            sched_extra = extra + ["--chaos", "drop=0,seed=7,preempt=3@2"]
+            sched_base_extra = extra + ["--chaos", "drop=0,seed=7"]
+            tmpb = os.path.join(root, "preempt--base")
+            os.makedirs(tmpb, exist_ok=True)
+            ckb = os.path.join(tmpb, "ck")
+            base = _run_child(
+                tmpb, "base", sched_base_extra + ["--checkpoint-dir", ckb]
+            )
+            if base.returncode != 0:
+                raise RuntimeError(
+                    f"preempt baseline failed: {base.stderr[-1000:]}"
+                )
+            preempt_cells.append(_preempt_cell(
+                root, "schedule", sched_extra, _records(tmpb, "base"), ckb,
+            ))
+            preempt_cells.append(_preempt_cell(
+                root, "signal", extra, *baselines["arena_pipe"],
+            ))
+            for c in preempt_cells:
+                verdict = "OK" if (
+                    c["exit"] == PREEMPTED_EXIT and c["marker"]
+                    and c["final_state_bitwise"] and c["history_bitwise"]
+                    and 0 <= c["lost_blocks"] <= 1
+                ) else f"FAIL ({c.get('error')})"
+                print(f"[preempt] {c['kind']} -> {verdict}", flush=True)
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+    unresumable = sum(
+        1 for c in cells if not (c["crashed"] and c["resumed"])
+    )
+    silent_loss = sum(
+        1 for c in cells
+        if c["resumed"] and not (
+            c["final_state_bitwise"] and c["history_bitwise"]
+        )
+    )
+    out = {
+        "bench": "crash_matrix",
+        "platform": jax.default_backend(),
+        "mode": "smoke" if smoke else "full",
+        "op_point": dict(_OP, model="mlp", algo="eventgrad"),
+        "configs": {k: " ".join(v[0]) for k, v in configs.items()},
+        "exit_codes": {
+            "crashpoint": CRASHPOINT_EXIT, "preempted": PREEMPTED_EXIT,
+        },
+        "n_cells": len(cells),
+        "cells": cells,
+        "unresumable": unresumable,
+        "silent_data_loss": silent_loss,
+        # recomputation bound per cell: one save interval of snapshot
+        # age, PLUS one more under the dispatch pipeline — a kill
+        # inside the ASYNC epoch-E save (ckpt.mid_swap et al.) falls
+        # back to the epoch E-save_every snapshot while the main loop
+        # legitimately ran ahead to the next join barrier (the E+
+        # save_every save). Measured worst case: 2 * save_every.
+        "recovery_bound_epochs": 2 * _OP["save_every"],
+        "recovery_ok": bool(cells) and all(
+            0 <= c["lost_epochs"] <= 2 * _OP["save_every"] for c in cells
+        ),
+        "preemption": {"cells": preempt_cells},
+        "wall_s": round(time.perf_counter() - t_start, 1),
+    }
+    if out_path:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(out_path)), exist_ok=True
+        )
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="two configs instead of five (same schema; the "
+                         "committed artifact uses the full matrix)")
+    ap.add_argument("--workdir", default=None,
+                    help="keep per-cell checkpoints/logs here instead of "
+                         "a temp dir (debugging)")
+    ap.add_argument("--out", default=os.path.join(
+        _ROOT, "artifacts", f"crash_matrix_{jax.default_backend()}.json"
+    ))
+    args = ap.parse_args(argv)
+    out = run_matrix(args.out, smoke=args.smoke, workdir=args.workdir)
+    print(json.dumps(
+        {k: v for k, v in out.items() if k not in ("cells", "preemption")},
+        indent=1, sort_keys=True,
+    ))
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_artifacts",
+        os.path.join(_ROOT, "tools", "validate_artifacts.py"),
+    )
+    va = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(va)
+    errs = va.validate(out, va.CRASH_MATRIX_SCHEMA)
+    for e in errs:
+        print(f"CRASH_MATRIX_SCHEMA violation: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

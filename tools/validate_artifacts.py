@@ -368,9 +368,101 @@ INTEGRITY_SCHEMA = {
     },
 }
 
+_CRASH_CELL = {
+    "type": "object",
+    "required": [
+        "config", "site", "hit", "crashed", "resumed",
+        "final_state_bitwise", "history_bitwise", "lost_epochs",
+        "crash_exit",
+    ],
+    "properties": {
+        "config": {"type": "string"},
+        "site": {"type": "string"},
+        "hit": {"type": "integer", "minimum": 1},
+        # every cell must have ACTUALLY crashed at the armed site (an
+        # unfired site would read as "survived" vacuously), resumed,
+        # and recovered bitwise — no exceptions, or the aggregate
+        # unresumable/silent_data_loss pins below fail anyway
+        "crashed": {"enum": [True]},
+        "resumed": {"enum": [True]},
+        "final_state_bitwise": {"enum": [True]},
+        "history_bitwise": {"enum": [True]},
+        "lost_epochs": {"type": "integer", "minimum": 0},
+        "crash_exit": {"enum": [83]},
+    },
+}
+
+_PREEMPT_CELL = {
+    "type": "object",
+    "required": [
+        "kind", "exit", "marker", "final_state_bitwise",
+        "history_bitwise", "lost_blocks",
+    ],
+    "properties": {
+        "kind": {"enum": ["schedule", "signal"]},
+        "exit": {"enum": [75]},
+        "marker": {"enum": [True]},
+        "final_state_bitwise": {"enum": [True]},
+        "history_bitwise": {"enum": [True]},
+        # the ISSUE 8 bound: graceful preemption loses AT MOST one
+        # dispatch block of work (the boundary snapshot makes it 0)
+        "lost_blocks": {"type": "integer", "minimum": 0, "maximum": 1},
+    },
+}
+
+CRASH_MATRIX_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "op_point", "configs", "exit_codes",
+        "n_cells", "cells", "unresumable", "silent_data_loss",
+        "recovery_ok", "preemption", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["crash_matrix"]},
+        "platform": {"type": "string"},
+        # the crash-consistency acceptance gates (ISSUE 8): every
+        # registered crash site x configuration cell was killed at the
+        # armed seam, resumed, and recovered the uninterrupted run's
+        # final snapshot and history BITWISE — zero unresumable cells,
+        # zero silent data loss, every recovery within one save
+        # interval — and both graceful-preemption legs (scheduled
+        # notice + real SIGTERM) exited PREEMPTED_EXIT with a marker
+        # and lost at most one dispatch block
+        "exit_codes": {
+            "type": "object",
+            "required": ["crashpoint", "preempted"],
+            "properties": {
+                "crashpoint": {"enum": [83]},
+                "preempted": {"enum": [75]},
+            },
+        },
+        "n_cells": {"type": "integer", "minimum": 12},
+        "cells": {"type": "array", "minItems": 12, "items": _CRASH_CELL},
+        "unresumable": {"enum": [0]},
+        "silent_data_loss": {"enum": [0]},
+        # every recomputation within the documented bound (one save
+        # interval of snapshot age + one of pipeline run-ahead past a
+        # killed async save)
+        "recovery_bound_epochs": {"type": "integer", "minimum": 1},
+        "recovery_ok": {"enum": [True]},
+        "preemption": {
+            "type": "object",
+            "required": ["cells"],
+            "properties": {
+                "cells": {
+                    "type": "array", "minItems": 2,
+                    "items": _PREEMPT_CELL,
+                },
+            },
+        },
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
+    ("crash_matrix_", CRASH_MATRIX_SCHEMA),
     ("integrity_", INTEGRITY_SCHEMA),
     ("obs_report_", OBS_REPORT_SCHEMA),
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
