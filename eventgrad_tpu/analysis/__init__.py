@@ -1,0 +1,31 @@
+"""Static analysis over the traced train step + the project lint rules.
+
+Three layers, each importable on its own:
+
+  * `walker` — structure-blind traversal of a jaxpr through every nested
+    sub-jaxpr (pjit / scan / cond / while / custom_jvp / remat), plus the
+    op-accounting primitives the regression gates are built from
+    (`count_primitives`, `count_full_ravels`).
+  * `rankflow` — a dataflow analysis over the vmap-lifted step proving
+    RANK ISOLATION: every intermediate is tracked for which array axis
+    (if any) carries the rank coordinate, and the only equations allowed
+    to move information ACROSS that axis are the declared neighbor
+    exchanges (the constant-permutation gathers `lax.ppermute` lowers to
+    under vmap) — anything else is a violation.
+  * `audit` — the per-configuration auditor: rank isolation, wire-byte
+    truth (bytes derived from the exchange lanes' shapes/dtypes ==
+    the independent formula == the step's `sent_bytes_wire_real`
+    metric), and step hygiene (no host callbacks, full-model ravel
+    budget, wire dtype fidelity, donation aliasing) — with seeded
+    ORACLE violations proving each check can actually fire.
+
+`lint` is the AST-based source lint framework (exit-code literals,
+`os._exit` confinement, host syncs in traced paths, the shard_map
+skip-pattern, crashpoint instrumentation); tier-1 tests and
+`tools/audit.py` both run it.  See docs/ANALYSIS.md.
+
+Submodules import explicitly (`from eventgrad_tpu.analysis import
+lint`): no eager package-level imports, so the AST-only lint never
+pays the auditor's jax/optax/model import chain and the
+`python -m eventgrad_tpu.analysis.lint` CLI runs warning-free.
+"""

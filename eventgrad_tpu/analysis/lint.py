@@ -1,0 +1,421 @@
+"""AST-based project lint framework + the repo's rule set.
+
+One framework replaces the grep lints that had accumulated in
+tests/test_lint_spmd.py and tests/test_crashpoint.py: each rule walks
+parsed ASTs (so docstrings/comments never false-positive) or — where
+the invariant is genuinely textual, like the shard_map skip-pattern —
+the raw source, and reports `Violation(rule, path, line, message)`
+records.  Tier-1 tests assert `run()` is empty; `tools/audit.py` runs
+the same rules and pins `lint_violations: 0` in the audit artifact;
+`python -m eventgrad_tpu.analysis.lint` is the CLI.
+
+The rules (docs/ANALYSIS.md has the rationale for each):
+
+  * exit-code-literals — the process exit codes are a cross-process
+    contract owned by `eventgrad_tpu/exitcodes.py`; a literal 75/77/83
+    anywhere else in the package is a re-declaration waiting to drift.
+  * os-exit-confined — `os._exit` is the crashpoint engine's honest
+    SIGKILL model and belongs to `chaos/crashpoint.py` (one named
+    exemption: train/loop.py's fault_inject `crash:N`, which predates
+    the registry and exits 13 by a separate contract).
+  * no-host-sync-in-traced — `block_until_ready`/`device_get` in
+    `parallel/`, `ops/`, or `train/steps.py` is a host round-trip on a
+    traced path; the dispatch pipeline exists to delete exactly those.
+  * shard-map-marker / shard-map-respell / shard-map-exempt-honest —
+    the tests/_spmd.py skip-pattern rules (formerly
+    tests/test_lint_spmd.py, messages preserved verbatim).
+  * crashpoint-instrumented — every registered crash site appears at
+    EXACTLY one literal `crashpoint.hit("<name>")` call (formerly a
+    grep in tests/test_crashpoint.py, messages preserved).
+
+Adding a rule: subclass `Rule`, implement `check(files)`, append to
+`RULES`.  Scope rules by `rel` prefix; prefer AST matching; when a
+file must be exempt, name it AND assert the exemption is still honest
+(a stale exemption silently covers nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from functools import cached_property
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from eventgrad_tpu import exitcodes
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  #: repo-relative
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceFile:
+    path: str
+    rel: str
+    text: str
+
+    @cached_property
+    def tree(self) -> ast.AST:
+        return ast.parse(self.text, filename=self.rel)
+
+
+def collect_sources(
+    root: str = REPO_ROOT, subdirs: Sequence[str] = ("eventgrad_tpu", "tests")
+) -> List[SourceFile]:
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path) as f:
+                    out.append(SourceFile(
+                        path=path,
+                        rel=os.path.relpath(path, root),
+                        text=f.read(),
+                    ))
+    return out
+
+
+class Rule:
+    name: str = "rule"
+    description: str = ""
+
+    def check(self, files: Sequence[SourceFile]) -> List[Violation]:
+        raise NotImplementedError
+
+    def _v(self, sf: SourceFile, line: int, message: str) -> Violation:
+        return Violation(self.name, sf.rel, line, message)
+
+
+def _in_package(sf: SourceFile) -> bool:
+    return sf.rel.startswith("eventgrad_tpu" + os.sep)
+
+
+def _is_test(sf: SourceFile) -> bool:
+    return (
+        sf.rel.startswith("tests" + os.sep)
+        and os.path.basename(sf.rel).startswith("test_")
+    )
+
+
+# --- package rules ----------------------------------------------------------
+
+
+class ExitCodeLiterals(Rule):
+    """The exit codes are a contract; the package spells them
+    `exitcodes.<NAME>`, never by value."""
+
+    name = "exit-code-literals"
+    #: the contract values, read FROM the contract module (this file
+    #: itself must pass its own rule)
+    CODES = frozenset(exitcodes.EXIT_CODE_NAMES)
+    ALLOWED = "eventgrad_tpu" + os.sep + "exitcodes.py"
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            if not _in_package(sf) or sf.rel == self.ALLOWED:
+                continue
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and type(node.value) is int
+                    and node.value in self.CODES
+                ):
+                    out.append(self._v(
+                        sf, node.lineno,
+                        f"exit-code literal {node.value} outside "
+                        "exitcodes.py — import eventgrad_tpu.exitcodes "
+                        f"({exitcodes.EXIT_CODE_NAMES[node.value]}) "
+                        "instead of re-declaring the contract by value",
+                    ))
+        return out
+
+
+class OsExitConfined(Rule):
+    """`os._exit` belongs to the crashpoint engine."""
+
+    name = "os-exit-confined"
+    OWNER = os.path.join("eventgrad_tpu", "chaos", "crashpoint.py")
+    #: named exemptions with the reason on record; each exempt file must
+    #: still contain EXACTLY one os._exit or the exemption has gone stale
+    EXEMPT = {
+        os.path.join("eventgrad_tpu", "train", "loop.py"):
+            "fault_inject crash:N — the seeded hard-kill predates the "
+            "crashpoint registry and exits 13 by its own contract",
+    }
+
+    @staticmethod
+    def _os_exit_calls(sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_exit"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "os"
+            ):
+                yield node
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            if not _in_package(sf) or sf.rel == self.OWNER:
+                continue
+            calls = list(self._os_exit_calls(sf))
+            if sf.rel in self.EXEMPT:
+                if len(calls) != 1:
+                    out.append(self._v(
+                        sf, calls[1].lineno if len(calls) > 1 else 1,
+                        f"exempt file has {len(calls)} os._exit calls "
+                        "(the exemption covers exactly one: "
+                        f"{self.EXEMPT[sf.rel]})",
+                    ))
+                continue
+            for call in calls:
+                out.append(self._v(
+                    sf, call.lineno,
+                    "os._exit outside chaos/crashpoint.py — the hard-"
+                    "kill model belongs to the crashpoint engine "
+                    "(raise, or register a crash site)",
+                ))
+        return out
+
+
+class NoHostSyncInTraced(Rule):
+    """No host round-trips on the traced-step paths."""
+
+    name = "no-host-sync-in-traced"
+    SCOPES = (
+        os.path.join("eventgrad_tpu", "parallel") + os.sep,
+        os.path.join("eventgrad_tpu", "ops") + os.sep,
+        os.path.join("eventgrad_tpu", "train", "steps.py"),
+    )
+    BANNED_ATTRS = ("block_until_ready", "device_get")
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            if not any(
+                sf.rel.startswith(s) or sf.rel == s for s in self.SCOPES
+            ):
+                continue
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr in self.BANNED_ATTRS
+                ):
+                    out.append(self._v(
+                        sf, node.lineno,
+                        f"{node.attr} on a traced path — a host sync "
+                        "the dispatch pipeline cannot hide; read back "
+                        "at the loop boundary instead",
+                    ))
+        return out
+
+
+class CrashpointInstrumented(Rule):
+    """Every registered crash site is instrumented at exactly one
+    literal `crashpoint.hit("<name>")` call (messages preserved from
+    tests/test_crashpoint.py's grep lint)."""
+
+    name = "crashpoint-instrumented"
+    OWNER = os.path.join("eventgrad_tpu", "chaos", "crashpoint.py")
+
+    def check(self, files):
+        from eventgrad_tpu.chaos import crashpoint
+
+        out = []
+        used: Dict[str, List[str]] = {}
+        for sf in files:
+            if not _in_package(sf) or sf.rel == self.OWNER:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "hit"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "crashpoint"
+                ):
+                    continue
+                arg = node.args[0] if node.args else None
+                if not (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                ):
+                    out.append(self._v(
+                        sf, node.lineno,
+                        "crashpoint.hit() must take a string literal "
+                        "(the instrumentation lint counts literal sites)",
+                    ))
+                    continue
+                used.setdefault(arg.value, []).append(sf.rel)
+        unregistered = set(used) - set(crashpoint.SITES)
+        dead = set(crashpoint.SITES) - set(used)
+        dupes = {n: fs for n, fs in used.items() if len(fs) > 1}
+        if unregistered:
+            out.append(Violation(
+                self.name, "eventgrad_tpu", 1,
+                f"unregistered crashpoint names instrumented: "
+                f"{sorted(unregistered)}",
+            ))
+        if dead:
+            out.append(Violation(
+                self.name, "eventgrad_tpu", 1,
+                f"registered crashpoints with NO instrumented site: "
+                f"{sorted(dead)}",
+            ))
+        if dupes:
+            out.append(Violation(
+                self.name, "eventgrad_tpu", 1,
+                f"crashpoints instrumented at more than one site: {dupes}",
+            ))
+        return out
+
+
+# --- shard_map skip-pattern rules (tests/) ----------------------------------
+
+#: the seed's shard_map test files: the pre-existing tier-1 baseline
+#: failures in shard_map-less environments. Frozen — new entries mean
+#: new un-skipped debt, which is exactly what this lint exists to stop.
+SEED_EXEMPT = frozenset({
+    "test_collectives.py",
+    "test_ring_attention.py",
+    "test_train_equivalence.py",
+})
+
+_IMPORT_RE = re.compile(
+    r"^\s*from\s+_spmd\s+import\s+.*\brequires_shard_map\b", re.MULTILINE
+)
+#: a hand-rolled respelling: a skipif whose condition mentions shard_map
+#: (tests/_spmd.py holds the one allowed instance)
+_RESPELL_RE = re.compile(r"skipif\s*\([^)]*shard_map", re.DOTALL)
+
+#: the lint runner test's own docstrings quote the patterns
+_LINT_TEST = "test_lint_spmd.py"
+
+
+class ShardMapMarkerImport(Rule):
+    name = "shard-map-marker"
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            name = os.path.basename(sf.rel)
+            if not _is_test(sf) or name == _LINT_TEST:
+                continue
+            if (
+                "shard_map" in sf.text
+                and name not in SEED_EXEMPT
+                and not _IMPORT_RE.search(sf.text)
+            ):
+                out.append(self._v(
+                    sf, 1,
+                    f"{name} touches shard_map without importing the "
+                    "shared `requires_shard_map` marker from "
+                    "tests/_spmd.py (ROADMAP Open item 1); add `from "
+                    "_spmd import requires_shard_map` instead of "
+                    "re-spelling the skipif",
+                ))
+        return out
+
+
+class ShardMapRespell(Rule):
+    name = "shard-map-respell"
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            name = os.path.basename(sf.rel)
+            if not _is_test(sf) or name == _LINT_TEST:
+                continue
+            if _RESPELL_RE.search(sf.text):
+                out.append(self._v(
+                    sf, 1,
+                    f"{name} re-spells the shard_map skipif; use "
+                    "`requires_shard_map` from tests/_spmd.py (single "
+                    "definition, single reason string)",
+                ))
+        return out
+
+
+class ShardMapExemptHonest(Rule):
+    """The exemption list stays honest: every exempt file still exists
+    and still touches shard_map."""
+
+    name = "shard-map-exempt-honest"
+
+    def check(self, files):
+        out = []
+        by_name = {os.path.basename(sf.rel): sf for sf in files if _is_test(sf)}
+        for name in sorted(SEED_EXEMPT):
+            sf = by_name.get(name)
+            if sf is None:
+                out.append(Violation(
+                    self.name, os.path.join("tests", name), 1,
+                    f"exempt file {name} no longer exists",
+                ))
+            elif "shard_map" not in sf.text:
+                out.append(self._v(
+                    sf, 1,
+                    f"exempt file {name} no longer touches shard_map — "
+                    "drop it from SEED_EXEMPT",
+                ))
+        return out
+
+
+RULES: Sequence[Rule] = (
+    ExitCodeLiterals(),
+    OsExitConfined(),
+    NoHostSyncInTraced(),
+    CrashpointInstrumented(),
+    ShardMapMarkerImport(),
+    ShardMapRespell(),
+    ShardMapExemptHonest(),
+)
+
+
+def run(
+    rules: Optional[Iterable[Rule]] = None,
+    root: str = REPO_ROOT,
+    files: Optional[Sequence[SourceFile]] = None,
+) -> List[Violation]:
+    """Run every rule over the repo (or an injected file set — the
+    oracle tests feed seeded-violation sources through here)."""
+    if files is None:
+        files = collect_sources(root)
+    out: List[Violation] = []
+    for rule in rules if rules is not None else RULES:
+        out.extend(rule.check(files))
+    return out
+
+
+def main(argv=None) -> int:
+    violations = run()
+    for v in violations:
+        print(str(v), file=sys.stderr)
+    print(f"lint: {len(RULES)} rules, {len(violations)} violations")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,914 @@
+"""Rank-isolation dataflow analysis over the vmap-lifted train step.
+
+The decentralized-semantics guarantee EventGraD rests on: rank r's new
+state depends on other ranks ONLY through the declared neighbor
+exchange.  On the single-chip vmap lift every rank lives as one index
+of a leading [n_ranks] axis, so the guarantee has a precise structural
+form: every equation of the lifted jaxpr must treat that axis
+POINTWISE, except the equations `lax.ppermute` lowers to — under vmap,
+a gather over the rank axis whose indices are a CONSTANT permutation
+(the neighbor shift).  This module is an abstract interpreter that
+tracks, for every intermediate, which array axis (if any) carries the
+rank coordinate, and reports
+
+  * `exchanges` — the constant-permutation gathers found, each with its
+    ring offset, per-neighbor lane shape, and dtype (the wire-truth
+    inputs of analysis/audit.py);
+  * `psums` — positional cross-rank reductions (`lax.psum`/`pmean`
+    under vmap); legal only for configurations that declare them
+    (allreduce, aux axes), never for ring gossip;
+  * `violations` — every other equation that moves information across
+    the rank axis (a data-dependent cross-rank gather, a slice or
+    concatenate that cuts the axis, a reduction over it, a reshape that
+    folds it away, an unknown primitive the rules cannot prove safe).
+
+Soundness stance: UNKNOWN primitives are violations, not warnings — a
+new op in the step must either be provably rank-pointwise (add a rule)
+or be a declared exchange.  Known limitation: a reshape that merges the
+rank axis with another dim (the vmap batching rule for convolutions
+does this) reports as a violation; the audit matrix therefore runs on
+the MLP geometry, where the step's exchange structure is identical and
+no such merge occurs (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+#: cap on constant values carried through the fold (the permutation
+#: vectors are [n_ranks]; anything big is never needed for an index)
+_MAX_CONST_ELEMS = 1 << 16
+
+
+@dataclasses.dataclass(frozen=True)
+class Abs:
+    """Abstract value: `axis` is the array dim carrying the rank
+    coordinate (None = rank-invariant — the value does not depend on
+    any rank's inputs); `const` is the concrete value when statically
+    known (index pipelines), else None."""
+
+    axis: Optional[int] = None
+    const: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class Exchange:
+    """One declared cross-rank move: a constant-permutation gather."""
+
+    offset: int  #: signed ring offset (dst reads from dst+offset)
+    lane_shape: Tuple[int, ...]  #: per-rank payload shape
+    dtype: str
+    path: Tuple[str, ...]
+
+    @property
+    def lane_elems(self) -> int:
+        return int(math.prod(self.lane_shape)) if self.lane_shape else 1
+
+
+@dataclasses.dataclass
+class Finding:
+    kind: str  #: "violation" | "psum"
+    prim: str
+    reason: str
+    path: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class RankFlowReport:
+    n_ranks: int
+    exchanges: List[Exchange]
+    psums: List[Finding]
+    violations: List[Finding]
+
+    def exchange_offsets(self) -> List[int]:
+        return sorted({e.offset for e in self.exchanges})
+
+
+# --- primitive rule tables --------------------------------------------------
+
+#: pointwise primitives: every ranked operand shares the rank axis and
+#: the output inherits it — no data moves across ranks
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "atan2",
+    "max", "min", "and", "or", "xor", "not", "neg", "sign", "abs",
+    "exp", "exp2", "log", "log1p", "expm1", "sqrt", "rsqrt", "cbrt",
+    "tanh", "tan", "sin", "cos", "asin", "acos", "atan", "sinh", "cosh",
+    "asinh", "acosh", "atanh", "logistic", "erf", "erfc", "erf_inv",
+    "floor", "ceil", "round", "nextafter", "is_finite", "square",
+    "gt", "lt", "ge", "le", "eq", "ne", "select_n", "clamp",
+    "gt_to", "lt_to", "ge_to", "le_to", "eq_to", "ne_to",
+    "convert_element_type", "stop_gradient", "add_any", "copy",
+    "reduce_precision", "real", "imag", "conj",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz",
+})
+
+#: prefix-preserving primitives: output keeps the leading dims of the
+#: input (rank axis survives in place); trailing dims may change
+_PREFIX = frozenset({
+    "random_wrap", "random_unwrap", "random_split", "random_bits",
+    "random_fold_in", "random_seed", "bitcast_convert_type",
+})
+
+_REDUCE = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_or", "reduce_and", "reduce_xor", "argmax", "argmin",
+})
+
+_CUM = frozenset({"cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp"})
+
+_FOLD = {
+    "add": np.add, "sub": np.subtract, "mul": np.multiply,
+    "rem": np.mod, "max": np.maximum, "min": np.minimum,
+    "lt": np.less, "le": np.less_equal, "gt": np.greater,
+    "ge": np.greater_equal, "eq": np.equal, "ne": np.not_equal,
+    "and": np.bitwise_and, "or": np.bitwise_or, "xor": np.bitwise_xor,
+    "not": np.invert, "neg": np.negative, "abs": np.abs,
+}
+
+_COLLECTIVE_VIOLATIONS = frozenset({
+    "all_gather", "all_to_all", "reduce_scatter", "pgather", "pbroadcast",
+})
+
+
+def _const_of(v) -> Optional[np.ndarray]:
+    if v is None:
+        return None
+    try:
+        arr = np.asarray(v)
+    except Exception:
+        return None
+    if arr.size > _MAX_CONST_ELEMS or arr.dtype == object:
+        return None
+    return arr
+
+
+class _Flow:
+    def __init__(self, n_ranks: int):
+        self.n = n_ranks
+        self.exchanges: List[Exchange] = []
+        self.psums: List[Finding] = []
+        self.violations: List[Finding] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _mark(self):
+        """Snapshot of the findings lists (fixpoint re-runs and cond
+        branches truncate back to a mark so one runtime execution is
+        recorded exactly once)."""
+        return len(self.exchanges), len(self.psums), len(self.violations)
+
+    def _reset(self, mark):
+        e, p, v = mark
+        del self.exchanges[e:]
+        del self.psums[p:]
+        del self.violations[v:]
+
+    def _take_since(self, mark):
+        e, p, v = mark
+        taken = (self.exchanges[e:], self.psums[p:], self.violations[v:])
+        self._reset(mark)
+        return taken
+
+    def _bad(self, eqn, path, reason) -> Abs:
+        self.violations.append(
+            Finding("violation", eqn.primitive.name, reason, path)
+        )
+        return Abs(None, None)
+
+    def _read(self, env, v) -> Abs:
+        if isinstance(v, jax.core.Literal):
+            return Abs(None, _const_of(v.val))
+        return env.get(v, Abs(None, None))
+
+    def _common_axis(self, eqn, path, abs_in) -> Tuple[Optional[int], bool]:
+        axes = {a.axis for a in abs_in if a.axis is not None}
+        if len(axes) > 1:
+            self._bad(eqn, path, f"operands carry rank axes {sorted(axes)}")
+            return None, False
+        return (next(iter(axes)) if axes else None), True
+
+    # -- entry point --------------------------------------------------------
+
+    def run(self, closed, in_abs: Sequence[Abs], path=()) -> List[Abs]:
+        jaxpr = closed.jaxpr
+        env: Dict[Any, Abs] = {}
+        for cv, cval in zip(jaxpr.constvars, closed.consts):
+            env[cv] = Abs(None, _const_of(cval))
+        if len(in_abs) != len(jaxpr.invars):
+            raise ValueError(
+                f"rankflow: {len(in_abs)} abstract inputs for "
+                f"{len(jaxpr.invars)} invars"
+            )
+        for v, a in zip(jaxpr.invars, in_abs):
+            env[v] = a
+        self._run_eqns(jaxpr, env, path)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _run_jaxpr_open(self, jaxpr, consts_abs, in_abs, path) -> List[Abs]:
+        """Bare Jaxpr whose constvars get abstract values (scan body)."""
+        env: Dict[Any, Abs] = {}
+        for cv, a in zip(jaxpr.constvars, consts_abs):
+            env[cv] = a
+        for v, a in zip(jaxpr.invars, in_abs):
+            env[v] = a
+        self._run_eqns(jaxpr, env, path)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _run_eqns(self, jaxpr, env, path):
+        for eqn in jaxpr.eqns:
+            abs_in = [self._read(env, v) for v in eqn.invars]
+            abs_out = self._apply(eqn, abs_in, path)
+            for v, a in zip(eqn.outvars, abs_out):
+                env[v] = a
+
+    # -- the per-primitive transfer function --------------------------------
+
+    def _apply(self, eqn, abs_in: List[Abs], path) -> List[Abs]:
+        prim = eqn.primitive.name
+        n_out = len(eqn.outvars)
+        p = eqn.params
+
+        if prim in _ELEMENTWISE:
+            d, ok = self._common_axis(eqn, path, abs_in)
+            const = None
+            if ok and all(a.const is not None for a in abs_in):
+                fn = _FOLD.get(prim)
+                if prim == "select_n" and len(abs_in) == 3:
+                    const = _const_of(np.where(
+                        abs_in[0].const.astype(bool),
+                        abs_in[2].const, abs_in[1].const,
+                    ))
+                elif prim == "convert_element_type":
+                    const = _const_of(
+                        abs_in[0].const.astype(p["new_dtype"])
+                    )
+                elif prim in ("stop_gradient", "copy"):
+                    const = abs_in[0].const
+                elif fn is not None:
+                    try:
+                        const = _const_of(fn(*[a.const for a in abs_in]))
+                    except Exception:
+                        const = None
+            return [Abs(d, const)] * n_out
+
+        if prim in _PREFIX:
+            a = abs_in[0]
+            d = a.axis
+            out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+            if d is not None and (
+                len(out_shape) <= d or out_shape[d] != self.n
+            ):
+                return [self._bad(
+                    eqn, path, f"{prim} drops the rank axis (dim {d})"
+                )] * n_out
+            return [Abs(d, None)] * n_out
+
+        if prim == "broadcast_in_dim":
+            a = abs_in[0]
+            d = None if a.axis is None else int(p["broadcast_dimensions"][a.axis])
+            const = None
+            if a.const is not None:
+                try:
+                    shape = tuple(int(s) for s in p["shape"])
+                    with_ones = [1] * len(shape)
+                    for src, dst in enumerate(p["broadcast_dimensions"]):
+                        with_ones[int(dst)] = a.const.shape[src]
+                    const = _const_of(np.broadcast_to(
+                        a.const.reshape(with_ones), shape
+                    ))
+                except Exception:
+                    const = None
+            return [Abs(d, const)]
+
+        if prim == "reshape":
+            a = abs_in[0]
+            if p.get("dimensions") is not None and a.axis is not None:
+                return [self._bad(
+                    eqn, path, "reshape with permuted dimensions over a "
+                    "rank-carrying value"
+                )]
+            in_shape = tuple(eqn.invars[0].aval.shape)
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            const = None
+            if a.const is not None:
+                try:
+                    const = _const_of(a.const.reshape(out_shape))
+                except Exception:
+                    const = None
+            if a.axis is None:
+                return [Abs(None, const)]
+            pre = math.prod(in_shape[: a.axis]) if a.axis else 1
+            for d2 in range(len(out_shape)):
+                if (
+                    math.prod(out_shape[:d2]) == pre
+                    and out_shape[d2] == self.n
+                ):
+                    return [Abs(d2, const)]
+            return [self._bad(
+                eqn, path,
+                f"reshape {in_shape}->{out_shape} folds the rank axis "
+                f"(dim {a.axis}) into another dim — rank blocks are no "
+                "longer separable",
+            )]
+
+        if prim == "squeeze":
+            a = abs_in[0]
+            dims = tuple(int(x) for x in p["dimensions"])
+            const = None
+            if a.const is not None:
+                try:
+                    const = _const_of(np.squeeze(a.const, axis=dims))
+                except Exception:
+                    const = None
+            if a.axis is None:
+                return [Abs(None, const)]
+            if a.axis in dims:
+                return [self._bad(eqn, path, "squeeze removes the rank axis")]
+            return [Abs(a.axis - sum(1 for x in dims if x < a.axis), const)]
+
+        if prim == "transpose":
+            a = abs_in[0]
+            perm = tuple(int(x) for x in p["permutation"])
+            d = None if a.axis is None else perm.index(a.axis)
+            const = None
+            if a.const is not None:
+                try:
+                    const = _const_of(np.transpose(a.const, perm))
+                except Exception:
+                    const = None
+            return [Abs(d, const)]
+
+        if prim == "slice":
+            a = abs_in[0]
+            const = None
+            if a.const is not None:
+                try:
+                    idx = tuple(
+                        slice(int(s), int(l), int(st))
+                        for s, l, st in zip(
+                            p["start_indices"], p["limit_indices"],
+                            p["strides"] or [1] * len(p["start_indices"]),
+                        )
+                    )
+                    const = _const_of(a.const[idx])
+                except Exception:
+                    const = None
+            if a.axis is None:
+                return [Abs(None, const)]
+            d = a.axis
+            strides = p["strides"] or [1] * len(p["start_indices"])
+            if (
+                int(p["start_indices"][d]) != 0
+                or int(p["limit_indices"][d]) != self.n
+                or int(strides[d]) != 1
+            ):
+                return [self._bad(
+                    eqn, path,
+                    "slice selects a subset of ranks (cross-rank read)",
+                )]
+            return [Abs(d, const)]
+
+        if prim == "pad":
+            a = abs_in[0]
+            if a.axis is not None:
+                cfg = p["padding_config"][a.axis]
+                if tuple(int(x) for x in cfg) != (0, 0, 0):
+                    return [self._bad(eqn, path, "pad alters the rank axis")]
+            return [Abs(a.axis, None)]
+
+        if prim == "concatenate":
+            d, ok = self._common_axis(eqn, path, abs_in)
+            if not ok:
+                return [Abs(None, None)]
+            if d is not None and int(p["dimension"]) == d:
+                return [self._bad(
+                    eqn, path,
+                    "concatenate along the rank axis reassembles ranks "
+                    "(cross-rank write)",
+                )]
+            return [Abs(d, None)]
+
+        if prim == "iota":
+            const = None
+            shape = tuple(int(s) for s in p["shape"])
+            if len(shape) == 1 and shape[0] <= _MAX_CONST_ELEMS:
+                const = _const_of(
+                    np.arange(shape[0]).astype(p["dtype"])
+                )
+            return [Abs(None, const)]
+
+        if prim in _REDUCE:
+            a = abs_in[0]
+            axes = tuple(int(x) for x in p["axes"])
+            if a.axis is not None and a.axis in axes:
+                return [self._bad(
+                    eqn, path,
+                    f"{prim} reduces over the rank axis — cross-rank "
+                    "information flow",
+                )] * n_out
+            d = (
+                None if a.axis is None
+                else a.axis - sum(1 for x in axes if x < a.axis)
+            )
+            return [Abs(d, None)] * n_out
+
+        if prim in _CUM:
+            a = abs_in[0]
+            if a.axis is not None and int(p["axis"]) == a.axis:
+                return [self._bad(
+                    eqn, path, f"{prim} scans across the rank axis"
+                )]
+            return [Abs(a.axis, None)]
+
+        if prim == "sort":
+            d, ok = self._common_axis(eqn, path, abs_in)
+            if ok and d is not None and int(p["dimension"]) == d:
+                return [self._bad(eqn, path, "sort along the rank axis")] * n_out
+            return [Abs(d, None)] * n_out
+
+        if prim == "top_k":
+            a = abs_in[0]
+            ndim = len(eqn.invars[0].aval.shape)
+            if a.axis is not None and a.axis == ndim - 1:
+                return [self._bad(eqn, path, "top_k along the rank axis")] * n_out
+            return [Abs(a.axis, None)] * n_out
+
+        if prim == "rev":
+            a = abs_in[0]
+            if a.axis is not None and a.axis in tuple(
+                int(x) for x in p["dimensions"]
+            ):
+                return [self._bad(
+                    eqn, path, "rev reverses the rank axis (a cross-rank "
+                    "permutation outside the declared exchange)",
+                )]
+            return [Abs(a.axis, None)]
+
+        if prim == "gather":
+            return [self._gather(eqn, abs_in, path)]
+
+        if prim in ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                    "scatter-max"):
+            return [self._scatter(eqn, abs_in, path)]
+
+        if prim == "dot_general":
+            return [self._dot_general(eqn, abs_in, path)]
+
+        if prim == "dynamic_slice":
+            a = abs_in[0]
+            if any(x.axis is not None for x in abs_in[1:]):
+                return [self._bad(
+                    eqn, path, "rank-dependent dynamic_slice start index"
+                )]
+            if a.axis is not None and int(p["slice_sizes"][a.axis]) != self.n:
+                return [self._bad(
+                    eqn, path, "dynamic_slice cuts the rank axis"
+                )]
+            return [Abs(a.axis, None)]
+
+        if prim == "dynamic_update_slice":
+            op, upd = abs_in[0], abs_in[1]
+            if any(x.axis is not None for x in abs_in[2:]):
+                return [self._bad(
+                    eqn, path, "rank-dependent dynamic_update_slice index"
+                )]
+            d, ok = self._common_axis(eqn, path, [op, upd])
+            if not ok:
+                return [Abs(None, None)]
+            if d is not None and tuple(eqn.invars[1].aval.shape)[d] != self.n:
+                return [self._bad(
+                    eqn, path, "dynamic_update_slice writes a subset of ranks"
+                )]
+            return [Abs(d, None)]
+
+        if prim == "psum":
+            a = abs_in[0]
+            axes = tuple(x for x in p["axes"] if isinstance(x, int))
+            if a.axis is not None and a.axis in axes:
+                self.psums.append(Finding(
+                    "psum", prim,
+                    "positional psum over the rank axis (allreduce/pmean)",
+                    path,
+                ))
+                d = None  # reduced away: result is rank-invariant
+                return [Abs(d, None)] * n_out
+            d = (
+                None if a.axis is None
+                else a.axis - sum(1 for x in axes if x < a.axis)
+            )
+            return [Abs(d, None)] * n_out
+
+        if prim == "ppermute":
+            # shard_map / pmap form: explicit named-axis permutation
+            perm = tuple((int(s), int(d)) for s, d in p["perm"])
+            offs = {(s - d) % self.n for s, d in perm}
+            off = offs.pop() if len(offs) == 1 else None
+            if off is None:
+                return [self._bad(
+                    eqn, path, "ppermute with a non-uniform permutation"
+                )] * n_out
+            for ov in eqn.outvars:
+                self.exchanges.append(Exchange(
+                    offset=off if off <= self.n // 2 else off - self.n,
+                    lane_shape=tuple(ov.aval.shape),
+                    dtype=str(ov.aval.dtype),
+                    path=path,
+                ))
+            return [Abs(a.axis, None) for a in abs_in[:n_out]]
+
+        if prim in _COLLECTIVE_VIOLATIONS:
+            return [self._bad(
+                eqn, path, f"{prim}: undeclared cross-rank collective"
+            )] * n_out
+
+        # --- nested jaxprs --------------------------------------------------
+
+        if prim == "pjit":
+            return self.run(
+                p["jaxpr"], abs_in, path + (p.get("name") or "pjit",)
+            )
+
+        if prim in ("closed_call", "core_call", "call"):
+            return self.run(p["call_jaxpr"], abs_in, path + (prim,))
+
+        if prim in ("custom_jvp_call", "custom_vjp_call",
+                    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"):
+            sub = p.get("call_jaxpr") or p.get("fun_jaxpr")
+            if sub is None:
+                return [self._bad(
+                    eqn, path, f"{prim} without an inspectable call_jaxpr"
+                )] * n_out
+            return self.run(sub, abs_in, path + (prim,))
+
+        if prim in ("remat", "checkpoint", "remat2"):
+            sub = p["jaxpr"]
+            if isinstance(sub, jax.core.Jaxpr):
+                return self._run_jaxpr_open(sub, [], abs_in, path + (prim,))
+            return self.run(sub, abs_in, path + (prim,))
+
+        if prim == "scan":
+            return self._scan(eqn, abs_in, path)
+
+        if prim == "while":
+            return self._while(eqn, abs_in, path)
+
+        if prim == "cond":
+            return self._cond(eqn, abs_in, path)
+
+        return [self._bad(
+            eqn, path,
+            f"primitive '{prim}' has no rank-flow rule — prove it "
+            "rank-pointwise (add a rule in analysis/rankflow.py) or "
+            "declare it as an exchange",
+        )] * n_out
+
+    # -- the interesting primitives -----------------------------------------
+
+    def _gather(self, eqn, abs_in, path) -> Abs:
+        op, idx = abs_in[0], abs_in[1]
+        dn = eqn.params["dimension_numbers"]
+        offset_dims = tuple(int(x) for x in dn.offset_dims)
+        collapsed = tuple(int(x) for x in dn.collapsed_slice_dims)
+        start_map = tuple(int(x) for x in dn.start_index_map)
+        op_batch = tuple(int(x) for x in getattr(dn, "operand_batching_dims", ()))
+        idx_batch = tuple(
+            int(x) for x in getattr(dn, "start_indices_batching_dims", ())
+        )
+        slice_sizes = tuple(int(x) for x in eqn.params["slice_sizes"])
+        idx_ndim = len(eqn.invars[1].aval.shape)
+        out_ndim = len(eqn.outvars[0].aval.shape)
+        # output dims not fed by slices come from the indices' non-vector
+        # dims, in order (XLA gather semantics; the last indices dim is
+        # the index vector)
+        batch_positions = [q for q in range(out_ndim) if q not in offset_dims]
+        idx_nonvec = list(range(idx_ndim - 1))
+
+        def out_axis_from_idx(di):
+            if di not in idx_nonvec:
+                return None
+            return batch_positions[idx_nonvec.index(di)]
+
+        if op.axis is None:
+            if idx.axis is None:
+                return Abs(None, None)
+            d_out = out_axis_from_idx(idx.axis)
+            if d_out is None:
+                return self._bad(
+                    eqn, path,
+                    "rank axis used as the gather index vector dim",
+                )
+            # per-rank selection from a rank-invariant table: no
+            # cross-rank information flow
+            return Abs(d_out, None)
+
+        d = op.axis
+        if d in op_batch:
+            if idx.axis is None:
+                # rank-invariant indices applied within each rank's
+                # batch slice: out[r] = operand[r][idx] — pointwise
+                di = idx_batch[op_batch.index(d)]
+                return Abs(out_axis_from_idx(di), None)
+            if idx.axis not in idx_batch:
+                return self._bad(
+                    eqn, path,
+                    "batched gather whose indices carry the rank axis "
+                    "outside a batching dim",
+                )
+            return Abs(out_axis_from_idx(idx.axis), None)
+
+        if d in start_map:
+            # data moves ACROSS the rank axis, driven by the indices:
+            # legal only as a constant permutation (the ppermute lowering)
+            if idx.axis is not None:
+                return self._bad(
+                    eqn, path,
+                    "rank-indexed gather across the rank axis (a rank's "
+                    "data chosen by another rank's value)",
+                )
+            perm = None
+            if idx.const is not None:
+                flat = np.asarray(idx.const).reshape(-1)
+                if (
+                    flat.size == self.n
+                    and np.issubdtype(flat.dtype, np.integer)
+                    and sorted(int(x) for x in flat) == list(range(self.n))
+                ):
+                    perm = [int(x) for x in flat]
+            if perm is None:
+                return self._bad(
+                    eqn, path,
+                    "gather across the rank axis whose indices are not a "
+                    "static permutation — undeclared cross-rank data "
+                    "movement",
+                )
+            offs = {(perm[r] - r) % self.n for r in range(self.n)}
+            if len(offs) != 1:
+                return self._bad(
+                    eqn, path,
+                    f"cross-rank gather permutation {perm} is not a "
+                    "uniform ring shift",
+                )
+            off = offs.pop()
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            d_out = out_axis_from_idx(idx_nonvec[0]) if idx_nonvec else None
+            if d_out is None:
+                return self._bad(
+                    eqn, path, "exchange gather with no output rank dim"
+                )
+            lane = tuple(
+                s for q, s in enumerate(out_shape) if q != d_out
+            )
+            self.exchanges.append(Exchange(
+                offset=off if off <= self.n // 2 else off - self.n,
+                lane_shape=lane,
+                dtype=str(eqn.outvars[0].aval.dtype),
+                path=path,
+            ))
+            return Abs(d_out, None)
+
+        if d in collapsed:
+            return self._bad(
+                eqn, path, "gather collapses the rank axis"
+            )
+        # rank dim passes through whole as a slice dim
+        if slice_sizes[d] != self.n:
+            return self._bad(
+                eqn, path, "gather slices a subset of ranks"
+            )
+        surviving = [
+            q for q in range(len(slice_sizes))
+            if q not in collapsed and q not in op_batch
+        ]
+        return Abs(offset_dims[surviving.index(d)], None)
+
+    def _scatter(self, eqn, abs_in, path) -> Abs:
+        op, idx, upd = abs_in[0], abs_in[1], abs_in[2]
+        dn = eqn.params["dimension_numbers"]
+        op_batch = tuple(int(x) for x in getattr(dn, "operand_batching_dims", ()))
+        idx_batch = tuple(
+            int(x) for x in getattr(dn, "scatter_indices_batching_dims", ())
+        )
+        scatter_op_dims = tuple(
+            int(x) for x in dn.scatter_dims_to_operand_dims
+        )
+        if op.axis is None and idx.axis is None and upd.axis is None:
+            return Abs(None, None)
+        if op.axis is not None and op.axis in scatter_op_dims:
+            return self._bad(
+                eqn, path,
+                "scatter writes across the rank axis (cross-rank write)",
+            )
+        if op.axis is not None and op.axis in op_batch:
+            if idx.axis is not None and idx.axis not in idx_batch:
+                return self._bad(
+                    eqn, path,
+                    "batched scatter whose indices carry the rank axis "
+                    "outside a batching dim",
+                )
+            return Abs(op.axis, None)
+        if (
+            op.axis is None
+            and idx.axis is not None and idx.axis in idx_batch
+            and op_batch
+        ):
+            # rank-invariant base (e.g. a zeros buffer) scattered with
+            # per-rank batched indices/updates: each rank's slice only
+            # receives that rank's updates — pointwise
+            return Abs(op_batch[idx_batch.index(idx.axis)], None)
+        if op.axis is not None and idx.axis is None and upd.axis is None:
+            # rank-invariant updates written identically into every
+            # rank's slice of a pass-through rank dim
+            return Abs(op.axis, None)
+        return self._bad(
+            eqn, path, "scatter mixes ranked and unranked operands in a "
+            "shape the rules cannot prove rank-pointwise",
+        )
+
+    def _dot_general(self, eqn, abs_in, path) -> Abs:
+        lhs, rhs = abs_in[0], abs_in[1]
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lc, rc = tuple(int(x) for x in lc), tuple(int(x) for x in rc)
+        lb, rb = tuple(int(x) for x in lb), tuple(int(x) for x in rb)
+        lhs_ndim = len(eqn.invars[0].aval.shape)
+        rhs_ndim = len(eqn.invars[1].aval.shape)
+        lhs_free = [q for q in range(lhs_ndim) if q not in lc and q not in lb]
+        rhs_free = [q for q in range(rhs_ndim) if q not in rc and q not in rb]
+
+        def out_pos_lhs(d):
+            if d in lb:
+                return lb.index(d)
+            return len(lb) + lhs_free.index(d)
+
+        def out_pos_rhs(d):
+            if d in rb:
+                return rb.index(d)
+            return len(lb) + len(lhs_free) + rhs_free.index(d)
+
+        if lhs.axis is None and rhs.axis is None:
+            return Abs(None, None)
+        for a, contract in ((lhs, lc), (rhs, rc)):
+            if a.axis is not None and a.axis in contract:
+                return self._bad(
+                    eqn, path,
+                    "dot_general contracts over the rank axis — a "
+                    "cross-rank reduction",
+                )
+        if lhs.axis is not None and rhs.axis is not None:
+            if lhs.axis in lb and rhs.axis in rb and (
+                lb.index(lhs.axis) == rb.index(rhs.axis)
+            ):
+                return Abs(lb.index(lhs.axis), None)
+            return self._bad(
+                eqn, path,
+                "dot_general pairs two rank-carrying operands outside a "
+                "shared batch dim — every rank sees every rank",
+            )
+        if lhs.axis is not None:
+            return Abs(out_pos_lhs(lhs.axis), None)
+        return Abs(out_pos_rhs(rhs.axis), None)
+
+    # -- control flow --------------------------------------------------------
+
+    def _scan(self, eqn, abs_in, path) -> List[Abs]:
+        p = eqn.params
+        nc, ncar = int(p["num_consts"]), int(p["num_carry"])
+        consts, carries, xs = (
+            abs_in[:nc], abs_in[nc:nc + ncar], abs_in[nc + ncar:],
+        )
+        xs_body = []
+        for a, v in zip(xs, eqn.invars[nc + ncar:]):
+            if a.axis == 0:
+                return [self._bad(
+                    eqn, path, "scan iterates OVER the rank axis — each "
+                    "step would see one rank's data with carried state "
+                    "across ranks",
+                )] * len(eqn.outvars)
+            xs_body.append(Abs(None if a.axis is None else a.axis - 1, None))
+        carry_abs = list(carries)
+        body = p["jaxpr"]  # ClosedJaxpr
+        mark = self._mark()
+        for _ in range(3):
+            # each fixpoint re-run replaces (not appends to) the body's
+            # findings: one scan body, one set of exchanges/violations
+            self._reset(mark)
+            outs = self.run(
+                body, list(consts) + carry_abs + xs_body, path + ("scan",)
+            )
+            new_carry = [Abs(a.axis, None) for a in outs[:ncar]]
+            if [a.axis for a in new_carry] == [a.axis for a in carry_abs]:
+                break
+            carry_abs = [
+                Abs(o.axis if o.axis is not None else i.axis, None)
+                for i, o in zip(carry_abs, new_carry)
+            ]
+        else:
+            return [self._bad(
+                eqn, path, "scan carry rank structure did not stabilize"
+            )] * len(eqn.outvars)
+        ys = [
+            Abs(None if a.axis is None else a.axis + 1, None)
+            for a in outs[ncar:]
+        ]
+        return [Abs(a.axis, None) for a in outs[:ncar]] + ys
+
+    def _while(self, eqn, abs_in, path) -> List[Abs]:
+        p = eqn.params
+        cn, bn = int(p["cond_nconsts"]), int(p["body_nconsts"])
+        cond_c, body_c = abs_in[:cn], abs_in[cn:cn + bn]
+        carry = list(abs_in[cn + bn:])
+        mark = self._mark()
+        for _ in range(3):
+            self._reset(mark)
+            self.run(
+                p["cond_jaxpr"], list(cond_c) + carry, path + ("while.cond",)
+            )
+            outs = self.run(
+                p["body_jaxpr"], list(body_c) + carry, path + ("while.body",)
+            )
+            if [a.axis for a in outs] == [a.axis for a in carry]:
+                break
+            carry = [
+                Abs(o.axis if o.axis is not None else i.axis, None)
+                for i, o in zip(carry, outs)
+            ]
+        else:
+            return [self._bad(
+                eqn, path, "while carry rank structure did not stabilize"
+            )] * len(eqn.outvars)
+        return [Abs(a.axis, None) for a in carry]
+
+    def _cond(self, eqn, abs_in, path) -> List[Abs]:
+        pred, ops = abs_in[0], abs_in[1:]
+        if pred.axis is not None:
+            return [self._bad(
+                eqn, path, "cond predicate carries the rank axis "
+                "(rank-varying control flow)",
+            )] * len(eqn.outvars)
+        # at runtime exactly ONE branch executes: record each branch's
+        # findings separately, keep every branch's violations/psums, but
+        # count the exchange lanes once — and only if the branches agree
+        # on them (branches shipping different wires is itself a
+        # violation: the step's wire would be control-flow-dependent)
+        per_branch, branch_finds = [], []
+        for i, br in enumerate(eqn.params["branches"]):
+            mark = self._mark()
+            per_branch.append(self.run(br, list(ops), path + (f"cond.{i}",)))
+            branch_finds.append(self._take_since(mark))
+        for exchanges, psums, violations in branch_finds:
+            self.psums.extend(psums)
+            self.violations.extend(violations)
+        sigs = [
+            sorted((e.offset, e.lane_shape, e.dtype) for e in ex)
+            for ex, _, _ in branch_finds
+        ]
+        self.exchanges.extend(branch_finds[0][0])
+        if any(s != sigs[0] for s in sigs[1:]):
+            self.violations.append(Finding(
+                "violation", "cond",
+                "cond branches ship different exchange lanes — the wire "
+                "format would depend on control flow",
+                path,
+            ))
+        outs = []
+        for k in range(len(eqn.outvars)):
+            axes = {b[k].axis for b in per_branch if b[k].axis is not None}
+            if len(axes) > 1:
+                outs.append(self._bad(
+                    eqn, path,
+                    f"cond branches disagree on output {k}'s rank axis",
+                ))
+            else:
+                outs.append(Abs(next(iter(axes)) if axes else None, None))
+        return outs
+
+
+def analyze(
+    closed_jaxpr: "jax.core.ClosedJaxpr",
+    n_ranks: int,
+    in_axes: Optional[Sequence[Optional[int]]] = None,
+) -> RankFlowReport:
+    """Run the rank-isolation dataflow over a lifted step's closed jaxpr.
+
+    `in_axes` gives the rank-axis position per flat invar; by default
+    every invar whose leading dim equals `n_ranks` is assumed stacked at
+    axis 0 (the spmd vmap-lift layout) and everything else is
+    rank-invariant."""
+    if in_axes is None:
+        in_axes = [
+            0 if (tuple(v.aval.shape)[:1] == (n_ranks,)) else None
+            for v in closed_jaxpr.jaxpr.invars
+        ]
+    flow = _Flow(n_ranks)
+    flow.run(closed_jaxpr, [Abs(d, None) for d in in_axes])
+    return RankFlowReport(
+        n_ranks=n_ranks,
+        exchanges=flow.exchanges,
+        psums=flow.psums,
+        violations=flow.violations,
+    )

@@ -1,0 +1,78 @@
+"""Nested-jaxpr traversal + the op-accounting the regression gates use.
+
+One walker for every consumer (the arena op-count gate in
+tests/test_arena.py, the hygiene checks in analysis/audit.py, ad-hoc
+prints in tools/): `iter_eqns` yields every equation of a jaxpr
+INCLUDING those inside nested call/scan/cond/while/pjit/custom-deriv
+sub-jaxprs, so a count or a search can never silently miss ops that
+jit/scan wrapping moved one level down.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+
+
+def sub_jaxprs(eqn) -> Iterator["jax.core.Jaxpr"]:
+    """Every jaxpr nested in an equation's params (pjit's `jaxpr`,
+    scan/while/cond bodies, custom_jvp/vjp call jaxprs, ...), as bare
+    `jax.core.Jaxpr` objects."""
+    for v in eqn.params.values():
+        for sub in jax.tree.leaves(
+            v,
+            is_leaf=lambda x: isinstance(
+                x, (jax.core.Jaxpr, jax.core.ClosedJaxpr)
+            ),
+        ):
+            if isinstance(sub, jax.core.ClosedJaxpr):
+                yield sub.jaxpr
+            elif isinstance(sub, jax.core.Jaxpr):
+                yield sub
+
+
+def iter_eqns(
+    jaxpr: "jax.core.Jaxpr", path: Tuple[str, ...] = ()
+) -> Iterator[Tuple["jax.core.JaxprEqn", Tuple[str, ...]]]:
+    """(eqn, path) for every equation, depth-first through every nested
+    sub-jaxpr. `path` names the enclosing primitives (e.g.
+    ('scan', 'pjit')) so findings can say WHERE they sit."""
+    for eqn in jaxpr.eqns:
+        yield eqn, path
+        sub_path = path + (eqn.primitive.name,)
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, sub_path)
+
+
+def count_primitives(jaxpr, name: Optional[str] = None) -> int:
+    """Total equation count (or occurrences of primitive `name`)
+    including every nested sub-jaxpr."""
+    return sum(
+        1 for eqn, _ in iter_eqns(jaxpr) if name is None or eqn.primitive.name == name
+    )
+
+
+def count_full_ravels(jaxpr, n_total: int) -> int:
+    """Concatenates materializing a full [n_total] model buffer — the
+    per-step footprint of a pytree flatten (the arena op budget's unit;
+    under the vmap lift the buffer is [n_ranks, n_total], so the check
+    reads the TRAILING dim)."""
+    total = 0
+    for eqn, _ in iter_eqns(jaxpr):
+        if (
+            eqn.primitive.name == "concatenate"
+            and eqn.outvars[0].aval.shape
+            and eqn.outvars[0].aval.shape[-1] == n_total
+        ):
+            total += 1
+    return total
+
+
+def primitive_census(jaxpr) -> dict:
+    """{primitive name: count} over every nested equation — the
+    inventory view `tools/audit.py --census` prints."""
+    out: dict = {}
+    for eqn, _ in iter_eqns(jaxpr):
+        out[eqn.primitive.name] = out.get(eqn.primitive.name, 0) + 1
+    return out

@@ -7,8 +7,10 @@ per-rank buffer is BITWISE the tree path, across algorithms, wire
 dtypes, gossip wires, staleness, telemetry, and chaos delivery masks.
 Leaf metadata (`_leaf_meta` / ArenaSpec / `compact_capacity_floor`) is
 lru-cached per structure so no caller can re-derive it inside a traced
-step, and a jaxpr op-count budget keeps the per-step tree traversals
-from silently creeping back.
+step. The jaxpr op-count budget rides the shared nested-jaxpr walker
+(eventgrad_tpu/analysis/walker.py) — the same traversal the trace
+auditor (analysis/audit.py, tests/test_audit.py) uses for its ravel
+and hygiene checks, so the two gates can never drift apart.
 """
 
 import jax
@@ -20,6 +22,7 @@ import pytest
 from _spmd import requires_shard_map
 from jax.flatten_util import ravel_pytree
 
+from eventgrad_tpu.analysis import walker
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.datasets import synthetic_dataset
@@ -416,27 +419,8 @@ def test_leaf_meta_cache_hits():
 
 
 # ---------------------------------------------------------------------------
-# op-count regression gate (no timing — CI-stable jaxpr accounting)
-
-
-def _count_primitives(jaxpr, name=None):
-    """Total eqn count (or occurrences of primitive `name`) including
-    nested call/scan/cond jaxprs."""
-    total = 0
-    for eqn in jaxpr.eqns:
-        if name is None or eqn.primitive.name == name:
-            total += 1
-        for v in eqn.params.values():
-            for sub in jax.tree.leaves(
-                v, is_leaf=lambda x: isinstance(
-                    x, (jax.core.Jaxpr, jax.core.ClosedJaxpr)
-                )
-            ):
-                if isinstance(sub, jax.core.ClosedJaxpr):
-                    total += _count_primitives(sub.jaxpr, name)
-                elif isinstance(sub, jax.core.Jaxpr):
-                    total += _count_primitives(sub, name)
-    return total
+# op-count regression gate (no timing — CI-stable jaxpr accounting),
+# on the shared nested-jaxpr walker the trace auditor also uses
 
 
 def _step_jaxpr(arena_on):
@@ -451,30 +435,6 @@ def _step_jaxpr(arena_on):
     )
     batch = _batches(1)[0]
     return jax.make_jaxpr(spmd(step, topo))(state, batch)
-
-
-def _count_full_ravels(jaxpr, n_total):
-    """Concatenates that materialize a full [n_total] model buffer —
-    the per-step footprint of a pytree flatten."""
-    total = 0
-    for eqn in jaxpr.eqns:
-        if (
-            eqn.primitive.name == "concatenate"
-            and eqn.outvars[0].aval.shape
-            and eqn.outvars[0].aval.shape[-1] == n_total
-        ):
-            total += 1
-        for v in eqn.params.values():
-            for sub in jax.tree.leaves(
-                v, is_leaf=lambda x: isinstance(
-                    x, (jax.core.Jaxpr, jax.core.ClosedJaxpr)
-                )
-            ):
-                if isinstance(sub, jax.core.ClosedJaxpr):
-                    total += _count_full_ravels(sub.jaxpr, n_total)
-                elif isinstance(sub, jax.core.Jaxpr):
-                    total += _count_full_ravels(sub, n_total)
-    return total
 
 
 def test_arena_step_op_budget():
@@ -499,7 +459,7 @@ def test_arena_step_op_budget():
     # flattened model copy: the arena step gets exactly ONE — the wire
     # build, with the event mask fused into its pieces. A second one
     # means a per-step flatten crept back in.
-    rav_arena = _count_full_ravels(arena_jaxpr.jaxpr, n_total)
+    rav_arena = walker.count_full_ravels(arena_jaxpr.jaxpr, n_total)
     assert rav_arena <= 1, (
         f"arena step materializes {rav_arena} full-model concatenates — "
         "a per-step flatten crept back in (budget: the wire build only)"
@@ -507,13 +467,13 @@ def test_arena_step_op_budget():
     # concatenate total: the wire plus the [L]-vector stacks of the
     # trigger (norms, slope ring); a per-leaf traversal would add L
     # entries and blow this
-    cat_arena = _count_primitives(arena_jaxpr.jaxpr, "concatenate")
+    cat_arena = walker.count_primitives(arena_jaxpr.jaxpr, "concatenate")
     assert cat_arena <= 5, f"arena concatenate count grew to {cat_arena}"
     # whole-graph budget: the arena program stays strictly leaner than
     # the tree program it replaced (no separate mask pass, no
     # per-neighbor unravels, no duplicate flatten), with an absolute
     # ceiling for drift (measured 323 + slack)
-    n_arena = _count_primitives(arena_jaxpr.jaxpr)
-    n_tree = _count_primitives(tree_jaxpr.jaxpr)
+    n_arena = walker.count_primitives(arena_jaxpr.jaxpr)
+    n_tree = walker.count_primitives(tree_jaxpr.jaxpr)
     assert n_arena < n_tree, (n_arena, n_tree)
     assert n_arena <= 380, f"arena step grew to {n_arena} eqns"

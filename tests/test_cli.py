@@ -6,6 +6,7 @@ import json
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
 from eventgrad_tpu.cli import build_parser, main, parse_mesh
 
 
@@ -41,7 +42,16 @@ def test_torus_mesh_and_global_batch(capsys):
     assert [r["steps"] for r in recs if "epoch" in r] == [4, 4]
 
 
+@requires_shard_map
 def test_mesh_backend_matches_sim(capsys):
+    """REGRESSION NOTE: `--backend mesh` runs the shard_map lift, which
+    this environment's jax may not provide — unmarked, this test FAILED
+    standalone with AttributeError yet appeared to pass in full-suite
+    runs only because tier-1 (`-m 'not slow'`) deselects the whole
+    slow-marked test_cli module, so the standalone failure was invisible
+    to the gate and order/selection-dependent for everyone else. The
+    shared `requires_shard_map` marker makes the outcome identical in
+    every run mode (skip without shard_map, run with it)."""
     args = ["--algo", "eventgrad", "--mesh", "ring:8"] + BASE
     sim = _run(capsys, args + ["--backend", "sim"])
     mesh = _run(capsys, args + ["--backend", "mesh"])  # 8 virtual CPU devices

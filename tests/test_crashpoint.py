@@ -8,7 +8,6 @@ crash matrix (the full matrix lives in tools/crash_matrix.py and ships
 as the schema-gated artifacts/crash_matrix_cpu.json).
 """
 
-import glob
 import json
 import os
 import re
@@ -30,7 +29,6 @@ from eventgrad_tpu.train.loop import train
 from eventgrad_tpu.utils import checkpoint
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "eventgrad_tpu")
 
 
 @pytest.fixture(autouse=True)
@@ -123,38 +121,44 @@ def test_every_crashpoint_instrumented_exactly_once():
     hollow out the crash matrix silently, a duplicate would make "kill
     at site X" ambiguous — and every hit() call in the package uses a
     string literal naming a registered site (the lint can only count
-    what it can read)."""
-    sources = {}
-    for path in glob.glob(os.path.join(PKG, "**", "*.py"), recursive=True):
-        if os.path.basename(path) == "crashpoint.py":
-            continue
-        with open(path) as f:
-            sources[os.path.relpath(path, PKG)] = f.read()
+    what it can read). The walking/counting lives in the shared AST
+    lint framework (eventgrad_tpu/analysis/lint.py,
+    CrashpointInstrumented — the old grep plumbing, messages kept)."""
+    from eventgrad_tpu.analysis import lint
 
-    call_re = re.compile(r"crashpoint\.hit\(\s*(.)")
-    name_re = re.compile(r'crashpoint\.hit\(\s*"([^"]+)"')
-    used = {}
-    for rel, src in sources.items():
-        for m in call_re.finditer(src):
-            assert m.group(1) == '"', (
-                f"{rel}: crashpoint.hit() must take a string literal "
-                "(the instrumentation lint counts literal sites)"
-            )
-        for name in name_re.findall(src):
-            used.setdefault(name, []).append(rel)
+    offenders = lint.CrashpointInstrumented().check(
+        lint.collect_sources(REPO)
+    )
+    assert not offenders, "\n".join(str(v) for v in offenders)
 
-    unregistered = set(used) - set(crashpoint.SITES)
-    assert not unregistered, (
-        f"unregistered crashpoint names instrumented: {unregistered}"
-    )
-    dead = set(crashpoint.SITES) - set(used)
-    assert not dead, (
-        f"registered crashpoints with NO instrumented site: {dead}"
-    )
-    dupes = {n: fs for n, fs in used.items() if len(fs) > 1}
-    assert not dupes, (
-        f"crashpoints instrumented at more than one site: {dupes}"
-    )
+
+def test_crashpoint_lint_detects_seeded_violations():
+    """The framework rule can FIRE: a non-literal hit(), an
+    unregistered site name, and a duplicated site are each flagged
+    against a synthetic source set."""
+    from eventgrad_tpu.analysis import lint
+
+    sep = os.path.sep
+    real = lint.collect_sources(REPO)
+
+    def plus(text, name="seeded_bad.py"):
+        return real + [lint.SourceFile(
+            path="/" + name, rel=f"eventgrad_tpu{sep}{name}", text=text,
+        )]
+
+    rule = lint.CrashpointInstrumented()
+    msgs = "\n".join(str(v) for v in rule.check(
+        plus("import crashpoint\ncrashpoint.hit(site_var)\n")
+    ))
+    assert "string literal" in msgs
+    msgs = "\n".join(str(v) for v in rule.check(
+        plus('import crashpoint\ncrashpoint.hit("no.such.site")\n')
+    ))
+    assert "unregistered crashpoint names" in msgs
+    msgs = "\n".join(str(v) for v in rule.check(
+        plus('import crashpoint\ncrashpoint.hit("loop.block_end")\n')
+    ))
+    assert "more than one site" in msgs
 
 
 def test_marker_write_and_consume(tmp_path):

@@ -1,95 +1,136 @@
-"""Tier-1 lint: the shard_map skip-pattern must not spread.
+"""Tier-1 source lints, served by the shared AST lint framework.
 
-Some CPU-only environments run a jax without `jax.shard_map`, where the
-SEED's shard_map tests fail outright (the known pre-existing tier-1
-failures). Every test added SINCE skips instead — through the ONE
-`requires_shard_map` marker in tests/_spmd.py, so the condition and the
-reason string live in a single place while ROADMAP Open item 1
-(real-mesh SPMD: retire the single-chip vmap lift) is pending. This
-lint walks the test tree and enforces it:
-
-  * a test file that touches `shard_map` must import the shared marker
-    (no hand-rolled `pytest.mark.skipif(not hasattr(jax, "shard_map"))`
-    copies — ~10 of those accumulated across PRs 2-6 before the
-    consolidation);
-  * the three SEED files are exempt BY NAME: their shard_map tests
-    predate the helper and intentionally FAIL (not skip) in
-    shard_map-less environments — they are the recorded tier-1
-    baseline, and converting them would silently move it.
+The shard_map skip-pattern rules (the one `requires_shard_map` marker
+in tests/_spmd.py, no re-spelled skipifs, an honest seed-exemption
+list) and the package rules (exit-code literals confined to
+exitcodes.py, `os._exit` confined to chaos/crashpoint.py, no
+`block_until_ready`/`device_get` on traced paths) all live as `Rule`
+objects in eventgrad_tpu/analysis/lint.py — this file asserts the repo
+is clean rule by rule (so a failure names its rule) and proves each
+rule can actually FIRE by feeding it seeded-violation sources.  The
+grep plumbing that used to live here moved into the framework with the
+failure messages preserved; tests/test_crashpoint.py's instrumentation
+lint rides the same framework.
 """
 
 import os
-import re
 
-TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+from eventgrad_tpu.analysis import lint
 
-#: the seed's shard_map test files: the pre-existing tier-1 baseline
-#: failures in shard_map-less environments. Frozen — new entries mean
-#: new un-skipped debt, which is exactly what this lint exists to stop.
-SEED_EXEMPT = {
-    "test_collectives.py",
-    "test_ring_attention.py",
-    "test_train_equivalence.py",
-}
-
-_IMPORT_RE = re.compile(
-    r"^\s*from\s+_spmd\s+import\s+.*\brequires_shard_map\b", re.MULTILINE
-)
-# a hand-rolled respelling: a skipif whose condition mentions shard_map
-# (the helper file itself holds the one allowed instance)
-_RESPELL_RE = re.compile(r"skipif\s*\([^)]*shard_map", re.DOTALL)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _test_files():
-    this = os.path.basename(__file__)
-    for name in sorted(os.listdir(TESTS_DIR)):
-        if name == this:  # the lint's own docstrings quote the patterns
-            continue
-        if name.startswith("test_") and name.endswith(".py"):
-            with open(os.path.join(TESTS_DIR, name)) as f:
-                yield name, f.read()
+def _run_rule(rule, files=None):
+    return rule.check(files if files is not None else lint.collect_sources(REPO))
+
+
+def _fmt(violations):
+    return "\n".join(str(v) for v in violations)
+
+
+# --- the repo is clean, rule by rule ----------------------------------------
 
 
 def test_shard_map_tests_use_shared_marker():
     """Any non-seed test file touching shard_map imports the single
     `requires_shard_map` definition from tests/_spmd.py."""
-    offenders = [
-        name
-        for name, src in _test_files()
-        if "shard_map" in src
-        and name not in SEED_EXEMPT
-        and not _IMPORT_RE.search(src)
-    ]
-    assert not offenders, (
-        f"{offenders} touch shard_map without importing the shared "
-        "`requires_shard_map` marker from tests/_spmd.py (ROADMAP Open "
-        "item 1); add `from _spmd import requires_shard_map` instead of "
-        "re-spelling the skipif"
-    )
+    offenders = _run_rule(lint.ShardMapMarkerImport())
+    assert not offenders, _fmt(offenders)
 
 
 def test_no_respelled_shard_map_skipif():
     """Nobody — seed files included — re-spells the skipif condition:
     the definition lives in tests/_spmd.py and nowhere else."""
-    offenders = [
-        name for name, src in _test_files() if _RESPELL_RE.search(src)
-    ]
-    assert not offenders, (
-        f"{offenders} re-spell the shard_map skipif; use "
-        "`requires_shard_map` from tests/_spmd.py (single definition, "
-        "single reason string)"
-    )
+    offenders = _run_rule(lint.ShardMapRespell())
+    assert not offenders, _fmt(offenders)
 
 
 def test_seed_exemption_list_matches_reality():
     """The exemption list stays honest: every exempt file still exists
     and still touches shard_map (a renamed/retired file must leave the
     list, or the lint silently covers nothing)."""
-    for name in sorted(SEED_EXEMPT):
-        path = os.path.join(TESTS_DIR, name)
-        assert os.path.exists(path), f"exempt file {name} no longer exists"
-        with open(path) as f:
-            assert "shard_map" in f.read(), (
-                f"exempt file {name} no longer touches shard_map — drop "
-                "it from SEED_EXEMPT"
-            )
+    offenders = _run_rule(lint.ShardMapExemptHonest())
+    assert not offenders, _fmt(offenders)
+
+
+def test_exit_code_literals_confined():
+    """The process exit codes are a cross-process contract owned by
+    eventgrad_tpu/exitcodes.py; the package spells them by name."""
+    offenders = _run_rule(lint.ExitCodeLiterals())
+    assert not offenders, _fmt(offenders)
+
+
+def test_os_exit_confined():
+    """`os._exit` belongs to the crashpoint engine (one named, honesty-
+    checked exemption: train/loop.py's fault_inject hard-kill)."""
+    offenders = _run_rule(lint.OsExitConfined())
+    assert not offenders, _fmt(offenders)
+
+
+def test_no_host_sync_on_traced_paths():
+    """No block_until_ready/device_get in parallel/, ops/, or
+    train/steps.py — host round-trips the dispatch pipeline cannot
+    hide."""
+    offenders = _run_rule(lint.NoHostSyncInTraced())
+    assert not offenders, _fmt(offenders)
+
+
+def test_full_lint_run_clean():
+    """The aggregate entry point tools/audit.py pins in the artifact."""
+    violations = lint.run(root=REPO)
+    assert not violations, _fmt(violations)
+
+
+# --- and every rule can FIRE (seeded-violation oracles) ---------------------
+
+
+def _pkg_file(rel, text):
+    return lint.SourceFile(path="/" + rel, rel=rel, text=text)
+
+
+def test_rules_detect_seeded_violations():
+    sep = os.sep
+    bad_exit = _pkg_file(
+        f"eventgrad_tpu{sep}bad.py", "import sys\nsys.exit(77)\n"
+    )
+    bad_os_exit = _pkg_file(
+        f"eventgrad_tpu{sep}bad2.py", "import os\nos._exit(1)\n"
+    )
+    bad_sync = _pkg_file(
+        f"eventgrad_tpu{sep}parallel{sep}bad3.py",
+        "def f(x):\n    return x.block_until_ready()\n",
+    )
+    bad_marker = _pkg_file(
+        f"tests{sep}test_bad4.py",
+        "import jax\njax.shard_map\n",
+    )
+    bad_respell = _pkg_file(
+        f"tests{sep}test_bad5.py",
+        'import pytest, jax\n'
+        'm = pytest.mark.skipif(not hasattr(jax, "shard_map"), reason="x")\n',
+    )
+    assert _run_rule(lint.ExitCodeLiterals(), [bad_exit])
+    assert _run_rule(lint.OsExitConfined(), [bad_os_exit])
+    assert _run_rule(lint.NoHostSyncInTraced(), [bad_sync])
+    assert _run_rule(lint.ShardMapMarkerImport(), [bad_marker])
+    assert _run_rule(lint.ShardMapRespell(), [bad_respell])
+    # comments and docstrings never false-positive (the AST advantage
+    # over the old grep): 77 in prose is not a violation
+    ok_comment = _pkg_file(
+        f"eventgrad_tpu{sep}ok.py",
+        '"""exit 77 is the integrity abort."""\n# also 83 here\nX = 1\n',
+    )
+    assert not _run_rule(lint.ExitCodeLiterals(), [ok_comment])
+
+
+def test_exempt_file_exemption_stays_honest():
+    """train/loop.py's os._exit exemption covers EXACTLY one call — a
+    second one (or zero) is a violation again."""
+    sep = os.sep
+    rel = f"eventgrad_tpu{sep}train{sep}loop.py"
+    two = _pkg_file(rel, "import os\nos._exit(1)\nos._exit(2)\n")
+    zero = _pkg_file(rel, "X = 1\n")
+    assert _run_rule(lint.OsExitConfined(), [two])
+    assert _run_rule(lint.OsExitConfined(), [zero])
+    one = _pkg_file(rel, "import os\nos._exit(13)\n")
+    assert not _run_rule(lint.OsExitConfined(), [one])

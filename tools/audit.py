@@ -1,0 +1,135 @@
+"""Run the trace auditor + project lint and commit the audit artifact.
+
+Walks the full configuration matrix of the fused train step
+(eventgrad_tpu/analysis/audit.py: dpsgd/eventgrad/sp_eventgrad x
+masked|compact x arena on/off x obs/chaos/integrity on/off x wire
+dtypes), proving per cell: rank isolation (the only cross-rank flow is
+the declared neighbor exchange), wire-byte truth (jaxpr-derived bytes
+== accounting formula == the executed step's `sent_bytes_wire_real`,
+exactly), and step hygiene (no host callbacks, ravel budget, wire
+dtype fidelity, donation aliasing).  Then fires every seeded ORACLE
+violation to prove each check can detect its failure class, and runs
+the AST lint rules (analysis/lint.py) over the repo.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/audit.py [--out artifacts/audit_cpu.json]
+    JAX_PLATFORMS=cpu python tools/audit.py --census  # primitive inventory
+
+Exit 0 = every cell clean, every oracle detected, zero lint
+violations; 1 otherwise.  The committed artifacts/audit_cpu.json is
+schema-gated (AUDIT_SCHEMA in tools/validate_artifacts.py via
+tests/test_artifacts.py), so a regression in any invariant fails
+tier-1 twice: once in tests/test_audit.py, once at the artifact gate
+when the refreshed artifact stops matching.  See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--out", default=os.path.join(_ROOT, "artifacts", "audit_cpu.json")
+    )
+    ap.add_argument(
+        "--census", action="store_true",
+        help="print the primitive inventory of each config and exit",
+    )
+    args = ap.parse_args(argv)
+
+    from eventgrad_tpu.analysis import audit, lint, walker
+    from eventgrad_tpu.parallel.spmd import spmd
+
+    if args.census:
+        for cfg in audit.CONFIGS:
+            state, step, topo = audit.build(cfg)
+            closed = jax.make_jaxpr(spmd(step, topo))(state, audit._batch())
+            print(cfg.name, json.dumps(
+                walker.primitive_census(closed.jaxpr), sort_keys=True
+            ))
+        return 0
+
+    t0 = time.perf_counter()
+    configs = audit.audit_matrix(run_metric=True)
+    oracles = audit.run_oracles()
+    lint_violations = lint.run(root=_ROOT)
+    for v in lint_violations:
+        print(f"LINT {v}", file=sys.stderr)
+
+    n_clean = sum(1 for r in configs if audit.clean(r))
+    n_detected = sum(1 for o in oracles if o["detected"])
+    record = {
+        "bench": "audit",
+        "platform": jax.default_backend(),
+        "op_point": (
+            f"MLP(hidden={audit.MODEL['hidden']}) Ring({audit.N_RANKS}) "
+            f"compact_capacity={audit.CAPACITY}"
+        ),
+        "n_configs": len(configs),
+        "n_clean": n_clean,
+        "configs": [
+            {k: v for k, v in r.items() if k != "violation_details"}
+            | {"clean": audit.clean(r)}
+            for r in configs
+        ],
+        "n_oracles": len(oracles),
+        "n_detected": n_detected,
+        "oracles": oracles,
+        "lint_rules": len(lint.RULES),
+        "lint_violations": len(lint_violations),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    ok = (
+        n_clean == len(configs)
+        and n_detected == len(oracles)
+        and not lint_violations
+    )
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for r in configs:
+        mark = "CLEAN" if audit.clean(r) else "DIRTY"
+        print(
+            f"{mark} {r['name']}: violations={r['violations']} "
+            f"wire={r['wire_bytes_per_neighbor_derived']:.0f}B/nb "
+            f"(formula {r['wire_bytes_per_neighbor_formula']:.0f}, "
+            f"metric match {r['metric_match']}) "
+            f"ravel {r['ravel_count']}/{r['ravel_budget']} "
+            f"callbacks={r['callbacks']}"
+        )
+    for o in oracles:
+        mark = "DETECTED" if o["detected"] else "MISSED"
+        print(f"{mark} oracle {o['name']}: {o['reason']}")
+    print(
+        f"audit: {n_clean}/{len(configs)} configs clean, "
+        f"{n_detected}/{len(oracles)} oracles detected, "
+        f"{len(lint_violations)} lint violations, "
+        f"{record['wall_s']}s -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

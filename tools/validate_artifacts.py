@@ -459,9 +459,75 @@ CRASH_MATRIX_SCHEMA = {
     },
 }
 
+_AUDIT_CELL = {
+    "type": "object",
+    "required": [
+        "name", "algo", "clean", "violations", "wire_match",
+        "metric_match", "ravel_ok", "callbacks",
+        "wire_bytes_per_neighbor_derived",
+        "wire_bytes_per_neighbor_formula",
+    ],
+    "properties": {
+        "name": {"type": "string"},
+        "algo": {"enum": ["dpsgd", "eventgrad", "sp_eventgrad"]},
+        # every committed cell is CLEAN: zero rank-isolation
+        # violations, the jaxpr-derived wire bytes equal the accounting
+        # formula AND the executed step's sent_bytes_wire_real metric
+        # exactly, the ravel budget holds, no host callbacks
+        "clean": {"enum": [True]},
+        "violations": {"enum": [0]},
+        "wire_match": {"enum": [True]},
+        "metric_match": {"enum": [True]},
+        "ravel_ok": {"enum": [True]},
+        "callbacks": {"enum": [0]},
+        "wire_bytes_per_neighbor_derived": {"type": "number", "minimum": 0},
+        "wire_bytes_per_neighbor_formula": {"type": "number", "minimum": 0},
+    },
+}
+
+AUDIT_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "op_point", "n_configs", "n_clean",
+        "configs", "n_oracles", "n_detected", "oracles",
+        "lint_violations", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["audit"]},
+        "platform": {"type": "string"},
+        # the trace-auditor acceptance gates (ISSUE 9): the FULL config
+        # matrix (>= 10 cells covering dpsgd/eventgrad/sp x
+        # masked|compact x arena x obs/chaos/integrity) reports ZERO
+        # violations with exact wire-byte truth, EVERY seeded oracle
+        # violation (rank coupling, dtype upcast, extra ravel, byte-
+        # formula drift, host callback) is flagged, and the AST lint
+        # rules pass repo-wide
+        "n_configs": {"type": "integer", "minimum": 10},
+        "n_clean": {"type": "integer", "minimum": 10},
+        "configs": {"type": "array", "minItems": 10, "items": _AUDIT_CELL},
+        "n_oracles": {"type": "integer", "minimum": 5},
+        "n_detected": {"type": "integer", "minimum": 5},
+        "oracles": {
+            "type": "array",
+            "minItems": 5,
+            "items": {
+                "type": "object",
+                "required": ["name", "detected"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "detected": {"enum": [True]},
+                },
+            },
+        },
+        "lint_violations": {"enum": [0]},
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
+    ("audit_", AUDIT_SCHEMA),
     ("crash_matrix_", CRASH_MATRIX_SCHEMA),
     ("integrity_", INTEGRITY_SCHEMA),
     ("obs_report_", OBS_REPORT_SCHEMA),
