@@ -303,6 +303,12 @@ def main() -> None:
     # A/B knob of tools/bubble_decomposition.py). Training is bitwise-
     # identical either way — only the host schedule moves.
     bench_pipeline = os.environ.get("EG_BENCH_PIPELINE", "1") != "0"
+    # bucketed gossip schedule (train/steps.py bucketed=K): pipeline the
+    # per-bucket exchange under the update work — event legs only (the
+    # D-PSGD twin has no event exchange to bucket); EG_BENCH_BUCKETED=K
+    # turns it on, 0 (default) keeps the monolithic schedule. Training
+    # is bitwise-identical either way (tests/test_bucketed.py).
+    bench_bucketed = int(os.environ.get("EG_BENCH_BUCKETED", "0")) or None
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
@@ -323,7 +329,7 @@ def main() -> None:
     with obs_reg.span("cifar_eventgrad", cat="leg", tier=tier):
         state, hist = train(
             model, topo, x, y, algo="eventgrad", event_cfg=event_cfg,
-            registry=obs_reg, **common
+            registry=obs_reg, bucketed=bench_bucketed, **common
         )
     wall_event = time.perf_counter() - t0
     with obs_reg.span("eval_eventgrad", cat="leg"):
@@ -597,6 +603,13 @@ def main() -> None:
                 "host_bubble_frac": host_bubble_frac,
                 "pipeline": bench_pipeline,
                 "step_overhead_ratio": round(step_s / step_s_d, 4),
+                # bucketed gossip schedule: bucket count of the event
+                # leg (1 = monolithic) and its per-bucket wire split —
+                # the in-step comm/compute-overlap knob next to step_ms
+                "buckets": int(hist[-1].get("buckets", 1)),
+                "sent_bytes_wire_real_per_bucket": hist[-1].get(
+                    "sent_bytes_wire_real_per_bucket"
+                ),
                 # both legs ran with the flat-arena hot path? (the
                 # step_overhead_ratio acceptance metric is arena-on;
                 # EG_BENCH_ARENA=0 gives the legacy-tree comparison)

@@ -27,7 +27,7 @@ tunnel can wedge mid-run and the completed measurements must survive):
     asserted 0; on TPU the measured speedups land in
     eventgrad_tpu/ops/arena_tuning.json (the kernels' dispatch table).
 
-Usage: python bench_kernels.py [attn|fused|gossip|arena|all|tune]
+Usage: python bench_kernels.py [attn|fused|gossip|arena|bucketed|all|tune]
        [--seqs 512,1024,...]
        [--out FILE]   (appends each line to FILE as well as stdout)
 
@@ -500,6 +500,124 @@ def bench_arena():
                        "(interpret-mode timings are not dispatch evidence)"})
 
 
+def bench_bucketed(k_buckets=(2, 4, 8)):
+    """The bucketed fused tail vs the monolithic fused tail (ISSUE 10
+    satellite: the bucketed KERNEL path must earn its dispatch).
+
+    The bucketed gossip schedule with fused_sgd launches ONE
+    fused_mix_commit per bucket instead of one for the whole arena —
+    the many-launch regime the fused family measured as a loss on
+    trees. This leg proves the per-bucket decomposition BIT-EQUAL to
+    the monolithic call on the LeNetCifar geometry, times both, and on
+    TPU merges `bucketed_tail_speedup` (worst K) into
+    eventgrad_tpu/ops/arena_tuning.json — the entry
+    ops/arena_tuning.bucketed_tail_ok() gates on. No entry -> the step
+    falls back to the monolithic fused path instead of guessing."""
+    import os
+
+    from eventgrad_tpu.models import LeNetCifar
+    from eventgrad_tpu.ops import arena_update
+    from eventgrad_tpu.parallel import arena
+
+    on_tpu = jax.default_backend() == "tpu"
+    params = LeNetCifar().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    spec = arena.arena_spec(params)
+    flat = spec.ravel(params)
+    n = spec.n_total
+    k0 = jax.random.PRNGKey(7)
+    g, t, c0, c1, l0, l1 = (
+        jax.random.normal(jax.random.fold_in(k0, i), (n,)) for i in range(6)
+    )
+    keep0 = jax.random.uniform(jax.random.fold_in(k0, 8), (n,)) > 0.5
+    keep1 = jax.random.uniform(jax.random.fold_in(k0, 9), (n,)) > 0.3
+    # on CPU both sides run the jnp reference twin (interpret-mode
+    # Pallas timings are not dispatch evidence); on TPU both run the
+    # kernel — the ratio isolates the K-launch split either way
+    tail = (
+        (lambda *a, **kw: arena_update.fused_mix_commit(
+            *a, interpret=False, **kw))
+        if on_tpu else arena_update.mix_commit_reference
+    )
+
+    def mono(p, c0, c1, k0_, k1_, l0_, l1_, g_, t_):
+        return tail(p, (c0, c1), (k0_, k1_), (l0_, l1_), g_, t_,
+                    0.01, 0.9, 1 / 3)
+
+    jmono = jax.jit(mono)
+    ref = jmono(flat, c0, c1, keep0, keep1, l0, l1, g, t)
+    jax.block_until_ready(ref)
+    speed = {}
+    for K in k_buckets:
+        buckets = spec.buckets(K)
+
+        def bucketed(p, c0, c1, k0_, k1_, l0_, l1_, g_, t_, _bs=buckets):
+            outs = []
+            for b in _bs:
+                sl = slice(b.start, b.start + b.size)
+                outs.append(tail(
+                    p[sl], (c0[sl], c1[sl]), (k0_[sl], k1_[sl]),
+                    (l0_[sl], l1_[sl]), g_[sl], t_[sl], 0.01, 0.9, 1 / 3,
+                ))
+            return outs
+
+        jb = jax.jit(bucketed)
+        out = jb(flat, c0, c1, keep0, keep1, l0, l1, g, t)
+        jax.block_until_ready(out)
+        # bit-equality: the tail is elementwise per position, so the
+        # per-bucket split must reproduce the monolithic result exactly
+        for field in range(3):
+            mono_f = jax.tree.leaves(ref[field])
+            buck_f = [jax.tree.leaves(o[field]) for o in out]
+            cat = [
+                np.concatenate([np.asarray(x).reshape(-1) for x in grp])
+                for grp in zip(*buck_f)
+            ] if isinstance(ref[field], tuple) else [np.concatenate(
+                [np.asarray(o[field]) for o in out]
+            )]
+            for m, b_ in zip(
+                [np.asarray(x).reshape(-1) for x in mono_f]
+                if isinstance(ref[field], tuple) else
+                [np.asarray(ref[field])],
+                cat,
+            ):
+                assert np.array_equal(m, b_), "bucketed tail diverges"
+        tm = dict(iters=3, repeats=3) if not on_tpu else {}
+        ms_m = _time(jmono, flat, c0, c1, keep0, keep1, l0, l1, g, t, **tm)
+        ms_b = _time(jb, flat, c0, c1, keep0, keep1, l0, l1, g, t, **tm)
+        speed[K] = round(ms_m / ms_b, 3)
+        _emit({
+            "kernel": "bucketed_mix_commit", "config": f"LeNetCifar K={K}",
+            "bucketed_ms": round(ms_b, 3), "monolithic_ms": round(ms_m, 3),
+            "speedup": speed[K], "max_err": 0.0, "n_params": n,
+            "interpret_twin": not on_tpu,
+        })
+
+    if on_tpu:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "eventgrad_tpu", "ops", "arena_tuning.json")
+        try:
+            with open(path) as f:
+                table = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            table = {"platform": jax.devices()[0].device_kind}
+        # worst K of the sweep: the gate must hold for ANY configured K
+        table["bucketed_tail_speedup"] = min(speed.values())
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        _emit({"tuned": path,
+               "bucketed_tail_speedup": table["bucketed_tail_speedup"]})
+    else:
+        _emit({"tuned": None,
+               "note": "non-TPU platform: arena_tuning.json not written "
+                       "(the bucketed fused tail stays demoted to the "
+                       "monolithic path until a chip measures it)"})
+
+
 def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
     """Per-shape block sweep -> eventgrad_tpu/ops/flash_tuning.json."""
     import os
@@ -614,10 +732,11 @@ def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = args[0] if args and not args[0].startswith("--") else "all"
-    if which not in ("attn", "fused", "gossip", "arena", "all", "tune"):
+    if which not in ("attn", "fused", "gossip", "arena", "bucketed",
+                     "all", "tune"):
         raise SystemExit(
             f"unknown selector {which!r}: attn | fused | gossip | arena | "
-            "all | tune"
+            "bucketed | all | tune"
         )
     seqs = (512, 1024, 2048, 4096)
     for i, a in enumerate(args):
@@ -637,5 +756,7 @@ if __name__ == "__main__":
         bench_fused_update()
     if which in ("arena", "all"):
         bench_arena()
+    if which in ("bucketed", "all"):
+        bench_bucketed()
     if which in ("gossip", "all"):
         bench_gossip_wire()

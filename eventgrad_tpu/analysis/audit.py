@@ -72,6 +72,10 @@ CFG = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2,
                   max_silence=4)
 #: fits Dense_0's kernel+bias, defers the second layer when all fire
 CAPACITY = 1100
+#: bucketed compact cells need sum(per-bucket floors) <= capacity; with
+#: K=4 on the 4-leaf MLP every leaf is its own bucket, so the floor is
+#: the full model (collectives.bucketed_capacity_floor)
+BUCKETED_CAPACITY = 1210
 
 _ITEMSIZE = {
     "float32": 4.0, "bfloat16": 2.0, "float16": 2.0, "int8": 1.0,
@@ -96,6 +100,10 @@ class AuditConfig:
     chaos: bool = False
     integrity: bool = False
     staleness: int = 0
+    #: bucketed gossip schedule (train/steps.py bucketed=): 0 = off;
+    #: K >= 2 splits every exchange into K leaf-aligned bucket wires,
+    #: each with its own declared-offset ppermute lanes
+    bucketed: int = 0
     #: full-model concatenates allowed in the traced step (the arena
     #: contract is ONE — the fused wire build; the tree paths pay one
     #: ravel_pytree per exchange family; sp's per-leaf top-k never
@@ -128,6 +136,13 @@ CONFIGS: Tuple[AuditConfig, ...] = (
     AuditConfig("event_compact_bf16_arena_stale", gossip_wire="compact",
                 capacity=CAPACITY, wire="bf16", arena=True, staleness=1),
     AuditConfig("sp_f32_tree", algo="sp_eventgrad"),
+    # bucketed gossip schedule (ISSUE 10): the auditor must see K
+    # declared-offset ppermute lane groups per neighbor and the SAME
+    # three-way wire-byte equality, summed over buckets
+    AuditConfig("event_masked_f32_arena_b4", arena=True, bucketed=4),
+    AuditConfig("event_compact_int8_arena_b4", gossip_wire="compact",
+                capacity=BUCKETED_CAPACITY, wire="int8", arena=True,
+                bucketed=4),
 )
 
 
@@ -158,7 +173,8 @@ def build(cfg: AuditConfig):
     tx = optax.sgd(0.05)
     chaos = ChaosSchedule(seed=3, drop_p=0.4) if cfg.chaos else None
     state = init_train_state(
-        model, IN_SHAPE, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena
+        model, IN_SHAPE, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
+        bucketed=cfg.bucketed or 1,
     )
     if chaos is not None:
         state = state.replace(
@@ -179,6 +195,7 @@ def build(cfg: AuditConfig):
         staleness=cfg.staleness, obs=cfg.obs, chaos=chaos,
         arena=cfg.arena,
         integrity=IntegrityConfig() if cfg.integrity else None,
+        bucketed=cfg.bucketed or None,
     )
     return state, step, topo
 
@@ -196,11 +213,42 @@ def _meta(state):
 # --- wire classification ----------------------------------------------------
 
 
-def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int):
+def _bucket_info(cfg: AuditConfig, state):
+    """(buckets, caps) of a bucketed cell, None otherwise — the same
+    ArenaSpec.buckets/split_capacity the step itself runs, so the
+    expected lanes and formula can never drift from the program."""
+    if not cfg.bucketed or cfg.bucketed < 2:
+        return None
+    from eventgrad_tpu.parallel import arena as arena_lib
+
+    params = jax.tree.map(lambda x: x[0], state.params)
+    buckets = arena_lib.arena_spec(params).buckets(cfg.bucketed)
+    caps = (
+        collectives.split_capacity(cfg.capacity, buckets)
+        if cfg.gossip_wire == "compact" else None
+    )
+    return buckets, caps
+
+
+def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int,
+                    binfo=None):
     """[(role, elems, dtype)] one neighbor's exchange must ship; riders
-    are transfer metadata documented OUTSIDE the wire-byte formula."""
+    are transfer metadata documented OUTSIDE the wire-byte formula.
+    Bucketed cells expect K lane GROUPS per neighbor — one value lane
+    (bucket elems or its capacity split) + one fire vector (+ one int8
+    scale vector) per bucket."""
     if cfg.algo == "sp_eventgrad":
         return None  # per-leaf top-k lanes: totals-only comparison
+    if binfo is not None:
+        buckets, caps = binfo
+        lanes = []
+        for i, b in enumerate(buckets):
+            val_elems = b.size if caps is None else caps[i]
+            lanes.append(("value", val_elems, _WIRE_DTYPE[cfg.wire]))
+            lanes.append(("fire", b.n_leaves, "bool"))
+            if cfg.wire == "int8":
+                lanes.append(("scale", b.n_leaves, "float32"))
+        return lanes, []
     val_elems = (
         cfg.capacity if cfg.gossip_wire == "compact" else n_params
     )
@@ -214,14 +262,21 @@ def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int):
 
 
 def _formula_bytes_per_neighbor(
-    cfg: AuditConfig, n_params: int, n_leaves: int, k_total: int
+    cfg: AuditConfig, n_params: int, n_leaves: int, k_total: int,
+    binfo=None,
 ) -> float:
     """The SHIPPED accounting formula the metric is built from — what
-    the jaxpr-derived truth is checked against."""
+    the jaxpr-derived truth is checked against. Bucketed cells sum the
+    per-bucket formula (the step's own definition)."""
     if cfg.algo == "sp_eventgrad":
         val = collectives.WIRE_VAL_BYTES[cfg.wire]
         scale = 4.0 if cfg.wire == "int8" else 0.0
         return (val + 4.0) * k_total + 1.0 * n_leaves + scale * n_leaves
+    if binfo is not None:
+        buckets, caps = binfo
+        return float(sum(collectives.bucketed_wire_real_bytes_per_neighbor(
+            buckets, cfg.wire, caps
+        )))
     return collectives.wire_real_bytes_per_neighbor(
         n_params, n_leaves, cfg.wire,
         compact_capacity=(
@@ -236,6 +291,7 @@ def _classify_exchanges(
     report: rankflow.RankFlowReport,
     n_params: int,
     n_leaves: int,
+    binfo=None,
 ) -> Dict[str, Any]:
     """Group the detected exchange lanes by ring offset and check them
     against the expected wire format; returns per-neighbor derived
@@ -246,7 +302,7 @@ def _classify_exchanges(
     problems: List[str] = []
     per_offset_bytes: Dict[int, float] = {}
     rider_bytes: Dict[int, float] = {}
-    expected = _expected_lanes(cfg, n_params, n_leaves)
+    expected = _expected_lanes(cfg, n_params, n_leaves, binfo)
     for off, lanes in groups.items():
         got = sorted((e.lane_elems, e.dtype) for e in lanes)
         if expected is None:
@@ -408,11 +464,14 @@ def audit_config(
     ]
 
     declared = sorted(nb.offset for nb in topo.neighbors)
-    wire = _classify_exchanges(cfg, report, n_params, n_leaves)
+    binfo = _bucket_info(cfg, state)
+    wire = _classify_exchanges(cfg, report, n_params, n_leaves, binfo)
     undeclared_offsets = sorted(set(wire["offsets"]) - set(declared))
     missing_offsets = sorted(set(declared) - set(wire["offsets"]))
 
-    formula = _formula_bytes_per_neighbor(cfg, n_params, n_leaves, k_total)
+    formula = _formula_bytes_per_neighbor(
+        cfg, n_params, n_leaves, k_total, binfo
+    )
     derived_each = list(wire["per_offset_bytes"].values())
     derived_total = float(sum(derived_each))
     wire_match = (
@@ -447,6 +506,7 @@ def audit_config(
         "chaos": cfg.chaos,
         "integrity": cfg.integrity,
         "staleness": cfg.staleness,
+        "bucketed": int(cfg.bucketed),
         "n_params": int(n_params),
         "n_leaves": int(n_leaves),
         "violations": len(violations),
@@ -568,8 +628,11 @@ def _audit_lifted(cfg, lifted, state, batch, run_metric=False):
     report = rankflow.analyze(closed, N_RANKS)
     topo = Ring(N_RANKS)
     declared = sorted(nb.offset for nb in topo.neighbors)
-    wire = _classify_exchanges(cfg, report, n_params, n_leaves)
-    formula = _formula_bytes_per_neighbor(cfg, n_params, n_leaves, k_total)
+    binfo = _bucket_info(cfg, state)
+    wire = _classify_exchanges(cfg, report, n_params, n_leaves, binfo)
+    formula = _formula_bytes_per_neighbor(
+        cfg, n_params, n_leaves, k_total, binfo
+    )
     derived_total = float(sum(wire["per_offset_bytes"].values()))
     out = {
         "violations": len(report.violations) + len(report.psums),
@@ -707,8 +770,34 @@ def oracle_host_callback() -> Tuple[bool, str]:
     return rep["callbacks"] > 0, f"{rep['callbacks']} host callbacks"
 
 
+def oracle_bucket_undeclared_offset() -> Tuple[bool, str]:
+    """One BUCKET's wire lane re-shipped at an undeclared offset (+2)
+    in the bucketed schedule — per-bucket exchanges must stay on the
+    topology's declared offsets like every monolithic lane (ISSUE 10's
+    seeded oracle leg)."""
+    cfg = config_by_name("event_masked_f32_arena_b4")
+    state, step, topo = build(cfg)
+
+    def bad(state, batch):
+        ns, m = step(state, batch)
+        m = dict(m)
+        # neighbor 0's bucket-1 receive buffer, shipped off-ring
+        m["leak"] = lax.ppermute(
+            ns.event.bufs[0][1], topo.axes[0],
+            [((r + 2) % N_RANKS, r) for r in range(N_RANKS)],
+        )
+        return ns, m
+
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch())
+    detected = bool(rep["undeclared_offsets"]) or bool(rep["wire_problems"])
+    return detected, (
+        f"undeclared exchange offsets {rep['undeclared_offsets']}"
+    )
+
+
 ORACLES = {
     "rank_coupling_ppermute": oracle_rank_coupling,
+    "bucket_undeclared_offset": oracle_bucket_undeclared_offset,
     "rank_coupling_roll": oracle_rank_roll,
     "wire_dtype_upcast": oracle_wire_dtype_upcast,
     "extra_full_ravel": oracle_extra_ravel,

@@ -69,6 +69,94 @@ def count_full_ravels(jaxpr, n_total: int) -> int:
     return total
 
 
+def bucket_schedule(jaxpr, wire_dims, commit_dims) -> dict:
+    """Machine-check of the bucketed gossip schedule's emission order
+    (ISSUE 10 acceptance gate): in the vmap-lifted bucketed step's
+    jaxpr, at least one EXCHANGE-side op of bucket k must appear
+    between UPDATE-side ops of buckets k-1 and k+1 — the exchanges
+    interleave with the update work instead of forming one prefix
+    block (the monolithic shape).
+
+    Detection (structural signatures of the vmap lift, where every
+    per-rank array is [n_ranks, dim]):
+      * exchange-side: a `gather` whose output shape equals its operand
+        shape — the ROW PERMUTATION `lax.ppermute` lowers to under vmap
+        — whose index operand has shape (n_ranks, 1) (one source row
+        per rank), with trailing dim == wire_dims[b]: the bucket's
+        value lane. Data-dependent unpack/expansion gathers carry
+        per-POSITION indices ([dim, 1]) and never match.
+      * update-side: a rank-batched ([n_ranks, dim], ndim == 2)
+        `select_n` with trailing dim == commit_dims[b] — the buffer
+        commit's `where(eff[seg], cand, stale)` — that appears AFTER
+        the bucket's first exchange op. The temporal filter is what
+        makes the attribution sound on single-leaf buckets: a commit
+        consumes the exchange's output and can never precede it, while
+        the wire-build mask `where(fire_k, leaf, 0)` (leaf-sized, so
+        it collides with the commit dim exactly when the bucket is one
+        leaf) is an exchange INPUT and always precedes it — so a
+        prefix-block emission keeps zero update ops between exchanges
+        and cannot false-pass the gate.
+
+    `wire_dims` / `commit_dims` are the per-bucket trailing dims of the
+    value lane and the commit select; each list must be collision-free
+    (pairwise distinct) or the attribution is refused. Returns
+    {"exchange": {b: [ordinal, ...]}, "update": {...},
+    "interleaved": bool, "witnesses": [(k, ordinal), ...]}."""
+    wire_dims = [int(d) for d in wire_dims]
+    commit_dims = [int(d) for d in commit_dims]
+    if len(set(wire_dims)) != len(wire_dims):
+        raise ValueError(f"wire_dims collide: {wire_dims}")
+    if len(set(commit_dims)) != len(commit_dims):
+        raise ValueError(f"commit_dims collide: {commit_dims}")
+    n_buckets = len(wire_dims)
+    ex: dict = {b: [] for b in range(n_buckets)}
+    upd: dict = {b: [] for b in range(n_buckets)}
+    for ordinal, (eqn, _path) in enumerate(iter_eqns(jaxpr)):
+        name = eqn.primitive.name
+        out_aval = eqn.outvars[0].aval
+        shape = tuple(getattr(out_aval, "shape", ()) or ())
+        if len(shape) != 2:
+            continue
+        if name == "gather" and len(eqn.invars) >= 2:
+            in_aval = eqn.invars[0].aval
+            idx_shape = tuple(
+                getattr(getattr(eqn.invars[1], "aval", None), "shape", ())
+                or ()
+            )
+            if (
+                shape == tuple(in_aval.shape)
+                and idx_shape == (shape[0], 1)
+                and shape[-1] in wire_dims
+            ):
+                ex[wire_dims.index(shape[-1])].append(ordinal)
+        elif name == "select_n" and shape[-1] in commit_dims:
+            upd[commit_dims.index(shape[-1])].append(ordinal)
+    # temporal soundness filter (docstring): only selects AFTER the
+    # bucket's first exchange can be its commit — wire-build masks
+    # (which may share the dim on single-leaf buckets) precede it
+    for b in range(n_buckets):
+        if ex[b]:
+            first_ex = min(ex[b])
+            upd[b] = [o for o in upd[b] if o > first_ex]
+        else:
+            upd[b] = []
+    witnesses = []
+    for k in range(1, n_buckets - 1):
+        if not (ex[k] and upd[k - 1] and upd[k + 1]):
+            continue
+        lo, hi = min(upd[k - 1]), max(upd[k + 1])
+        for e in ex[k]:
+            if lo < e < hi:
+                witnesses.append((k, e))
+                break
+    return {
+        "exchange": ex,
+        "update": upd,
+        "interleaved": bool(witnesses),
+        "witnesses": witnesses,
+    }
+
+
 def primitive_census(jaxpr) -> dict:
     """{primitive name: count} over every nested equation — the
     inventory view `tools/audit.py --census` prints."""

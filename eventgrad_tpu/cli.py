@@ -230,6 +230,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "dpsgd/eventgrad on plain data-parallel "
                         "topologies; off = legacy tree path (the A/B "
                         "knob of tools/overhead_ablation.py)")
+    p.add_argument("--bucketed", type=int, default=0, metavar="K",
+                   help="bucketed gossip schedule (train/steps.py): "
+                        "segment the flat arena into K leaf-aligned "
+                        "buckets and pipeline each bucket's gate/pack/"
+                        "exchange/commit/mix so the scheduler can "
+                        "overlap one bucket's transfer with another's "
+                        "update work — bitwise-identical training "
+                        "(tests/test_bucketed.py). eventgrad (needs "
+                        "the arena) and sp_eventgrad; 0/1 = monolithic "
+                        "(the default)")
     p.add_argument("--pipeline", choices=["auto", "on", "off"],
                    default="auto",
                    help="zero-bubble dispatch pipeline (train/loop.py): "
@@ -644,6 +654,7 @@ def main(argv=None) -> int:
                     membership=membership, integrity=integrity_cfg,
                     obs=args.obs, registry=registry,
                     arena={"auto": None, "on": True, "off": False}[args.arena],
+                    bucketed=args.bucketed or None,
                     pipeline={
                         "auto": None, "on": True, "off": False
                     }[args.pipeline],

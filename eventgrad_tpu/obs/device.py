@@ -41,9 +41,17 @@ class TelemetryState(struct.PyTreeNode):
     # migration path (train/loop.py restore_with_fill).
     wire_reject: jnp.ndarray = None    # type: ignore[assignment]  # i32 [n_edges]
     quarantined: jnp.ndarray = None    # type: ignore[assignment]  # i32 []
+    # per-bucket wire-real bytes of the bucketed gossip schedule
+    # (train/steps.py bucketed=); [1] on the monolithic path, so the
+    # sum always reconciles with edge_bytes' total. Defaulted like the
+    # integrity counters so pre-bucket snapshots restore via the
+    # known-added migration path.
+    bucket_bytes: jnp.ndarray = None   # type: ignore[assignment]  # f32 [n_buckets]
 
     @classmethod
-    def init(cls, n_leaves: int, n_edges: int) -> "TelemetryState":
+    def init(
+        cls, n_leaves: int, n_edges: int, n_buckets: int = 1,
+    ) -> "TelemetryState":
         zl = jnp.zeros((n_leaves,), jnp.float32)
         return cls(
             steps=jnp.zeros((), jnp.int32),
@@ -57,6 +65,7 @@ class TelemetryState(struct.PyTreeNode):
             edge_bytes=jnp.zeros((n_edges,), jnp.float32),
             wire_reject=jnp.zeros((n_edges,), jnp.int32),
             quarantined=jnp.zeros((), jnp.int32),
+            bucket_bytes=jnp.zeros((max(1, n_buckets),), jnp.float32),
         )
 
 
@@ -82,6 +91,7 @@ def accumulate(
     edge_bytes: Optional[jnp.ndarray] = None,    # f32 [n_edges] this pass
     wire_reject: Optional[jnp.ndarray] = None,   # bool/i32 [n_edges]
     quarantined: Optional[jnp.ndarray] = None,   # bool/i32 []
+    bucket_bytes: Optional[jnp.ndarray] = None,  # f32 [n_buckets] this pass
 ) -> TelemetryState:
     """One pass of counter updates; omitted (None) quantities leave their
     counters untouched (the non-event algorithms pass only edge_bytes).
@@ -110,6 +120,8 @@ def accumulate(
         upd["wire_reject"] = tel.wire_reject + wire_reject.astype(jnp.int32)
     if quarantined is not None:
         upd["quarantined"] = tel.quarantined + quarantined.astype(jnp.int32)
+    if bucket_bytes is not None:
+        upd["bucket_bytes"] = tel.bucket_bytes + bucket_bytes
     return tel.replace(**upd)
 
 
@@ -163,4 +175,11 @@ def window_record(cur, prev=None):
             int(v) for v in d("wire_reject").sum(axis=0)
         ]
         rec["quarantined_steps"] = int(d("quarantined").sum())
+    if cur.bucket_bytes is not None:
+        # bucketed-schedule rider (known-added like the integrity
+        # counters): per-bucket wire-real bytes per pass, rank mean
+        rec["bucket_bytes_per_step"] = [
+            round(float(v), 2)
+            for v in d("bucket_bytes").mean(axis=0) / denom
+        ]
     return rec

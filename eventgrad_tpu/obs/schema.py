@@ -87,6 +87,13 @@ TELEMETRY_FIELDS = {
         "passes this rank spent quarantined (non-finite local gradients "
         "or post-update parameters: update skipped, sends suppressed)",
     ),
+    "bucket_bytes": (
+        "bytes[bucket]", "gossip algos",
+        "per-bucket wire-real bytes accumulated under the bucketed "
+        "gossip schedule (train(bucketed=K)); [1] on the monolithic "
+        "path — the sum always equals the edge_bytes total (see "
+        "docs/ARCHITECTURE.md 'Bucketed gossip schedule')",
+    ),
 }
 
 #: Host-side `obs` block attached to block-end history records
@@ -136,6 +143,12 @@ RECORD_FIELDS = {
     "quarantined_steps": (
         "rank-passes", "integrity runs",
         "quarantined rank-passes in this flush window, summed over ranks",
+    ),
+    "bucket_bytes_per_step": (
+        "bytes[bucket]", "gossip algos",
+        "per-bucket wire-real bytes per pass (rank mean) — the bucketed "
+        "gossip schedule's wire split; a single entry on the "
+        "monolithic path",
     ),
 }
 

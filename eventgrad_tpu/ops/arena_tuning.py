@@ -54,3 +54,21 @@ def mix_commit_ok() -> bool:
         return True
     ratio = _table().get("mix_commit_speedup")
     return ratio is None or float(ratio) >= 1.0
+
+
+def bucketed_tail_ok() -> bool:
+    """Run the fused commit+mix+SGD tail PER BUCKET under the bucketed
+    gossip schedule (train/steps.py bucketed= + fused_sgd)?
+
+    The per-bucket form launches K kernels instead of one — the
+    many-launch regime the fused family measured as a LOSS on trees
+    (ops/fused_tuning.py), so it must earn its place with a measured
+    `bucketed_tail_speedup` entry (written by `bench_kernels.py
+    bucketed` on the active device). No table / no entry -> False: an
+    unmeasured shape falls back to the MONOLITHIC fused path instead of
+    guessing (train/loop.py demotes bucketed to K=1 with a warning
+    there). EG_FORCE_ARENA_PALLAS=1 overrides for manual experiments."""
+    if os.environ.get("EG_FORCE_ARENA_PALLAS") == "1":
+        return True
+    ratio = _table().get("bucketed_tail_speedup")
+    return ratio is not None and float(ratio) >= 1.0

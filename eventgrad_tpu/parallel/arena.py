@@ -121,6 +121,98 @@ class ArenaSpec:
         ]
         return jax.tree.unflatten(self.treedef, leaves)
 
+    def buckets(self, k: int) -> "Tuple[BucketSpec, ...]":
+        """Segment the arena into up to `k` contiguous LEAF-ALIGNED
+        buckets (the bucketed gossip schedule's unit, train/steps.py).
+
+        Boundaries sit on leaf edges — no leaf ever straddles a bucket,
+        so every bucket is itself a small arena (its own sizes/starts/
+        floor) and the per-bucket wire, commit, and mix operate on whole
+        leaves exactly like the monolithic path. Cut points are chosen
+        element-balanced (each interior cut lands on the leaf edge
+        nearest i*n_total/k), `k` clamps to the leaf count, and the
+        result is lru-cached per (spec, k) like every other piece of
+        leaf metadata — callers may re-derive freely inside a traced
+        step."""
+        return _buckets_cached(self, int(k))
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One contiguous leaf-aligned segment of an arena.
+
+    `lo`/`hi` are leaf indices into the parent ArenaSpec (half-open),
+    `start`/`size` the element range, `sizes`/`starts_rel` the
+    bucket-local leaf layout (starts_rel[0] == 0), and `floor` the
+    largest leaf — the smallest legal per-bucket compact capacity
+    (collectives.split_capacity)."""
+
+    index: int
+    lo: int
+    hi: int
+    start: int
+    size: int
+    sizes: Tuple[int, ...]
+    starts_rel: Tuple[int, ...]
+    floor: int
+
+    @property
+    def n_leaves(self) -> int:
+        return self.hi - self.lo
+
+    def sizes_arr(self) -> jnp.ndarray:
+        return jnp.asarray(self.sizes, jnp.int32)
+
+    def starts_arr(self) -> jnp.ndarray:
+        return jnp.asarray(self.starts_rel, jnp.int32)
+
+    def seg_expand(self) -> jnp.ndarray:
+        """[size] int32 bucket-local leaf index per flat position — the
+        bucket's slice of the parent seg map, re-based to 0."""
+        return jnp.repeat(
+            jnp.arange(self.n_leaves, dtype=jnp.int32),
+            self.sizes_arr(),
+            total_repeat_length=self.size,
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def _buckets_cached(spec: ArenaSpec, k: int) -> Tuple[BucketSpec, ...]:
+    n_leaves = spec.n_leaves
+    k = max(1, min(int(k), n_leaves))
+    ends = [s + z for s, z in zip(spec.starts, spec.sizes)]
+    cuts = []
+    prev = 0
+    for i in range(1, k):
+        target = i * spec.n_total / k
+        # the leaf edge nearest the element-balanced target, constrained
+        # so every remaining bucket keeps at least one leaf (ties break
+        # toward the earlier edge — deterministic)
+        lo_c, hi_c = prev + 1, n_leaves - (k - i)
+        best = min(
+            range(lo_c, hi_c + 1),
+            key=lambda c: (abs(ends[c - 1] - target), c),
+        )
+        cuts.append(best)
+        prev = best
+    bounds = [0] + cuts + [n_leaves]
+    out = []
+    for b in range(k):
+        lo, hi = bounds[b], bounds[b + 1]
+        sizes = spec.sizes[lo:hi]
+        base = spec.starts[lo]
+        out.append(BucketSpec(
+            index=b,
+            lo=lo,
+            hi=hi,
+            start=base,
+            size=int(sum(sizes)),
+            sizes=sizes,
+            starts_rel=tuple(s - base for s in spec.starts[lo:hi]),
+            floor=max(sizes),
+        ))
+    return tuple(out)
+
 
 @functools.lru_cache(maxsize=256)
 def _spec_cached(

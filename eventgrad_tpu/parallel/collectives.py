@@ -437,6 +437,57 @@ def compact_capacity_floor(sizes) -> int:
     return _capacity_floor_cached(tuple(int(s) for s in sizes))
 
 
+def bucketed_capacity_floor(buckets) -> int:
+    """Smallest legal TOTAL compact capacity under a bucketed schedule:
+    every bucket must be able to ship its own largest leaf whole, so the
+    floor is the SUM of per-bucket floors — strictly above the monolithic
+    floor whenever K > 1 (the price of bucket-local budgets; see
+    docs/compaction.md)."""
+    return int(sum(b.floor for b in buckets))
+
+
+def split_capacity(capacity: int, buckets) -> Tuple[int, ...]:
+    """Split a total compact capacity into per-bucket static budgets.
+
+    Element-proportional shares with two invariants: each bucket gets at
+    least its own floor (largest leaf in the bucket — a smaller budget
+    could never ship that leaf and would starve it), and the splits SUM
+    EXACTLY to `capacity` (largest-remainder rounding), so the bucketed
+    wire moves the same total value lanes the monolithic wire would.
+    Deterministic in (capacity, bucket layout) — both static, so the
+    split is part of the compiled program, never a recompile source.
+    Raises when sum(floors) > capacity: the bucketed schedule needs at
+    least `bucketed_capacity_floor` elements."""
+    capacity = int(capacity)
+    floors = [int(b.floor) for b in buckets]
+    if sum(floors) > capacity:
+        raise ValueError(
+            f"compact capacity {capacity} is below the bucketed floor "
+            f"{sum(floors)} (sum of per-bucket largest leaves): some "
+            "bucket's largest leaf could never ship and would starve — "
+            "raise the capacity or lower the bucket count"
+        )
+    total = sum(int(b.size) for b in buckets)
+    raw = [capacity * int(b.size) / total for b in buckets]
+    caps = [max(f, int(r)) for f, r in zip(floors, raw)]
+    rem = capacity - sum(caps)
+    # largest fractional remainder first; deterministic tie-break on index
+    order = sorted(
+        range(len(caps)), key=lambda i: (-(raw[i] - int(raw[i])), i)
+    )
+    j = 0
+    while rem != 0:
+        i = order[j % len(order)]
+        if rem > 0:
+            caps[i] += 1
+            rem -= 1
+        elif caps[i] > floors[i]:
+            caps[i] -= 1
+            rem += 1
+        j += 1
+    return tuple(caps)
+
+
 def choose_capacity(
     n_params: int,
     max_fired_elems: float,
@@ -645,6 +696,29 @@ def wire_real_bytes_per_neighbor(
     if wire == "int8":
         b += 4.0 * n_leaves
     return b
+
+
+def bucketed_wire_real_bytes_per_neighbor(
+    buckets, wire=None, caps: "Optional[Tuple[int, ...]]" = None,
+) -> Tuple[float, ...]:
+    """Per-bucket wire truth of the bucketed gossip schedule: bucket b's
+    exchange ships its value lanes (`caps[b]` on the compact wire, the
+    bucket's element count otherwise) plus its own fire-bit vector (and
+    int8 scale vector). ONE definition shared by the step's
+    `sent_bytes_wire_real` metric, the per-bucket metric vector, and the
+    trace auditor's expected-lane formula (analysis/audit.py) — lanes ==
+    formula == executed, summed over buckets. The masked sum equals the
+    monolithic number exactly (same value elements, same [L] fire/scale
+    vectors, just segmented); the compact sum equals it whenever
+    `split_capacity` preserved the total (it always does)."""
+    out = []
+    for i, b in enumerate(buckets):
+        out.append(wire_real_bytes_per_neighbor(
+            int(b.size), b.n_leaves, wire,
+            compact_capacity=None if caps is None else int(caps[i]),
+            fire_bits=True,
+        ))
+    return tuple(out)
 
 
 def fired_wire_bytes_per_neighbor(
@@ -998,6 +1072,141 @@ def compact_neighbor_vals_flat(
         oks.append(ok)
     if integrity:
         return tuple(cands), tuple(effs), tuple(raws), jnp.stack(oks)
+    return tuple(cands), tuple(effs), tuple(raws)
+
+
+# ---------------------------------------------------------------------------
+# bucketed exchange family: one leaf-aligned bucket of the arena per
+# call (parallel/arena.py BucketSpec), the same wire semantics as the
+# flat functions above — each bucket's lanes are bitwise the bucket's
+# slice of the monolithic wire, so the K-bucket schedule reproduces the
+# monolithic step exactly (tests/test_bucketed.py). Integrity riders are
+# whole-wire contracts and stay monolithic-only (train/steps.py guards).
+
+def masked_neighbor_vals_bucket(
+    leaves,
+    fire_vec: jnp.ndarray,
+    topo: Topology,
+    bucket: "arena.BucketSpec",
+    dtype,
+    wire=None,
+    deliver: "Optional[Any]" = None,
+    scale_vec: "Optional[jnp.ndarray]" = None,
+):
+    """One bucket of the event-triggered masked exchange.
+
+    `leaves` are the bucket's parameter leaves (spec order), `fire_vec`
+    the bucket-local [L_b] fire bits, `scale_vec` the bucket's slice of
+    the per-leaf int8 scales (required iff wire == 'int8'; per-leaf
+    scales are bucket-invariant, so the slice quantizes bitwise what the
+    monolithic wire does). Returns the flat family's (candidates,
+    effective bits, raw bits) triple, every array bucket-sized."""
+    seg = bucket.seg_expand()
+    if wire == "int8":
+        q = _wire_concat(
+            [
+                jnp.clip(
+                    jnp.round(
+                        jnp.where(fire_vec[k], l.reshape(-1),
+                                  jnp.zeros((), dtype))
+                        / scale_vec[k]
+                    ),
+                    -127, 127,
+                )
+                for k, l in enumerate(leaves)
+            ],
+            jnp.int8,
+        )
+
+        def receive(nb):
+            got_q, got_s, got_vec = recv_from(
+                (q, scale_vec, fire_vec), topo, nb
+            )
+            return got_q.astype(dtype) * got_s[seg].astype(dtype), got_vec
+    else:
+        masked = _wire_concat(
+            [
+                jnp.where(fire_vec[k], l.reshape(-1), jnp.zeros((), dtype))
+                for k, l in enumerate(leaves)
+            ],
+            dtype,
+        )
+        wire_buf = _wire_out(masked, wire)
+
+        def receive(nb):
+            got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
+            return got_flat.astype(dtype), got_vec
+
+    cands, effs, raws = [], [], []
+    for i, nb in enumerate(topo.neighbors):
+        got_flat, got_vec = receive(nb)
+        eff = got_vec if deliver is None else got_vec & deliver[i]
+        cands.append(got_flat)
+        effs.append(eff)
+        raws.append(got_vec)
+    return tuple(cands), tuple(effs), tuple(raws)
+
+
+def compact_neighbor_vals_bucket(
+    packed: jnp.ndarray,
+    leaf_id: jnp.ndarray,
+    fire_vec: jnp.ndarray,
+    topo: Topology,
+    bucket: "arena.BucketSpec",
+    capacity: int,
+    dtype,
+    wire=None,
+    deliver: "Optional[Any]" = None,
+    scale_vec: "Optional[jnp.ndarray]" = None,
+):
+    """One bucket of the budgeted compacted exchange.
+
+    `packed`/`leaf_id` come from `_compact_pack` over the bucket's flat
+    payload with its bucket-local `capacity` (one split of
+    `split_capacity`); `fire_vec` must be the bucket-local
+    capacity-gated bits. Offsets stay the implicit lane — both sides
+    recompute them from the bucket's fire bits. Deferral re-contention
+    is bucket-local by construction: a deferred leaf competes only for
+    its own bucket's budget next pass (docs/compaction.md)."""
+    capacity = int(capacity)
+    if capacity < bucket.floor:
+        raise ValueError(
+            f"bucket {bucket.index}: compact capacity {capacity} is "
+            f"below its largest leaf ({bucket.floor} elements) — use "
+            "split_capacity, which enforces per-bucket floors"
+        )
+    if wire == "int8":
+        wire_packed = _int8_encode_flat(packed, scale_vec, leaf_id)
+
+        def ship(nb):
+            got = recv_from((wire_packed, scale_vec, fire_vec), topo, nb)
+            return got[0], got[1], got[2]
+    else:
+        wire_packed = _wire_out(packed, wire)
+
+        def ship(nb):
+            got = recv_from((wire_packed, fire_vec), topo, nb)
+            return got[0], None, got[1]
+
+    seg = bucket.seg_expand()
+    sizes_arr = bucket.sizes_arr()
+    pos_in_leaf = (
+        jnp.arange(bucket.size, dtype=jnp.int32) - bucket.starts_arr()[seg]
+    )
+    cands, effs, raws = [], [], []
+    for i, nb in enumerate(topo.neighbors):
+        got_packed, got_scales, got_vec = ship(nb)
+        got_fired = jnp.where(got_vec, sizes_arr, 0)
+        got_offsets = jnp.cumsum(got_fired) - got_fired
+        src = got_offsets[seg] + pos_in_leaf
+        data = got_packed[jnp.clip(src, 0, capacity - 1)]
+        val = data.astype(dtype)
+        if got_scales is not None:
+            val = val * got_scales[seg].astype(dtype)
+        eff = got_vec if deliver is None else got_vec & deliver[i]
+        cands.append(val)
+        effs.append(eff)
+        raws.append(got_vec)
     return tuple(cands), tuple(effs), tuple(raws)
 
 

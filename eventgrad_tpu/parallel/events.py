@@ -211,15 +211,19 @@ class EventState(struct.PyTreeNode):
     @classmethod
     def init(
         cls, params: Any, topo: Topology, cfg: EventConfig,
-        arena: bool = False,
+        arena: bool = False, buckets: int = 1,
     ) -> "EventState":
         """`arena=True` stores the per-neighbor receive buffers as flat
         [n_params] arenas (parallel/arena.py) instead of pytrees — the
         layout the flat-arena train step carries so no per-step
-        ravel/unravel of stale buffers survives. Zero-initialized either
-        way (event.cpp:177-179); checkpoints restore into whichever
-        layout the run was built with (a cross-layout restore fails
-        loudly, by design)."""
+        ravel/unravel of stale buffers survives. `buckets=K` (arena
+        only) further segments each neighbor's buffer into the K
+        leaf-aligned bucket arrays of the bucketed gossip schedule
+        (ArenaSpec.buckets — the step commits and mixes each bucket
+        independently, so the state carries the per-bucket layout
+        directly). Zero-initialized either way (event.cpp:177-179);
+        checkpoints restore into whichever layout the run was built
+        with (a cross-layout restore fails loudly, by design)."""
         n = trees.tree_num_leaves(params)
         zeros = jnp.zeros((n,), jnp.float32)
         if arena:
@@ -235,7 +239,13 @@ class EventState(struct.PyTreeNode):
                     f"parameter dtype; got {sorted(set(spec.dtypes))} — "
                     "use arena=False for heterogeneous models"
                 )
-            buf0 = jnp.zeros((spec.n_total,), spec.dtype)
+            if buckets and int(buckets) > 1:
+                buf0 = tuple(
+                    jnp.zeros((b.size,), spec.dtype)
+                    for b in spec.buckets(int(buckets))
+                )
+            else:
+                buf0 = jnp.zeros((spec.n_total,), spec.dtype)
         else:
             buf0 = trees.tree_zeros_like(params)
         return cls(

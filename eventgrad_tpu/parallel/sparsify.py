@@ -104,31 +104,106 @@ def sparse_exchange(
     topo: Topology,
     cfg: SparseConfig,
     wire=None,
+    buckets=None,
 ) -> SparseState:
     """One step of sparsified gossip: build top-k payloads, ship them to every
     neighbor (masked — receivers apply only when the sender fired), update the
     sender shadow and the neighbor replicas. Returns the new SparseState; the
     caller then mixes `params` with `sp.replicas` (spevent.cpp:539-542).
     `wire` ("bf16"/"int8") compresses the top-k *values* for the transfer;
-    indices stay int32. The sender shadow keeps full precision."""
+    indices stay int32. The sender shadow keeps full precision.
+
+    `buckets` (a tuple of parallel/arena.py BucketSpec, the bucketed
+    gossip schedule) groups the per-leaf exchange by leaf-aligned
+    buckets with pipelined emission: bucket b's lanes ship before bucket
+    b-1's replica scatters are emitted, so XLA's scheduler is free to
+    overlap one bucket's exchange with another's commit work. Every op
+    is per-leaf either way — the result is bitwise the monolithic call
+    (tests/test_bucketed.py); the state layout is unchanged."""
     vals, idxs = topk_payload(params, sp.prev_sent, cfg)
 
     new_prev = scatter_into(sp.prev_sent, vals, idxs, fire)
 
     if wire == "int8":
-        q, scale_vec, scale_def = collectives._int8_encode(vals)
-        wire_vals = (q, scale_vec)
+        q, scale_vec, _ = collectives._int8_encode(vals)
     else:
-        wire_vals = (collectives._wire_out(vals, wire), None)
-    new_replicas = []
-    for nb, replica in zip(topo.neighbors, sp.replicas):
-        got_vals, got_s, got_idxs, got_fire = collectives.recv_from(
-            wire_vals + (idxs, fire), topo, nb
-        )
-        if wire == "int8":
-            got_vals = collectives._int8_decode(got_vals, got_s, scale_def, vals)
-        else:
-            got_vals = collectives._wire_in(got_vals, vals)
-        new_replicas.append(scatter_into(replica, got_vals, got_idxs, got_fire))
+        q, scale_vec = collectives._wire_out(vals, wire), None
 
+    def _decode(got_vals, got_s, like_vals):
+        if wire == "int8":
+            # bucket-local scale trees decode with their own treedef —
+            # per-leaf scales are bucket-invariant, so the values match
+            # the monolithic decode bitwise
+            return collectives._int8_dequant(
+                got_vals,
+                jax.tree.unflatten(
+                    jax.tree.structure(like_vals),
+                    [got_s[i] for i in range(got_s.shape[0])],
+                ),
+                like_vals,
+            )
+        return collectives._wire_in(got_vals, like_vals)
+
+    if buckets is None:
+        new_replicas = []
+        for nb, replica in zip(topo.neighbors, sp.replicas):
+            got_vals, got_s, got_idxs, got_fire = collectives.recv_from(
+                (q, scale_vec, idxs, fire), topo, nb
+            )
+            got_vals = _decode(got_vals, got_s, vals)
+            new_replicas.append(
+                scatter_into(replica, got_vals, got_idxs, got_fire)
+            )
+        return sp.replace(prev_sent=new_prev, replicas=tuple(new_replicas))
+
+    # bucketed: leaf-sliced lanes per bucket, shipped with pipelined
+    # emission (ship b, scatter b-1, ship b+1, ...)
+    def _leaves(tree):
+        return jax.tree.flatten(tree)[0]
+
+    v_l, i_l, f_l = _leaves(vals), _leaves(idxs), _leaves(fire)
+    q_l = _leaves(q)
+    r_l = [_leaves(r) for r in sp.replicas]  # [n_nb][L]
+    B = len(buckets)
+    shipped = [None] * B   # per bucket: per-neighbor received lane lists
+    out_l = [list(rl) for rl in r_l]
+
+    def _ship(bi):
+        b = buckets[bi]
+        lanes = (
+            tuple(q_l[b.lo:b.hi]),
+            (scale_vec[b.lo:b.hi] if scale_vec is not None else None),
+            tuple(i_l[b.lo:b.hi]),
+            tuple(f_l[b.lo:b.hi]),
+        )
+        shipped[bi] = [
+            collectives.recv_from(lanes, topo, nb) for nb in topo.neighbors
+        ]
+
+    def _commit(bi):
+        b = buckets[bi]
+        like = tuple(v_l[b.lo:b.hi])
+        for ni in range(len(topo.neighbors)):
+            got_q, got_s, got_idxs, got_fire = shipped[bi][ni]
+            got_vals = _decode(got_q, got_s, like)
+            for j, k in enumerate(range(b.lo, b.hi)):
+                scattered = (
+                    out_l[ni][k].reshape(-1).at[got_idxs[j]]
+                    .set(got_vals[j]).reshape(out_l[ni][k].shape)
+                )
+                out_l[ni][k] = jnp.where(
+                    got_fire[j], scattered, out_l[ni][k]
+                )
+
+    _ship(0)
+    for bi in range(1, B):
+        _ship(bi)
+        _commit(bi - 1)
+    _commit(B - 1)
+
+    rep_def = jax.tree.structure(sp.replicas[0])
+    new_replicas = tuple(
+        jax.tree.unflatten(rep_def, out_l[ni])
+        for ni in range(len(topo.neighbors))
+    )
     return sp.replace(prev_sent=new_prev, replicas=tuple(new_replicas))

@@ -37,6 +37,8 @@ from eventgrad_tpu.obs import OBS_MODES
 from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.data.sharding import epoch_index_plan, epoch_steps
+from eventgrad_tpu.ops import arena_tuning
+from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives, multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
@@ -271,6 +273,7 @@ def train(
     obs: str = "off",
     registry: Optional[Any] = None,
     arena: Optional[bool] = None,
+    bucketed: Optional[int] = None,
     pipeline: Optional[bool] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
@@ -291,6 +294,28 @@ def train(
     working; with an explicit `arena=True` the cross-layout restore
     raises an actionable error instead of corrupting state. History
     records carry `rec["arena"]`.
+
+    bucketed (None|K, auto-off for K=1) runs the event-exchange hot
+    path under the BUCKETED gossip schedule (train/steps.py): the flat
+    arena splits into K contiguous leaf-aligned buckets
+    (parallel/arena.py ArenaSpec.buckets) and each bucket's
+    gate->pack->exchange->commit->mix chain is emitted
+    software-pipelined so the scheduler can overlap one bucket's
+    ppermute with another's update work — bitwise-identical training
+    to the monolithic path (tests/test_bucketed.py), proven the same
+    way the arena was (equivalence matrix + trace audit + scanned
+    median-paired A/B, tools/overhead_ablation.py bucketed). eventgrad
+    (needs the arena; EventState buffers are then carried per-bucket —
+    a resume across a bucketed/monolithic layout change fails loudly)
+    and sp_eventgrad (per-leaf exchange grouped by bucket, state
+    unchanged) only; the compact wire's capacity splits per bucket
+    with bucket-local deferral (collectives.split_capacity,
+    docs/compaction.md); not combinable with the integrity engine or
+    chaos bitflips; with fused_update the per-bucket kernel tail needs
+    a measured ops/arena_tuning entry (bench_kernels.py bucketed) —
+    unmeasured backends demote to the monolithic fused path with a
+    warning. History records carry `buckets` and
+    `sent_bytes_wire_real_per_bucket`.
 
     With `checkpoint_dir`, the full gossip TrainState (+ epoch counter) is
     snapshotted every `save_every` epochs (always at the end); `resume=True`
@@ -708,9 +733,48 @@ def train(
                     "single parameter dtype"
                 )
             arena_on = False
+    # --- bucketed-gossip-schedule resolution (train/steps.py) ----------
+    bucketed_k = int(bucketed) if bucketed else 1
+    if bucketed_k < 1:
+        raise ValueError(f"bucketed must be >= 1 (or None), got {bucketed}")
+    if bucketed_k > 1:
+        if algo not in ("eventgrad", "sp_eventgrad"):
+            raise ValueError(
+                "bucketed=K pipelines the event-exchange hot path "
+                f"(eventgrad, sp_eventgrad); got algo={algo!r}"
+            )
+        if algo == "eventgrad" and not arena_on:
+            raise ValueError(
+                "bucketed=K segments the flat parameter arena, but this "
+                "run resolved arena OFF (explicit arena=False, a "
+                "sharded topology, or heterogeneous parameter dtypes) "
+                "— drop bucketed or make the run arena-eligible"
+            )
+        if integ_cfg is not None:
+            raise ValueError(
+                "bucketed does not compose with the integrity engine: "
+                "wire checksums, rejection verdicts, and rollback "
+                "hardening are whole-wire monolithic contracts"
+            )
+        if chaos_sched is not None and chaos_sched.has_bitflips:
+            raise ValueError(
+                "bucketed does not compose with chaos bitflip= faults "
+                "(the corruption transform targets one wire buffer per "
+                "edge, which the bucketed schedule splits K ways)"
+            )
+        if fused_update and not arena_tuning.bucketed_tail_ok():
+            import warnings
+            warnings.warn(
+                "bucketed fused tail has no measured "
+                "bucketed_tail_speedup entry in ops/arena_tuning.json "
+                "on this backend — falling back to the MONOLITHIC "
+                "fused path (run bench_kernels.py bucketed to measure)",
+                RuntimeWarning,
+            )
+            bucketed_k = 1
     state = init_fn(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
-        input_dtype=input_dtype, arena=arena_on,
+        input_dtype=input_dtype, arena=arena_on, bucketed=bucketed_k,
     )
     if chaos_sched is not None:
         # per-edge receiver-side health, stacked like every other state
@@ -726,7 +790,10 @@ def train(
         state = state.replace(
             telemetry=stack_for_ranks(
                 obs_device.TelemetryState.init(
-                    trees.tree_num_leaves(state.params), topo.n_neighbors
+                    trees.tree_num_leaves(state.params), topo.n_neighbors,
+                    n_buckets=min(
+                        bucketed_k, trees.tree_num_leaves(state.params)
+                    ),
                 ),
                 topo,
             )
@@ -852,6 +919,21 @@ def train(
             try:
                 restored, carry = _attempt(state)
             except Exception as exc:
+                if bucketed_k > 1 and algo == "eventgrad":
+                    # per-bucket EventState buffers (eventgrad only —
+                    # sp_eventgrad's bucketed state layout is
+                    # unchanged): a monolithic (or different-K)
+                    # snapshot cannot restore into this template —
+                    # fail loudly with the cause named
+                    raise RuntimeError(
+                        "checkpoint restore failed with bucketed="
+                        f"{bucketed_k}: EventState receive buffers are "
+                        "carried PER-BUCKET under the bucketed gossip "
+                        "schedule, and cross-layout restores fail "
+                        "loudly by design — resume with the snapshot's "
+                        "original bucketed/monolithic setting, then "
+                        "re-snapshot to migrate"
+                    ) from exc
                 # the EventState receive buffers changed layout with the
                 # flat arena: a snapshot written by a pre-arena (or
                 # arena=False) run holds tree-shaped bufs and cannot
@@ -915,6 +997,7 @@ def train(
             obs=obs_on,
             arena=arena_on,
             integrity=integ_now,
+            bucketed=bucketed_k,
             # NOTE arena_sgd (the all-flat SGD tail) stays off: it costs
             # two extra full-model ravels per step, and the measured CPU
             # ravel price (see ArenaSpec.ravel) makes the unflatten +
@@ -1230,6 +1313,17 @@ def train(
                 "n_params": n_params,
                 "arena": bool(arena_on),
             }
+            if bucketed_k > 1:
+                # bucketed gossip schedule: the bucket count and the
+                # per-bucket wire split next to the totals
+                rec["buckets"] = min(bucketed_k, sz)
+                if "sent_bytes_wire_real_per_bucket" in m_e:
+                    rec["sent_bytes_wire_real_per_bucket"] = [
+                        round(float(v), 1)
+                        for v in np.asarray(
+                            m_e["sent_bytes_wire_real_per_bucket"]
+                        )[-1, 0]
+                    ]
             if gossip_wire == "compact":
                 rec["gossip_wire"] = mode_now
                 if compact_capacity is not None:
@@ -1416,6 +1510,19 @@ def train(
                     int(np.prod(l.shape[1:], dtype=np.int64)) or 1
                     for l in jax.tree.leaves(hw["state"].params)
                 )
+                if bucketed_k > 1 and algo == "eventgrad":
+                    # every bucket must fit its own largest leaf: the
+                    # bucketed floor is the sum of per-bucket floors
+                    # (split_capacity's feasibility bound)
+                    _bspec = arena_lib.arena_spec(jax.tree.map(
+                        lambda l: jax.ShapeDtypeStruct(
+                            l.shape[1:], l.dtype
+                        ),
+                        hw["state"].params,
+                    ))
+                    floor = max(floor, collectives.bucketed_capacity_floor(
+                        _bspec.buckets(bucketed_k)
+                    ))
                 if compact_frac is not None:
                     cap = min(n_params, max(
                         floor, int(np.ceil(compact_frac * n_params))

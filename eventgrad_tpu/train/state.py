@@ -58,8 +58,14 @@ def init_train_state(
     seed: int = 0,
     input_dtype=jnp.float32,
     arena: bool = False,
+    bucketed: int = 1,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks.
+
+    `bucketed=K` (arena event runs only) carries the EventState receive
+    buffers in the K-bucket layout of the bucketed gossip schedule
+    (parallel/arena.py ArenaSpec.buckets) — the layout the bucketed
+    train step consumes; see EventState.init.
 
     On accelerator backends the whole build — flax init (hundreds of
     small ops for a ResNet), optimizer/event/sparse state, stacking, PRNG
@@ -85,7 +91,8 @@ def init_train_state(
             # arena=True stores the neighbor receive buffers flat (the
             # flat-arena step's layout; see EventState.init)
             event = EventState.init(
-                params, topo, event_cfg or EventConfig(), arena=arena
+                params, topo, event_cfg or EventConfig(), arena=arena,
+                buckets=bucketed,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)
@@ -117,6 +124,7 @@ def init_train_state_spmd(
     seed: int = 0,
     input_dtype=jnp.float32,
     arena: bool = False,
+    bucketed: int = 1,
 ) -> TrainState:
     """Per-rank initialization inside the SPMD context — required when the
     topology has `sharded_axes` (tensor/expert parallelism): sharded layers
@@ -135,7 +143,8 @@ def init_train_state_spmd(
         sparse = None
         if algo in ("eventgrad", "sp_eventgrad"):
             event = EventState.init(
-                params, topo, event_cfg or EventConfig(), arena=arena
+                params, topo, event_cfg or EventConfig(), arena=arena,
+                buckets=bucketed,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)

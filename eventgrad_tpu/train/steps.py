@@ -101,6 +101,7 @@ def make_train_step(
     obs: bool = False,
     arena: bool = False,
     integrity: Optional[Any] = None,
+    bucketed: Optional[int] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -190,6 +191,32 @@ def make_train_step(
     of tools/integrity_sweep.py). Not combinable with the fused Pallas
     tail (the quarantine gate rides the optax tail).
 
+    bucketed=K (None/1 = off) restructures the event-exchange hot path
+    into the BUCKETED gossip schedule: the flat arena is segmented into
+    K contiguous leaf-aligned buckets (parallel/arena.py
+    ArenaSpec.buckets) and the per-bucket gate -> pack -> exchange ->
+    commit -> mix chain is emitted software-pipelined (bucket k's
+    ppermute is dispatched between bucket k-1's commit and mix, with no
+    dataflow edge forcing that order), so XLA's scheduler can overlap
+    one bucket's exchange with another bucket's update work — the
+    on-device analogue of the reference's non-blocking MPI sends and of
+    the zero-bubble host pipeline (docs/ARCHITECTURE.md "Bucketed
+    gossip schedule"). Training is BITWISE the monolithic path
+    (tests/test_bucketed.py): every bucket's wire lanes are the
+    bucket's slice of the monolithic wire, per-leaf int8 scales are
+    bucket-invariant, and the [L] trigger state machine stays global.
+    The compact wire's capacity splits per bucket
+    (collectives.split_capacity: element-proportional, per-bucket
+    floors, exact total) and deferral re-contention is BUCKET-LOCAL.
+    eventgrad needs arena=True (the buckets segment the flat arena;
+    EventState.bufs is then carried per-bucket — cross-layout
+    checkpoint restores fail loudly); sp_eventgrad groups its per-leaf
+    exchange by the same buckets with unchanged state. Not combinable
+    with in-step integrity or chaos bitflips (whole-wire contracts),
+    and the per-bucket fused tail requires a measured
+    ops/arena_tuning.bucketed_tail_ok() entry (bench_kernels.py
+    bucketed) — unmeasured shapes keep the monolithic fused path.
+
     chaos (a chaos.ChaosSchedule) injects deterministic message loss into
     the gossip edges inside this fused step: a dropped message keeps the
     receiver's stale buffer (eventgrad) or leaves the edge out of a
@@ -252,6 +279,47 @@ def make_train_step(
                 "bitflip=/nanstep= faults target the event exchange "
                 f"(algo='eventgrad'); got algo={algo!r}"
             )
+    n_buckets = int(bucketed) if bucketed else 1
+    if n_buckets < 1:
+        raise ValueError(f"bucketed must be >= 1 (or None), got {bucketed}")
+    if n_buckets > 1:
+        if algo not in ("eventgrad", "sp_eventgrad"):
+            raise ValueError(
+                "bucketed=K pipelines the event-exchange hot path "
+                f"(eventgrad, sp_eventgrad); got algo={algo!r}"
+            )
+        if algo == "eventgrad" and not arena:
+            raise ValueError(
+                "bucketed=K segments the flat parameter arena — "
+                "algo='eventgrad' needs arena=True (the loop's auto "
+                "mode resolves this; see train(bucketed=...))"
+            )
+        if integ_checksum or integ_quar:
+            raise ValueError(
+                "bucketed is not combinable with the in-step integrity "
+                "defenses: checksums and rejection verdicts are "
+                "whole-wire per-edge contracts, not per-bucket ones"
+            )
+        if chaos is not None and chaos.has_bitflips:
+            raise ValueError(
+                "bucketed is not combinable with chaos bitflip= faults: "
+                "the corruption transform targets ONE wire buffer per "
+                "edge, which the bucketed schedule splits K ways"
+            )
+        if fused_sgd is not None:
+            if algo != "eventgrad":
+                raise ValueError(
+                    "bucketed + fused_sgd rides the arena fused tail "
+                    f"(algo='eventgrad'); got algo={algo!r}"
+                )
+            if not arena_tuning.bucketed_tail_ok():
+                raise ValueError(
+                    "bucketed + fused_sgd needs a measured "
+                    "bucketed_tail_speedup entry in ops/arena_tuning."
+                    "json (run bench_kernels.py bucketed on this "
+                    "device) — unmeasured shapes keep the monolithic "
+                    "fused path (train/loop.py demotes with a warning)"
+                )
     chaos_policy = chaos_policy or RecoveryPolicy()
     if chaos is not None:
         chaos_policy.validate_against(event_cfg.max_silence if event_cfg else 0)
@@ -317,9 +385,15 @@ def make_train_step(
                 loss = loss + jnp.sum(leaf)
             return loss, (out, new_stats)
 
-        (loss, (out, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
+        # explicit jax.vjp (what value_and_grad wraps — bitwise the same
+        # cotangent pull-back): the backward pass is a plain function
+        # call here, so the bucketed schedule below can begin emitting
+        # per-bucket exchange work against its outputs with no
+        # value_and_grad closure in between
+        loss, vjp_fn, (out, new_stats) = jax.vjp(
+            loss_fn, state.params, has_aux=True
         )
+        (grads,) = vjp_fn(jnp.ones((), loss.dtype))
 
         # auxiliary (non-gossip) parallelism axes — e.g. sequence parallelism:
         # ranks along them hold identical parameters and share one logical
@@ -433,6 +507,23 @@ def make_train_step(
         arena_bufs = None    # flat neighbor buffers for the flat mix/tail
         arena_pending = None # (cands, effs, lasts) awaiting the fused commit
         arena_fire_vec = None
+        # bucketed gossip schedule (static, trace-time): the leaf-aligned
+        # segmentation the per-bucket pipeline below runs over
+        buckets_eff = None
+        if n_buckets > 1:
+            if algo == "eventgrad":
+                if not use_arena:
+                    raise ValueError(
+                        "bucketed=K needs the flat-arena hot path, and "
+                        "this model's parameters are not arena-eligible "
+                        "(heterogeneous dtypes?) — use bucketed=None"
+                    )
+                buckets_eff = spec.buckets(n_buckets)
+            else:  # sp_eventgrad groups its per-leaf exchange
+                buckets_eff = arena_lib.arena_spec(params).buckets(n_buckets)
+        bucketed_mixed = None      # mixed pytree awaiting the optax tail
+        bucketed_tail_done = False # per-bucket fused tail already applied
+        wire_real_bucket = None    # f32 [K] per-bucket wire-real metric
         # the fused-tail decision is needed inside the event branch (the
         # buffer commit defers into the fused kernel); static either way
         use_fused = fused_sgd is not None and algo != "allreduce"
@@ -474,6 +565,272 @@ def make_train_step(
                 # dropped edge leaves this pass's mix and the weight
                 # renormalizes (mix_weighted below)
                 health = chaos_monitor.update(health, deliver, ~deliver)
+
+        elif algo == "eventgrad" and use_arena and buckets_eff is not None:
+            # ---- bucketed gossip schedule (ISSUE 10) ----------------
+            # The [L] trigger state machine stays GLOBAL — its per-leaf
+            # ops are bucket-invariant and microscopic; the heavy chain
+            # (gate -> pack -> wire -> ppermute -> commit -> mix) runs
+            # per bucket, emitted software-pipelined: bucket k's
+            # exchange is dispatched between bucket k-1's buffer commit
+            # and its mix, with no dataflow edge forcing that order, so
+            # the scheduler can overlap one bucket's transfer with
+            # another's update math (the jaxpr interleaving gate in
+            # analysis/walker.py proves the emission; tests/
+            # test_bucketed.py proves bitwise parity with the
+            # monolithic path).
+            force_fire = (
+                health.sync_req
+                if (chaos is not None and chaos_policy.sync_after)
+                else None
+            )
+            prop = propose(
+                params, event_state, pass_num, event_cfg,
+                force_fire=force_fire,
+            )
+            fire_raw = prop.fire_vec
+            if quar is not None:
+                fire_raw = fire_raw & ~jnp.broadcast_to(
+                    quar, fire_raw.shape
+                )
+            leaves = spec.treedef.flatten_up_to(params)
+            B = len(buckets_eff)
+            caps = None
+            pri = None
+            if gossip_wire == "compact":
+                # per-bucket capacity split: element-proportional with
+                # per-bucket floors, exact total (split_capacity);
+                # admission and deferral re-contention are BUCKET-LOCAL
+                caps = collectives.split_capacity(
+                    compact_capacity, buckets_eff
+                )
+                if event_cfg.max_silence > 0:
+                    pri = prop.iter_diff >= event_cfg.max_silence
+                if force_fire is not None:
+                    ff = jnp.broadcast_to(force_fire, fire_raw.shape)
+                    pri = ff if pri is None else (pri | ff)
+            fire_bs = []
+            for b in buckets_eff:
+                fb = fire_raw[b.lo:b.hi]
+                if caps is not None:
+                    pb = pri[b.lo:b.hi] if pri is not None else None
+                    fb = capacity_gate(
+                        fb, b.sizes, caps[b.index], priority=pb
+                    )
+                fire_bs.append(fb)
+            fire_vec = jnp.concatenate(fire_bs)
+            event_state = commit(
+                event_state, prop, fire_vec, event_cfg, n_nb
+            )
+            obs_prop, obs_fire_vec = prop, fire_vec
+            arena_fire_vec = fire_vec
+            scale_vec = (
+                collectives._masked_scales(
+                    collectives._leaf_absmax(leaves), fire_vec
+                )
+                if wire == "int8" else None
+            )
+            lasts = event_state.bufs  # per-neighbor tuples of buckets
+            shipped = [None] * B      # (cands, effs, raws) per bucket
+            new_bufs_b = [None] * B   # per bucket: per-neighbor tuple
+            mixed_leaves = [None] * spec.n_leaves
+
+            def _bflat(xs):
+                if len(xs) == 1:
+                    return xs[0].reshape(-1).astype(spec.dtype)
+                return jnp.concatenate(
+                    [x.reshape(-1).astype(spec.dtype) for x in xs]
+                )
+
+            def _ship(bi):
+                b = buckets_eff[bi]
+                lv = leaves[b.lo:b.hi]
+                sv = (
+                    scale_vec[b.lo:b.hi] if scale_vec is not None
+                    else None
+                )
+                if caps is not None:
+                    packed, leaf_id = collectives._compact_pack(
+                        _bflat(lv), fire_bs[bi], b.sizes, b.starts_rel,
+                        caps[bi],
+                    )
+                    shipped[bi] = collectives.compact_neighbor_vals_bucket(
+                        packed, leaf_id, fire_bs[bi], topo, b, caps[bi],
+                        spec.dtype, wire, deliver=deliver, scale_vec=sv,
+                    )
+                else:
+                    shipped[bi] = collectives.masked_neighbor_vals_bucket(
+                        lv, fire_bs[bi], topo, b, spec.dtype, wire,
+                        deliver=deliver, scale_vec=sv,
+                    )
+
+            def _commit_bufs(bi):
+                b = buckets_eff[bi]
+                cands, effs, _raws = shipped[bi]
+                last_b = tuple(lasts[i][bi] for i in range(n_nb))
+                new_bufs_b[bi] = collectives.commit_bufs_flat(
+                    cands, effs, last_b, b
+                )
+
+            def _mix(bi, w, gate):
+                # per-leaf slices of the bucket buffers feeding the
+                # optax tail directly — the bucketed twin of
+                # mix_flat_into_tree, same neighbor add order, bitwise
+                b = buckets_eff[bi]
+                use_b = (
+                    tuple(lasts[i][bi] for i in range(n_nb))
+                    if staleness else new_bufs_b[bi]
+                )
+                for j, k in enumerate(range(b.lo, b.hi)):
+                    p = leaves[k]
+                    acc = p
+                    for i, buf in enumerate(use_b):
+                        piece = lax.dynamic_slice_in_dim(
+                            buf, b.starts_rel[j], b.sizes[j], 0
+                        ).reshape(p.shape)
+                        if gate is not None:
+                            piece = jnp.where(
+                                gate[i], piece, jnp.zeros_like(piece)
+                            )
+                        acc = jnp.add(acc, piece)
+                    mixed_leaves[k] = acc * w
+
+            if use_fused:
+                # per-bucket fused tail: commit + mix + SGD in one
+                # kernel launch per bucket (measured-gated —
+                # arena_tuning.bucketed_tail_ok; chaos is already
+                # excluded from fused tails, so no gate plumbing here)
+                lr_f, mom_f = fused_sgd
+                g_leaves = spec.treedef.flatten_up_to(grads)
+                t_leaves = (
+                    spec.treedef.flatten_up_to(state.opt_state[0].trace)
+                    if mom_f else None
+                )
+                p_new = [None] * spec.n_leaves
+                t_new = [None] * spec.n_leaves
+                tail_fn = (
+                    functools.partial(
+                        fused_mix_commit, interpret=fused_interpret
+                    )
+                    if arena_tuning.mix_commit_ok()
+                    else mix_commit_reference
+                )
+
+                def _fused_tail(bi):
+                    b = buckets_eff[bi]
+                    cands, effs, _raws = shipped[bi]
+                    seg_b = b.seg_expand()
+                    keeps = tuple(e[seg_b] for e in effs)
+                    last_b = tuple(lasts[i][bi] for i in range(n_nb))
+                    flat_b = _bflat(leaves[b.lo:b.hi])
+                    g_b = _bflat(g_leaves[b.lo:b.hi])
+                    t_b = (
+                        _bflat(t_leaves[b.lo:b.hi]) if mom_f
+                        else jnp.zeros_like(flat_b)
+                    )
+                    p_b, t_b2, nb_b = tail_fn(
+                        flat_b, cands, keeps, last_b, g_b, t_b,
+                        float(lr_f), float(mom_f), topo.mix_weight,
+                        mix_stale=bool(staleness),
+                    )
+                    new_bufs_b[bi] = nb_b
+                    for j, k in enumerate(range(b.lo, b.hi)):
+                        sl = slice(
+                            b.starts_rel[j],
+                            b.starts_rel[j] + b.sizes[j],
+                        )
+                        p_new[k] = p_b[sl].reshape(leaves[k].shape)
+                        if mom_f:
+                            t_new[k] = t_b2[sl].reshape(
+                                t_leaves[k].shape
+                            )
+
+                _ship(0)
+                for bi in range(1, B):
+                    _fused_tail(bi - 1)
+                    _ship(bi)
+                _fused_tail(B - 1)
+                params = jax.tree.unflatten(spec.treedef, p_new)
+                if mom_f:
+                    opt_state = (
+                        state.opt_state[0]._replace(
+                            trace=jax.tree.unflatten(spec.treedef, t_new)
+                        ),
+                    ) + tuple(state.opt_state[1:])
+                else:
+                    opt_state = state.opt_state
+                bucketed_tail_done = True
+            elif deliver is None:
+                # the pipelined emission: ship(k) sits between
+                # commit(k-1) and mix(k-1) in the trace — the
+                # interleaving the jaxpr gate checks
+                _ship(0)
+                for bi in range(1, B):
+                    _commit_bufs(bi - 1)
+                    _ship(bi)
+                    _mix(bi - 1, topo.mix_weight, None)
+                _commit_bufs(B - 1)
+                _mix(B - 1, topo.mix_weight, None)
+            else:
+                # chaos delivery masks ride per-bucket (the same
+                # per-edge bit gates every bucket of an edge — a drop
+                # drops the whole message, bitwise the monolithic
+                # semantics); the health update reads every bucket's
+                # raw fire-bit lanes, so ships are emitted first and
+                # the commit/mix sweep follows the verdict
+                for bi in range(B):
+                    _ship(bi)
+                sent_any = jnp.stack([
+                    jnp.any(jnp.concatenate([
+                        shipped[bi][2][i] for bi in range(B)
+                    ]))
+                    for i in range(n_nb)
+                ])
+                delivered = sent_any & deliver
+                health = chaos_monitor.update(
+                    health, delivered, sent_any & ~deliver
+                )
+                if chaos_policy.sync_after:
+                    need = health.silence >= chaos_policy.sync_after
+                    health = health.replace(
+                        sync_req=chaos_monitor.sync_requests(need, topo)
+                    )
+                for bi in range(B):
+                    _commit_bufs(bi)
+                gate = alive_mask(health.silence, chaos_policy)
+                if gate is None:
+                    for bi in range(B):
+                        _mix(bi, topo.mix_weight, None)
+                else:
+                    n_alive = jnp.sum(gate.astype(jnp.float32))
+                    w_g = 1.0 / (1.0 + n_alive)
+                    for bi in range(B):
+                        _mix(bi, w_g, gate)
+            event_state = event_state.replace(bufs=tuple(
+                tuple(new_bufs_b[bi][i] for bi in range(B))
+                for i in range(n_nb)
+            ))
+            if not bucketed_tail_done:
+                bucketed_mixed = jax.tree.unflatten(
+                    spec.treedef, mixed_leaves
+                )
+            fired_elems, fired_leaves = _fired_accounting(
+                fire_vec, spec.sizes
+            )
+            sent_bytes = jnp.float32(n_nb) * (
+                val_bytes * fired_elems + scale_bytes_per_leaf * fired_leaves
+            )
+            fired_frac = fired_leaves / spec.n_leaves
+            per_bucket = collectives.bucketed_wire_real_bytes_per_neighbor(
+                buckets_eff, wire, caps
+            )
+            # same expression shape as the monolithic branch
+            # (f32(n_nb) * python-float) so the f32 roundings agree and
+            # the metric stays bitwise across schedules
+            wire_real = jnp.float32(n_nb) * float(sum(per_bucket))
+            wire_real_bucket = jnp.float32(n_nb) * jnp.asarray(
+                per_bucket, jnp.float32
+            )
 
         elif algo == "eventgrad" and use_arena:
             force_fire = (
@@ -690,7 +1047,8 @@ def make_train_step(
             obs_prop, obs_fire_vec = prop, prop.fire_vec
             stale_replicas = sparse_state.replicas
             sparse_state = sparse_exchange(
-                params, fire, sparse_state, topo, sparse_cfg, wire
+                params, fire, sparse_state, topo, sparse_cfg, wire,
+                buckets=buckets_eff,
             )
             bufs = stale_replicas if staleness else sparse_state.replicas
             ks = tuple(
@@ -712,8 +1070,35 @@ def make_train_step(
                 + 1.0 * n_leaves_static
                 + scale_bytes_per_leaf * n_leaves_static
             )
+            if buckets_eff is not None:
+                # per-bucket split of the same formula (k lanes + fire
+                # bits + int8 scales group by leaf, so the bucket sums
+                # reproduce the total exactly)
+                per_bucket = []
+                for b in buckets_eff:
+                    k_b = sum(ks[b.lo:b.hi])
+                    per_bucket.append(
+                        (val_bytes + 4.0) * k_b
+                        + 1.0 * b.n_leaves
+                        + scale_bytes_per_leaf * b.n_leaves
+                    )
+                wire_real_bucket = jnp.float32(n_nb) * jnp.asarray(
+                    per_bucket, jnp.float32
+                )
 
-        if use_fused and (arena_pending is not None or arena_bufs is not None):
+        if bucketed_tail_done:
+            # bucketed fused tail: params/opt_state already updated per
+            # bucket inside the pipelined schedule above
+            pass
+        elif bucketed_mixed is not None:
+            # bucketed mix emitted per bucket above; the optimizer tail
+            # stays the monolithic optax call on the assembled mixed
+            # pytree — bitwise the arena tail (same values, same order)
+            updates, opt_state = tx.update(
+                grads, state.opt_state, bucketed_mixed
+            )
+            params = optax.apply_updates(bucketed_mixed, updates)
+        elif use_fused and (arena_pending is not None or arena_bufs is not None):
             # arena fused tail: buffer commit + mix + momentum-SGD in one
             # flat pass (ops/arena_update.fused_mix_commit); dpsgd has no
             # commit, so it rides fused_mix_sgd on the single flat leaf
@@ -869,6 +1254,17 @@ def make_train_step(
                 if algo != "allreduce" and n_nb
                 else None
             )
+            # per-bucket wire bytes ride the telemetry under the
+            # bucketed schedule; the monolithic path is the one-bucket
+            # degenerate ([1] vector), so the field's sum always equals
+            # the edge_bytes total. Gated like per_edge: allreduce has
+            # no gossip wire to attribute (docs/OBSERVABILITY.md)
+            per_bucket_tel = None
+            if algo != "allreduce" and n_nb:
+                per_bucket_tel = (
+                    wire_real_bucket if wire_real_bucket is not None
+                    else jnp.reshape(wire_real, (1,))
+                )
             if obs_prop is not None:
                 telemetry = obs_device.accumulate(
                     telemetry,
@@ -879,12 +1275,14 @@ def make_train_step(
                     silence=obs_prop.iter_diff,
                     fired_elems=fired_elems,
                     edge_bytes=per_edge,
+                    bucket_bytes=per_bucket_tel,
                     wire_reject=(~oks if oks is not None else None),
                     quarantined=quar_eff,
                 )
             else:
                 telemetry = obs_device.accumulate(
-                    telemetry, edge_bytes=per_edge
+                    telemetry, edge_bytes=per_edge,
+                    bucket_bytes=per_bucket_tel,
                 )
 
         new_state = state.replace(
@@ -915,6 +1313,10 @@ def make_train_step(
                 if event_state is not None else jnp.int32(0)
             ),
         }
+        if wire_real_bucket is not None:
+            # per-bucket wire truth of the bucketed schedule — static
+            # per step (the sum is sent_bytes_wire_real exactly)
+            metrics["sent_bytes_wire_real_per_bucket"] = wire_real_bucket
         if chaos is not None:
             metrics["edge_silence"] = health.silence  # int32 [n_nb]
             metrics["chaos_drops"] = health.drops  # cumulative int32

@@ -45,6 +45,10 @@ def test_repo_artifacts_all_valid():
     # truth, every seeded oracle violation detected, zero lint
     # violations (tools/audit.py, AUDIT_SCHEMA)
     assert "audit_cpu.json" in names
+    # the bucketed-gossip-schedule proof (ISSUE 10): K-sweep overhead
+    # <= 1.02 vs monolithic, bitwise state, jaxpr interleaving gate
+    # (BUCKETED_ABLATION_SCHEMA)
+    assert "bucketed_ablation_cpu.json" in names
     assert out["errors"] == []
 
 

@@ -1,0 +1,500 @@
+"""Bucketed gossip schedule: bucket geometry, capacity splits, bitwise
+parity with the monolithic step, the jaxpr interleaving gate, and the
+cross-layout resume guard.
+
+The bucketed path's contract (docs/ARCHITECTURE.md "Bucketed gossip
+schedule"): segmenting the flat arena into K leaf-aligned buckets and
+pipelining each bucket's gate/pack/exchange/commit/mix changes the
+SCHEDULE, never the values — training is bitwise the monolithic path
+across algorithms, wires, dtypes, staleness, chaos delivery masks, and
+telemetry. Deferral under the compact wire becomes BUCKET-LOCAL (each
+bucket has its own split of the capacity), which is semantics, not
+drift: the parity matrix runs at non-binding capacity, and the
+bucket-local behavior has its own units here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from _spmd import requires_shard_map
+
+from eventgrad_tpu.analysis import walker
+from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.chaos.schedule import ChaosSchedule
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.parallel import arena, collectives
+from eventgrad_tpu.parallel.events import EventConfig, capacity_gate
+from eventgrad_tpu.parallel.spmd import build_mesh, spmd, stack_for_ranks
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+N_RANKS = 4
+IN_SHAPE = (8, 8, 1)
+PER_RANK = 4
+MODEL = dict(hidden=16)
+CFG = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2,
+                  max_silence=4)
+#: the audit MLP's element count — a NON-BINDING compact capacity, so
+#: the per-bucket split admits exactly what the monolithic gate admits
+#: and the parity claim is exact (binding budgets are bucket-local by
+#: design and unit-tested separately below)
+FULL_CAPACITY = 1210
+
+
+def _batches(n_steps, seed=0):
+    x, y = synthetic_dataset(
+        N_RANKS * PER_RANK * n_steps, IN_SHAPE, seed=seed
+    )
+    xb = jnp.asarray(x.reshape((n_steps, N_RANKS, PER_RANK) + IN_SHAPE))
+    yb = jnp.asarray(y.reshape((n_steps, N_RANKS, PER_RANK)))
+    return [(xb[i], yb[i]) for i in range(n_steps)]
+
+
+def _build(algo, bucketed, *, wire=None, gossip_wire="dense",
+           capacity=None, staleness=0, obs=False, chaos=None,
+           momentum=0.0, backend="vmap"):
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05, momentum=momentum if momentum else None)
+    arena_on = algo == "eventgrad"
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, algo, CFG, seed=0, arena=arena_on,
+        bucketed=bucketed or 1,
+    )
+    if chaos is not None:
+        state = state.replace(
+            chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
+        )
+    if obs:
+        n_leaves = len(jax.tree.leaves(state.params))
+        state = state.replace(
+            telemetry=stack_for_ranks(
+                obs_device.TelemetryState.init(
+                    n_leaves, topo.n_neighbors,
+                    n_buckets=min(bucketed or 1, n_leaves),
+                ),
+                topo,
+            )
+        )
+    step = make_train_step(
+        model, tx, topo, algo, event_cfg=CFG, wire=wire,
+        gossip_wire=gossip_wire, compact_capacity=capacity,
+        staleness=staleness, obs=obs, chaos=chaos, arena=arena_on,
+        bucketed=bucketed,
+    )
+    mesh = build_mesh(topo) if backend == "shard_map" else None
+    return state, jax.jit(spmd(step, topo, mesh=mesh))
+
+
+def _run(state, lifted, batches):
+    m = None
+    for b in batches:
+        state, m = lifted(state, b)
+    return state, m
+
+
+def _flat_bufs(bufs):
+    """Per-neighbor flat view of either layout (monolithic [n] array or
+    the bucketed tuple of per-bucket arrays)."""
+    out = []
+    for buf in bufs:
+        if isinstance(buf, tuple):
+            out.append(np.concatenate(
+                [np.asarray(x) for x in buf], axis=-1
+            ))
+        else:
+            out.append(np.asarray(buf))
+    return out
+
+
+def _assert_parity(s_m, s_b, m_m, m_b, algo):
+    for name in ("params", "opt_state", "batch_stats"):
+        for x, y in zip(jax.tree.leaves(getattr(s_m, name)),
+                        jax.tree.leaves(getattr(s_b, name))):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=name
+            )
+    for f in ("thres", "last_sent_norm", "last_sent_iter", "slopes",
+              "num_events", "num_deferred"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_m.event, f)),
+            np.asarray(getattr(s_b.event, f)), err_msg=f,
+        )
+    if algo == "eventgrad":
+        for i, (bm, bb) in enumerate(
+            zip(_flat_bufs(s_m.event.bufs), _flat_bufs(s_b.event.bufs))
+        ):
+            np.testing.assert_array_equal(bm, bb, err_msg=f"bufs[{i}]")
+    if s_m.chaos is not None:
+        for x, y in zip(jax.tree.leaves(s_m.chaos),
+                        jax.tree.leaves(s_b.chaos)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg="chaos")
+    if s_m.telemetry is not None:
+        # every field bitwise except bucket_bytes, whose SHAPE is the
+        # schedule ([1] vs [K]) — its total must still reconcile
+        for f in ("steps", "fire_count", "defer_count", "thres_sum",
+                  "drift_sum", "silence_hist", "fired_elems_sum",
+                  "fired_elems_peak", "edge_bytes"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s_m.telemetry, f)),
+                np.asarray(getattr(s_b.telemetry, f)), err_msg=f,
+            )
+        np.testing.assert_allclose(
+            np.asarray(s_b.telemetry.bucket_bytes).sum(-1),
+            np.asarray(s_m.telemetry.bucket_bytes).sum(-1),
+        )
+    # metrics: shared keys bitwise; the per-bucket vector (bucketed
+    # only) must sum to the wire-real total exactly
+    for k in m_m:
+        np.testing.assert_array_equal(
+            np.asarray(m_m[k]), np.asarray(m_b[k]), err_msg=k
+        )
+    extra = set(m_b) - set(m_m)
+    assert extra <= {"sent_bytes_wire_real_per_bucket"}
+    if extra:
+        np.testing.assert_allclose(
+            np.asarray(m_b["sent_bytes_wire_real_per_bucket"]).sum(-1),
+            np.asarray(m_b["sent_bytes_wire_real"]),
+        )
+
+
+#: the required parity matrix: algos x wires x gossip wires x staleness
+#: x obs x chaos, each dimension exercised against at least one other
+#: (the test_arena.py CASES rule), crossed with K in {2, 4}
+CASES = {
+    "event_masked_f32": dict(algo="eventgrad"),
+    "event_masked_int8": dict(algo="eventgrad", wire="int8"),
+    "event_masked_bf16_stale": dict(algo="eventgrad", wire="bf16",
+                                    staleness=1),
+    "event_masked_obs": dict(algo="eventgrad", obs=True),
+    "event_masked_chaos": dict(algo="eventgrad",
+                               chaos=ChaosSchedule(seed=3, drop_p=0.4)),
+    "event_masked_mom": dict(algo="eventgrad", momentum=0.9),
+    "event_compact_f32": dict(algo="eventgrad", gossip_wire="compact",
+                              capacity=FULL_CAPACITY),
+    "event_compact_int8_obs": dict(algo="eventgrad",
+                                   gossip_wire="compact",
+                                   capacity=FULL_CAPACITY, wire="int8",
+                                   obs=True),
+    "event_compact_stale": dict(algo="eventgrad", gossip_wire="compact",
+                                capacity=FULL_CAPACITY, staleness=1),
+    "sp_f32": dict(algo="sp_eventgrad"),
+    "sp_int8_stale": dict(algo="sp_eventgrad", wire="int8", staleness=1),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_bucketed_bitwise_matches_monolithic(name):
+    """K in {2, 4} reproduce the monolithic step bitwise: full state AND
+    step metrics after several steps (warmup crossing, real fire
+    patterns)."""
+    kw = dict(CASES[name])
+    algo = kw.pop("algo")
+    batches = _batches(5)
+    s_m, lift_m = _build(algo, None, **kw)
+    s_m, m_m = _run(s_m, lift_m, batches)
+    for K in (2, 4):
+        s_b, lift_b = _build(algo, K, **kw)
+        s_b, m_b = _run(s_b, lift_b, batches)
+        _assert_parity(s_m, s_b, m_m, m_b, algo)
+
+
+@requires_shard_map
+def test_bucketed_bitwise_matches_monolithic_shard_map():
+    """Same contract under the real-mesh lift (one device per rank)."""
+    if len(jax.devices()) < N_RANKS:
+        pytest.skip(f"needs {N_RANKS} devices")
+    batches = _batches(3)
+    s_m, lift_m = _build("eventgrad", None, backend="shard_map")
+    s_b, lift_b = _build("eventgrad", 2, backend="shard_map")
+    s_m, m_m = _run(s_m, lift_m, batches)
+    s_b, m_b = _run(s_b, lift_b, batches)
+    _assert_parity(s_m, s_b, m_m, m_b, "eventgrad")
+
+
+# ---------------------------------------------------------------------------
+# bucket geometry units
+
+
+def _tree(sizes):
+    return {f"l{i:02d}": jnp.zeros((s,), jnp.float32)
+            for i, s in enumerate(sizes)}
+
+
+def test_buckets_leaf_aligned_partition():
+    """Buckets tile the arena exactly: contiguous, leaf-aligned (no leaf
+    straddles a boundary), element-balanced cuts, k clamped to the leaf
+    count, and every bucket's local layout re-derives the parent's."""
+    spec = arena.arena_spec(_tree((1024, 16, 160, 10, 300, 7)))
+    for k in (1, 2, 3, 4, 6, 9):
+        bs = spec.buckets(k)
+        assert len(bs) == min(k, spec.n_leaves)
+        assert bs[0].lo == 0 and bs[-1].hi == spec.n_leaves
+        assert sum(b.size for b in bs) == spec.n_total
+        for b1, b2 in zip(bs, bs[1:]):
+            assert b1.hi == b2.lo                  # contiguous
+            assert b1.start + b1.size == b2.start  # element-contiguous
+        for b in bs:
+            assert b.sizes == spec.sizes[b.lo:b.hi]
+            assert b.starts_rel[0] == 0
+            assert b.floor == max(b.sizes)
+            assert b.size == sum(b.sizes)
+            # the bucket-local segment map re-bases the parent's
+            seg = np.asarray(b.seg_expand())
+            assert seg.shape == (b.size,)
+            assert seg.max() == b.n_leaves - 1
+    # cached like every other piece of leaf metadata
+    assert spec.buckets(3) is spec.buckets(3)
+
+
+def test_split_capacity_floors_and_exact_sum():
+    spec = arena.arena_spec(_tree((1024, 16, 160, 10)))
+    bs = spec.buckets(2)
+    # full capacity splits to the bucket sizes exactly
+    assert collectives.split_capacity(spec.n_total, bs) == tuple(
+        b.size for b in bs
+    )
+    # a binding capacity still sums exactly and respects every floor
+    floor_total = collectives.bucketed_capacity_floor(bs)
+    for cap in (floor_total, floor_total + 37, spec.n_total - 1):
+        caps = collectives.split_capacity(cap, bs)
+        assert sum(caps) == cap
+        for c, b in zip(caps, bs):
+            assert c >= b.floor
+    # below the bucketed floor: loud, names the bound
+    with pytest.raises(ValueError, match="bucketed floor"):
+        collectives.split_capacity(floor_total - 1, bs)
+
+
+def test_deferral_stays_bucket_local():
+    """A bucket that overflows its split defers ONLY its own leaves:
+    other buckets' admissions are unaffected — where the monolithic
+    greedy gate would have let bucket 0's overflow starve later leaves
+    in line."""
+    spec = arena.arena_spec(_tree((100, 100, 50, 60)))
+    bs = spec.buckets(2)
+    assert [b.lo for b in bs] == [0, 2]
+    fire = jnp.asarray([True, True, True, True])
+    caps = collectives.split_capacity(210, bs)  # (100+100, 50+60) -> binding
+    gated = []
+    for b in bs:
+        gated.append(capacity_gate(
+            fire[b.lo:b.hi], b.sizes, caps[b.index]
+        ))
+    eff = np.concatenate([np.asarray(g) for g in gated])
+    # bucket 0 (200 elems) into its ~120-elem split: one leaf defers;
+    # bucket 1's admission is untouched by bucket 0's overflow
+    assert eff[:2].sum() == 1
+    assert caps[1] >= bs[1].floor
+    # monolithic greedy at the same total admits strictly differently
+    mono = np.asarray(capacity_gate(fire, spec.sizes, 210))
+    assert not np.array_equal(eff, mono)
+
+
+def test_bucketed_wire_bytes_sum_to_monolithic():
+    spec = arena.arena_spec(_tree((1024, 16, 160, 10)))
+    for wire in (None, "bf16", "int8"):
+        for k in (2, 4):
+            bs = spec.buckets(k)
+            per = collectives.bucketed_wire_real_bytes_per_neighbor(
+                bs, wire
+            )
+            assert len(per) == k
+            assert sum(per) == collectives.wire_real_bytes_per_neighbor(
+                spec.n_total, spec.n_leaves, wire, fire_bits=True
+            )
+            caps = collectives.split_capacity(spec.n_total, bs)
+            per_c = collectives.bucketed_wire_real_bytes_per_neighbor(
+                bs, wire, caps
+            )
+            assert sum(per_c) == collectives.wire_real_bytes_per_neighbor(
+                spec.n_total, spec.n_leaves, wire,
+                compact_capacity=spec.n_total, fire_bits=True,
+            )
+
+
+# ---------------------------------------------------------------------------
+# the jaxpr interleaving gate (ISSUE 10 acceptance)
+
+
+def test_jaxpr_interleaving_gate():
+    """In the bucketed step's jaxpr, at least one exchange-side op of
+    bucket k appears between update-side ops of buckets k-1 and k+1
+    (machine-checked via analysis/walker.bucket_schedule) — the
+    exchanges interleave with update work instead of forming one
+    prefix block like the monolithic schedule."""
+    K = 4
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05)
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        bucketed=K,
+    )
+    params0 = jax.tree.map(lambda l: l[0], state.params)
+    dims = [b.size for b in arena.arena_spec(params0).buckets(K)]
+    assert len(set(dims)) == K, "gate geometry needs distinct buckets"
+    step = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+        bucketed=K,
+    )
+    batch = _batches(1)[0]
+    closed = jax.make_jaxpr(spmd(step, topo))(state, batch)
+    sched = walker.bucket_schedule(closed.jaxpr, dims, dims)
+    # every bucket's exchange and commit were found...
+    for b in range(K):
+        assert sched["exchange"][b], f"bucket {b}: no exchange ops found"
+        assert sched["update"][b], f"bucket {b}: no update ops found"
+    # ...and the schedule interleaves
+    assert sched["interleaved"], (
+        "bucketed step's exchanges form a prefix block: "
+        f"{sched['exchange']} vs {sched['update']}"
+    )
+
+    # the monolithic step must NOT pass the same gate (its one exchange
+    # precedes every commit — nothing to interleave)
+    state_m = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True
+    )
+    step_m = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=CFG, arena=True
+    )
+    closed_m = jax.make_jaxpr(spmd(step_m, topo))(state_m, batch)
+    n_total = sum(dims)
+    sched_m = walker.bucket_schedule(
+        closed_m.jaxpr, [n_total], [n_total]
+    )
+    assert not sched_m["interleaved"]
+
+
+# ---------------------------------------------------------------------------
+# validation + resume
+
+
+def test_bucketed_validation():
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05)
+    with pytest.raises(ValueError, match="eventgrad"):
+        make_train_step(model, tx, topo, "dpsgd", bucketed=2)
+    with pytest.raises(ValueError, match="arena"):
+        make_train_step(
+            model, tx, topo, "eventgrad", event_cfg=CFG, bucketed=2
+        )
+    from eventgrad_tpu.chaos.integrity import IntegrityConfig
+
+    with pytest.raises(ValueError, match="integrity"):
+        make_train_step(
+            model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+            bucketed=2, integrity=IntegrityConfig(),
+        )
+    # the per-bucket fused tail is measured-gated: without a
+    # bucketed_tail_speedup entry the step refuses (the loop demotes
+    # to the monolithic fused path with a warning instead)
+    from eventgrad_tpu.ops import arena_tuning
+
+    if not arena_tuning.bucketed_tail_ok():
+        with pytest.raises(ValueError, match="bucketed_tail_speedup"):
+            make_train_step(
+                model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+                bucketed=2, fused_sgd=(0.05, 0.9),
+            )
+
+
+def test_bucketed_fused_tail_parity(monkeypatch):
+    """With the measured gate forced open, the per-bucket fused tail
+    (one fused_mix_commit per bucket) reproduces the monolithic fused
+    tail bitwise — the decomposition is positionwise."""
+    from eventgrad_tpu.ops import arena_tuning
+
+    monkeypatch.setattr(arena_tuning, "bucketed_tail_ok", lambda: True)
+    batches = _batches(4)
+    kw = dict(momentum=0.9)
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    def build(bucketed):
+        state = init_train_state(
+            model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0,
+            arena=True, bucketed=bucketed or 1,
+        )
+        step = make_train_step(
+            model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+            fused_sgd=(0.05, 0.9), bucketed=bucketed,
+        )
+        return state, jax.jit(spmd(step, topo))
+
+    s_m, lift_m = build(None)
+    s_b, lift_b = build(2)
+    s_m, _ = _run(s_m, lift_m, batches)
+    s_b, _ = _run(s_b, lift_b, batches)
+    for x, y in zip(jax.tree.leaves(s_m.params),
+                    jax.tree.leaves(s_b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for bm, bb in zip(_flat_bufs(s_m.event.bufs),
+                      _flat_bufs(s_b.event.bufs)):
+        np.testing.assert_array_equal(bm, bb)
+
+
+def test_resume_across_layout_change_fails_loudly(tmp_path):
+    """EventState buffers are carried per-bucket under the bucketed
+    schedule: resuming a monolithic snapshot with --bucketed (or a
+    bucketed snapshot monolithically) must fail LOUDLY, never corrupt
+    state."""
+    from eventgrad_tpu.train.loop import train
+
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=3)
+    common = dict(
+        algo="eventgrad", epochs=1, batch_size=4, event_cfg=CFG, seed=0,
+        log_every_epoch=False, save_every=1,
+    )
+    d1 = str(tmp_path / "mono")
+    train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1, **common)
+    with pytest.raises(RuntimeError, match="bucketed"):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1,
+              resume=True, bucketed=2, **{**common, "epochs": 2})
+    d2 = str(tmp_path / "bucketed")
+    train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d2,
+          bucketed=2, **common)
+    with pytest.raises(Exception):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d2,
+              resume=True, **{**common, "epochs": 2})
+
+
+def test_train_level_bucketed_history_parity():
+    """train(bucketed=K) reproduces the monolithic run's history on
+    every shared numeric field, carries `buckets` and the per-bucket
+    wire split, and a same-K resume round-trips."""
+    from eventgrad_tpu.train.loop import train
+
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=1)
+    common = dict(
+        algo="eventgrad", epochs=2, batch_size=4, event_cfg=CFG, seed=0,
+        log_every_epoch=False,
+    )
+    s_m, h_m = train(MLP(**MODEL), Ring(N_RANKS), x, y, **common)
+    s_b, h_b = train(MLP(**MODEL), Ring(N_RANKS), x, y, bucketed=2,
+                     **common)
+    for x_, y_ in zip(jax.tree.leaves(s_m.params),
+                      jax.tree.leaves(s_b.params)):
+        np.testing.assert_array_equal(np.asarray(x_), np.asarray(y_))
+    for rm, rb in zip(h_m, h_b):
+        assert rb["buckets"] == 2
+        split = rb["sent_bytes_wire_real_per_bucket"]
+        assert len(split) == 2
+        assert sum(split) == pytest.approx(
+            rb["sent_bytes_wire_real_per_step_per_chip"]
+        )
+        for k in ("loss", "train_acc", "num_events", "num_deferred",
+                  "msgs_saved_pct", "fired_frac",
+                  "sent_bytes_per_step_per_chip",
+                  "sent_bytes_wire_real_per_step_per_chip"):
+            assert rm[k] == rb[k], k

@@ -3,13 +3,16 @@
 Walks the full configuration matrix of the fused train step
 (eventgrad_tpu/analysis/audit.py: dpsgd/eventgrad/sp_eventgrad x
 masked|compact x arena on/off x obs/chaos/integrity on/off x wire
-dtypes), proving per cell: rank isolation (the only cross-rank flow is
-the declared neighbor exchange), wire-byte truth (jaxpr-derived bytes
-== accounting formula == the executed step's `sent_bytes_wire_real`,
-exactly), and step hygiene (no host callbacks, ravel budget, wire
+dtypes x the bucketed gossip schedule at K=4), proving per cell: rank
+isolation (the only cross-rank flow is the declared neighbor
+exchange), wire-byte truth (jaxpr-derived bytes == accounting formula
+== the executed step's `sent_bytes_wire_real`, exactly — summed over
+buckets on the bucketed cells, whose offsets must carry K declared
+lane groups), and step hygiene (no host callbacks, ravel budget, wire
 dtype fidelity, donation aliasing).  Then fires every seeded ORACLE
-violation to prove each check can detect its failure class, and runs
-the AST lint rules (analysis/lint.py) over the repo.
+violation to prove each check can detect its failure class (including
+a bucket lane re-shipped at an undeclared offset), and runs the AST
+lint rules (analysis/lint.py) over the repo.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/audit.py [--out artifacts/audit_cpu.json]

@@ -39,6 +39,18 @@ Usage:
       acceptance metric of the flat-arena PR (target: <= 1.05 with
       bitwise-equivalent training, tests/test_arena.py). Validated by
       tools/validate_artifacts.py.
+  python tools/overhead_ablation.py bucketed [n_rounds]
+      bucketed-gossip-schedule A/B (the --bucketed K leg, ISSUE 10):
+      times the eventgrad arena step at the bench op-point under the
+      monolithic schedule (K=1) and the bucketed schedule (K in
+      {2, 4, 8}), scanned + interleaved with MEDIAN PAIRED per-round
+      ratios (the only step-timing protocol stable on this shared
+      CPU), machine-checks the jaxpr interleaving gate
+      (analysis/walker.bucket_schedule: bucket k's exchange ops sit
+      between buckets k-1/k+1's update ops instead of forming one
+      prefix block), and writes artifacts/bucketed_ablation_<platform>
+      .json — schema-gated (BUCKETED_ABLATION_SCHEMA: headline K=4
+      ratio <= 1.02, jaxpr_interleaved true, bitwise_state true).
   python tools/overhead_ablation.py order <ed|de>     in-loop order twin:
       runs the bench op-point's two train() legs in the given order
       (ed = eventgrad first, the bench's order; de = dpsgd first) inside
@@ -83,6 +95,12 @@ from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
 from eventgrad_tpu.train.state import init_train_state  # noqa: E402
 from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
 from eventgrad_tpu.utils.profiling import timed_steps  # noqa: E402
+
+
+def _median(vals):
+    s = sorted(vals)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
 
 
 def _micro(fn, *args, iters: int = 30):
@@ -201,11 +219,6 @@ def arena_experiment(n_rounds: int = 8) -> None:
             jax.block_until_ready(out.params)
             times[k].append((time.perf_counter() - t0) / K * 1000)
 
-    def _median(v):
-        s = sorted(v)
-        mid = len(s) // 2
-        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
-
     results = {}
     for arena_on in (False, True):
         leg = {}
@@ -312,12 +325,150 @@ def arena_experiment(n_rounds: int = 8) -> None:
     print(json.dumps(rec, indent=1))
 
 
+def bucketed_experiment(n_rounds: int = 24) -> None:
+    """A/B the bucketed gossip schedule at the bench op-point (module
+    docstring): same scanned/interleaved/median-paired protocol as
+    `arena_experiment`, with the monolithic (K=1) leg as the paired
+    denominator of every bucketed leg."""
+    import numpy as np
+
+    from eventgrad_tpu.analysis import walker
+    from eventgrad_tpu.parallel import arena
+
+    topo = Ring(8)
+    model = LeNetCifar()
+    lr, mom = 1e-2, 0.9
+    tx = optax.sgd(lr, momentum=mom)
+    per_rank = 8
+    K_SCAN = 16
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    xb, yb = batched_epoch(x, y, topo.n_ranks, per_rank)
+    xs = jnp.asarray(np.stack(
+        [xb[:, s % xb.shape[1]] for s in range(K_SCAN)], 0))
+    ys = jnp.asarray(np.stack(
+        [yb[:, s % yb.shape[1]] for s in range(K_SCAN)], 0))
+    cfg = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+
+    sweep = (1, 2, 4, 8)
+    variants = {}
+    finals = {}  # compile-pass outputs double as the bitwise gate
+    for k in sweep:
+        state = init_train_state(
+            model, x.shape[1:], tx, topo, "eventgrad", cfg,
+            arena=True, bucketed=k,
+        )
+        lifted = spmd(make_train_step(
+            model, tx, topo, "eventgrad", event_cfg=cfg, arena=True,
+            bucketed=(k if k > 1 else None),
+        ), topo)
+
+        def run(s, xs, ys, _l=lifted):
+            return jax.lax.scan(lambda s, b: _l(s, b), s, (xs, ys))
+
+        run = jax.jit(run)
+        t0 = time.perf_counter()
+        out, _ = run(state, xs, ys)
+        jax.block_until_ready(out.params)
+        variants[k] = (state, run, round(time.perf_counter() - t0, 4))
+        finals[k] = jax.tree.leaves(out.params)
+
+    # bitwise gate rides the measurement: every bucketed leg's final
+    # scanned state must equal the monolithic leg's exactly
+    bitwise = all(
+        all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(finals[1], finals[k])
+        )
+        for k in sweep[1:]
+    )
+
+    times = {k: [] for k in sweep}
+    for _ in range(n_rounds):
+        for k, (state, run, _c) in variants.items():
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(out.params)
+            times[k].append((time.perf_counter() - t0) / K_SCAN * 1000)
+
+    results = {}
+    for k in sweep:
+        leg = {
+            "compile_s": variants[k][2],
+            "step_ms_min": round(min(times[k]), 4),
+            "step_ms_p50": round(_median(times[k]), 4),
+        }
+        if k > 1:
+            paired = [b / m for b, m in zip(times[k], times[1])]
+            leg["overhead_ratio_vs_monolithic"] = round(_median(paired), 4)
+        results[f"k{k}"] = leg
+        print(json.dumps({f"k{k}": leg}), flush=True)
+
+    # jaxpr interleaving gate at the headline K=4: at least one
+    # exchange-side op of bucket k sits between update-side ops of
+    # buckets k-1 and k+1 (analysis/walker.bucket_schedule)
+    gate_k = 4
+    st4 = variants[gate_k][0]
+    params0 = jax.tree.map(lambda l: l[0], st4.params)
+    buckets = arena.arena_spec(params0).buckets(gate_k)
+    dims = [b.size for b in buckets]
+    step4 = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=cfg, arena=True,
+        bucketed=gate_k,
+    )
+    closed = jax.make_jaxpr(spmd(step4, topo))(st4, (xs[0], ys[0]))
+    sched = walker.bucket_schedule(closed.jaxpr, dims, dims)
+
+    d = jax.devices()[0]
+    rec = {
+        "bench": "bucketed_ablation",
+        "op_point": {
+            "model": "LeNetCifar", "topology": "ring8",
+            "global_batch": topo.n_ranks * per_rank,
+            "scan_steps": K_SCAN, "rounds": n_rounds, "momentum": mom,
+            "trigger": {"horizon": 1.05, "max_silence": 50, "warmup": 10},
+            "k_sweep": list(sweep),
+        },
+        "results": results,
+        # the acceptance headline: bucketed K=4 vs monolithic, median
+        # paired per-round over scanned steady-state runs (<= 1.02)
+        "overhead_ratio": results["k4"]["overhead_ratio_vs_monolithic"],
+        "bitwise_state": bool(bitwise),
+        "jaxpr_interleaved": bool(sched["interleaved"]),
+        "jaxpr_witnesses": [list(w) for w in sched["witnesses"]],
+        "bucket_sizes_k4": dims,
+        "note": (
+            "ratios are median paired per-round (bucketed/monolithic "
+            "back-to-back under the same load) over scanned "
+            "steady-state runs. On CPU the schedule change is a wash "
+            "inside the ~1-2% noise floor — the overlap win needs real "
+            "async transfers (TPU ICI); this proxy bounds the schedule "
+            "OVERHEAD, and the jaxpr gate proves the emission actually "
+            "interleaves exchange and update work."
+        ),
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    out_path = os.path.join(
+        REPO, "artifacts", f"bucketed_ablation_{d.platform}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "order":
         order_experiment(sys.argv[2] if len(sys.argv) > 2 else "ed")
         return
     if len(sys.argv) > 1 and sys.argv[1] == "arena":
         arena_experiment(int(sys.argv[2]) if len(sys.argv) > 2 else 24)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "bucketed":
+        bucketed_experiment(int(sys.argv[2]) if len(sys.argv) > 2 else 24)
         return
     n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 24
     topo = Ring(8)

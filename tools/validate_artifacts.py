@@ -234,6 +234,32 @@ _BUBBLE_LEG = {
     },
 }
 
+BUCKETED_ABLATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "op_point", "results", "overhead_ratio",
+        "bitwise_state", "jaxpr_interleaved", "platform",
+    ],
+    "properties": {
+        "bench": {"enum": ["bucketed_ablation"]},
+        # the ISSUE 10 acceptance gates: the bucketed schedule's CPU
+        # proxy costs <= 2% over the monolithic step (median paired
+        # per-round, scanned steady state), trains BITWISE the same,
+        # and the traced program actually interleaves exchange-side
+        # ops between other buckets' update-side ops (the jaxpr gate,
+        # analysis/walker.bucket_schedule) instead of forming one
+        # prefix block
+        "overhead_ratio": {"type": "number", "minimum": 0,
+                           "maximum": 1.02},
+        "bitwise_state": {"enum": [True]},
+        "jaxpr_interleaved": {"enum": [True]},
+        "results": {
+            "type": "object",
+            "required": ["k1", "k2", "k4", "k8"],
+        },
+    },
+}
+
 PIPELINE_BUBBLE_SCHEMA = {
     "type": "object",
     "required": [
@@ -533,6 +559,7 @@ _ARTIFACT_FAMILIES = (
     ("obs_report_", OBS_REPORT_SCHEMA),
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
     ("arena_ablation_", ARENA_ABLATION_SCHEMA),
+    ("bucketed_ablation_", BUCKETED_ABLATION_SCHEMA),
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
