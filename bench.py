@@ -19,10 +19,14 @@ lifting path; identical trajectories to the shard_map path per
 test_train_equivalence.py::test_shard_map_matches_vmap).
 
 Also emitted: single-chip MFU for the flagship step (analytic XLA FLOPs from
-compiled cost_analysis / measured steady-state step time / chip peak), and
-wire-mode byte accounting (f32 native plus the derived bf16/int8 wire
-points — deterministic functions of the measured fired counts, see
-train/steps.py wire accounting).
+compiled cost_analysis / measured steady-state step time / chip peak), the
+`costmodel` block (obs/costmodel.py jaxpr walk: phase-split FLOPs/bytes,
+roofline position against obs/devicespec.py peaks — populated on every
+tier; obs/schema.py PERF_FIELDS), and wire-mode byte accounting (f32
+native plus the derived bf16/int8 wire points — deterministic functions of
+the measured fired counts, see train/steps.py wire accounting). The run
+ends with a one-line step_ms/MFU trajectory delta against the committed
+perf ledger (tools/perf_ledger.py) on stderr.
 
 Data: synthetic class-prototype CIFAR-shaped set (no network egress here).
 Augmentation stays OFF for synthetic data — the class prototypes' labels
@@ -454,6 +458,34 @@ def main() -> None:
     mfu = _mfu(flops, step_s)
     mfu = round(mfu, 4) if mfu is not None else None
 
+    # analytic cost model + roofline (obs/costmodel.py, PERF_FIELDS in
+    # obs/schema.py): backend-independent FLOP/byte counts of the SAME
+    # step traced phase-split (grad / gate_pack / exchange / commit_mix),
+    # against the obs/devicespec.py peaks. Populated on EVERY tier — the
+    # CPU tiers' MFU rides the NOMINAL generic-cpu spec, a cross-round
+    # tracking number for tools/perf_ledger.py, never a hardware claim
+    # (nominal_spec marks it). Trace-only: nothing extra compiles.
+    costmodel_rec = None
+    try:
+        from eventgrad_tpu.obs import costmodel as _costmodel
+        from eventgrad_tpu.obs.devicespec import device_spec
+
+        tx_cm = __import__("optax").sgd(1e-2, momentum=0.9)
+        # the traced step's buffer layout auto-matches the state the
+        # training leg produced (arena/bucketed — flops.step_layout_kwargs)
+        cm = _costmodel.analyze_step(
+            model, tx_cm, topo, "eventgrad", event_cfg, x, y, per_rank,
+            state,
+        )
+        rl = _costmodel.roofline(
+            cm["flops_total"], cm["hbm_bytes_total"], step_s,
+            device_spec(),
+        )
+        costmodel_rec = _costmodel.record_block(cm, rl)
+    except Exception as e:  # the bench result line must never die to it
+        import sys as _sys
+        print(f"costmodel block skipped: {e!r}", file=_sys.stderr)
+
     # wire accounting: measured f32-native bytes plus the derived bf16/int8
     # wire points (deterministic in the fired counts; the training effect
     # of the compressed wires is unit-tested in test_wire_bf16.py). int8
@@ -623,6 +655,11 @@ def main() -> None:
                 "mfu": mfu,
                 "flops_per_step": flops or None,
                 "chip_peak_flops": peak or None,
+                # analytic cost model + roofline of the eventgrad step
+                # (obs/costmodel.py; field meanings in obs/schema.py
+                # PERF_FIELDS) — populated on every tier, nominal-spec
+                # flagged on CPU
+                "costmodel": costmodel_rec,
                 "param_dtype_bytes": param_bytes,
                 "sent_bytes_per_step_per_chip": round(sent, 1),
                 "sent_bytes_wire_real": round(sent_real, 1),
@@ -644,6 +681,45 @@ def main() -> None:
             }
         )
     )
+
+    # one-line perf-trajectory delta vs the committed ledger
+    # (tools/perf_ledger.py) — stderr, because stdout is the result-line
+    # contract; comparability = same (platform, model, config) so a CPU
+    # smoke never reads as a regression of a chip round
+    try:
+        import sys as _sys
+
+        from tools import perf_ledger as _pl
+
+        _led_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "artifacts",
+            "perf_ledger_cpu.json",
+        )
+        with open(_led_path) as f:
+            _led = json.load(f)
+        _cur = {
+            "round": _led["n_rounds"] + 1, "source": "(this run)",
+            "status": "ok", "platform": jax.devices()[0].platform,
+            "model": type(model).__name__, "config": tier,
+            "step_ms": round(1000 * step_s, 2),
+            "mfu": (
+                mfu if mfu is not None
+                else (costmodel_rec or {}).get("mfu")
+            ),
+        }
+        _prev = _pl.last_comparable(_led, _cur)
+        if _prev is not None:
+            print(_pl.format_delta(_prev, _cur), file=_sys.stderr)
+        else:
+            print(
+                "perf trajectory: no comparable previous round in "
+                f"{os.path.basename(_led_path)} "
+                f"(group={_pl.comparable_key(_cur)})",
+                file=_sys.stderr,
+            )
+    except Exception as e:
+        import sys as _sys
+        print(f"perf trajectory line skipped: {e!r}", file=_sys.stderr)
 
     trace_path = os.environ.get("EG_BENCH_OBS_TRACE")
     if trace_path:

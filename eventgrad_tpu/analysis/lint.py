@@ -27,6 +27,11 @@ The rules (docs/ANALYSIS.md has the rationale for each):
   * crashpoint-instrumented — every registered crash site appears at
     EXACTLY one literal `crashpoint.hit("<name>")` call (formerly a
     grep in tests/test_crashpoint.py, messages preserved).
+  * wall-clock-confined — `time.time`/`time.perf_counter`/
+    `time.monotonic` in the package belong to `obs/` (spans are the one
+    timing API; tools/ and bench.py are host-side tooling outside this
+    lint's scope).  Pre-existing metric sites are EXEMPT by name with
+    the reason on record, honesty-checked like os-exit-confined.
 
 Adding a rule: subclass `Rule`, implement `check(files)`, append to
 `RULES`.  Scope rules by `rel` prefix; prefer AST matching; when a
@@ -292,6 +297,93 @@ class CrashpointInstrumented(Rule):
         return out
 
 
+class WallClockConfined(Rule):
+    """Wall-clock timing belongs to the observability layer: `obs/`
+    owns durations (Registry spans) and `tools/`/bench.py the host-side
+    tooling (outside this lint's package scope).  A stray
+    `time.perf_counter()` pair elsewhere in the package is a timing
+    fragment the span trace cannot see — the pre-obs fragmentation this
+    repo already consolidated once (PR 3).  Pre-existing metric sites
+    are exempt BY NAME with the reason on record; each exemption is
+    honesty-checked (the file must still contain a wall-clock call, or
+    the exemption has gone stale)."""
+
+    name = "wall-clock-confined"
+    ALLOWED_PREFIX = os.path.join("eventgrad_tpu", "obs") + os.sep
+    #: banned attribute reads on the `time` module (calls AND aliases)
+    BANNED = frozenset({
+        "time", "perf_counter", "perf_counter_ns", "monotonic",
+        "monotonic_ns",
+    })
+    EXEMPT = {
+        os.path.join("eventgrad_tpu", "utils", "profiling.py"):
+            "timed_steps — the pre-span latency helper whose output "
+            "feeds Registry.observe_latency; migrating it is a rename, "
+            "not a timing fragment",
+        os.path.join("eventgrad_tpu", "utils", "metrics.py"):
+            "JsonlLogger's per-record `ts` wall TIMESTAMP (not a "
+            "duration measurement)",
+        os.path.join("eventgrad_tpu", "supervise.py"):
+            "restart-budget / backoff clocks of the process supervisor "
+            "(injectable now= callables; no train-loop timing)",
+        os.path.join("eventgrad_tpu", "train", "loop.py"):
+            "block-boundary wall_s / preemption drain_s metrics — the "
+            "numbers the spans WRAP (spans record them too; the record "
+            "fields predate the registry)",
+        os.path.join("eventgrad_tpu", "chaos", "membership.py"):
+            "membership transition apply_s metric (same vintage as "
+            "loop.py's wall_s)",
+    }
+
+    def _hits(self, sf: SourceFile):
+        # every local name the time module is bound to — `import time`,
+        # `import time as clock` — so aliasing cannot dodge the rule
+        aliases = {"time"}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        aliases.add(a.asname or "time")
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in self.BANNED
+                and isinstance(node.value, ast.Name)
+                and node.value.id in aliases
+            ):
+                yield node.lineno
+            elif (
+                isinstance(node, ast.ImportFrom)
+                and node.module == "time"
+                and any(a.name in self.BANNED for a in node.names)
+            ):
+                yield node.lineno
+
+    def check(self, files):
+        out = []
+        for sf in files:
+            if not _in_package(sf) or sf.rel.startswith(self.ALLOWED_PREFIX):
+                continue
+            hits = list(self._hits(sf))
+            if sf.rel in self.EXEMPT:
+                if not hits:
+                    out.append(self._v(
+                        sf, 1,
+                        "exempt file no longer reads the wall clock — "
+                        "drop it from WallClockConfined.EXEMPT "
+                        f"({self.EXEMPT[sf.rel]})",
+                    ))
+                continue
+            for line in hits:
+                out.append(self._v(
+                    sf, line,
+                    "wall-clock timing outside obs/ — spans are the one "
+                    "timing API (obs.Registry.span); host-side tooling "
+                    "belongs in tools/ or bench.py, not the package",
+                ))
+        return out
+
+
 # --- shard_map skip-pattern rules (tests/) ----------------------------------
 
 #: the seed's shard_map test files: the pre-existing tier-1 baseline
@@ -388,6 +480,7 @@ RULES: Sequence[Rule] = (
     OsExitConfined(),
     NoHostSyncInTraced(),
     CrashpointInstrumented(),
+    WallClockConfined(),
     ShardMapMarkerImport(),
     ShardMapRespell(),
     ShardMapExemptHonest(),

@@ -9,6 +9,11 @@ exporters. `obs.report.build_report` (tools/obs_report.py) renders a
 self-contained run report from any history/JSONL. `obs.bubble`
 decomposes a span trace into wall = steps + host bubble — the dispatch
 pipeline's acceptance metric (tools/bubble_decomposition.py).
+`obs.costmodel` + `obs.devicespec` are the performance ledger's
+analytic side: phase-split FLOP/HBM-byte counts from the traced step's
+jaxpr, MFU and roofline position against per-device peak specs
+(tools/perf_ledger.py owns the cross-round trajectory + regression
+gates; obs/schema.py PERF_FIELDS names every surface).
 """
 
 from eventgrad_tpu.obs.device import TelemetryState, accumulate

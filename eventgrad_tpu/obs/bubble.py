@@ -36,7 +36,18 @@ Consumed by `tools/bubble_decomposition.py` (the committed
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class IncompleteTraceWarning(UserWarning):
+    """A span trace is missing a span type the decomposition wants —
+    e.g. a run killed mid-flight exported no `train` root, or a
+    pipelined block's deferred `block_ready` span never landed. The
+    decomposition degrades to a PARTIAL answer (envelope wall, enqueue
+    end as readiness) and names what was missing in `missing_spans`
+    instead of raising — a truncated trace is evidence, not an error."""
+
 
 #: span names summed into each named bubble component
 _COMPONENTS = {
@@ -102,11 +113,14 @@ def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
     """wall = steps + bubble; bubble >= data + flush + eval + checkpoint
     (serial) — returns the seconds of each plus `host_bubble_frac`."""
     normed = [_norm(s) for s in spans]
+    missing: List[str] = []
     train = [n for n in normed if n[0] == "train"]
     if train:
         wall_us = train[0][2]
         t_lo = train[0][1]
     else:  # no root span: fall back to the observed envelope
+        if normed:
+            missing.append("train")
         t_lo = min((n[1] for n in normed), default=0.0)
         wall_us = max((n[1] + n[2] for n in normed), default=0.0) - t_lo
 
@@ -117,6 +131,7 @@ def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
     }
     busy: List[Tuple[float, float]] = []
     n_blocks = 0
+    n_ready_missing = 0
     pipelined = False
     for n in normed:
         if n[0] != "dispatch_block":
@@ -128,11 +143,33 @@ def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
         # own end IS the observed readiness (the later block_ready span is
         # a no-op recorded after other host work — using it would swallow
         # that work into "busy"). Pipelined blocks: the dispatch span is
-        # just the enqueue; readiness is the deferred block_ready end.
+        # just the enqueue; readiness is the deferred block_ready end —
+        # a truncated trace (run killed before the readback) falls back
+        # to the enqueue end, UNDERCOUNTING steps_s, and says so.
         end = n[1] + n[2]
         if blk_piped:
-            end = max(end, ready_end.get(n[3].get("block"), end))
+            if n[3].get("block") in ready_end:
+                end = max(end, ready_end[n[3].get("block")])
+            else:
+                n_ready_missing += 1
         busy.append((n[1], end))
+    if n_ready_missing:
+        missing.append("block_ready")
+    if n_blocks == 0 and normed:
+        missing.append("dispatch_block")
+    if missing:
+        warnings.warn(
+            "span trace incomplete — missing span types "
+            f"{missing}"
+            + (
+                f" (block_ready absent for {n_ready_missing} pipelined "
+                "blocks: their steps intervals end at the enqueue)"
+                if n_ready_missing else ""
+            )
+            + "; returning a PARTIAL decomposition",
+            IncompleteTraceWarning,
+            stacklevel=2,
+        )
     steps_s = _union_s(busy)
 
     comp = {
@@ -142,7 +179,7 @@ def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
     wall_s = wall_us / 1e6
     bubble_s = max(0.0, wall_s - steps_s)
     other_s = max(0.0, bubble_s - sum(comp.values()))
-    return {
+    out = {
         "wall_s": round(wall_s, 4),
         "steps_s": round(steps_s, 4),
         "bubble_s": round(bubble_s, 4),
@@ -152,24 +189,33 @@ def decompose(spans: Iterable[Any]) -> Dict[str, Any]:
         "n_blocks": n_blocks,
         "pipelined": pipelined,
     }
+    if missing:
+        out["missing_spans"] = sorted(set(missing))
+    return out
 
 
 def render_text(d: Dict[str, Any], label: str = "") -> str:
-    """Human-readable one-block summary of a decomposition."""
+    """Human-readable one-block summary of a decomposition. Tolerates
+    PARTIAL dicts (a truncated trace's decomposition, or one written by
+    an older tool) — absent components render as 0 rather than raising."""
     head = f"bubble decomposition{' (' + label + ')' if label else ''}:"
     lines = [
         head,
-        f"  wall            {d['wall_s']:9.3f} s",
-        f"  steps (device)  {d['steps_s']:9.3f} s",
-        f"  host bubble     {d['bubble_s']:9.3f} s"
-        f"  ({100 * d['host_bubble_frac']:.1f}% of wall)",
+        f"  wall            {d.get('wall_s', 0.0):9.3f} s",
+        f"  steps (device)  {d.get('steps_s', 0.0):9.3f} s",
+        f"  host bubble     {d.get('bubble_s', 0.0):9.3f} s"
+        f"  ({100 * d.get('host_bubble_frac', 0.0):.1f}% of wall)",
     ]
     for key, title in (
         ("data_s", "data"), ("flush_s", "obs flush"), ("eval_s", "eval"),
         ("checkpoint_s", "checkpoint"), ("other_s", "other"),
     ):
-        lines.append(f"    {title:<13} {d[key]:9.3f} s")
+        lines.append(f"    {title:<13} {d.get(key, 0.0):9.3f} s")
     lines.append(
-        f"  blocks={d['n_blocks']} pipelined={d['pipelined']}"
+        f"  blocks={d.get('n_blocks', 0)} pipelined={d.get('pipelined', False)}"
     )
+    if d.get("missing_spans"):
+        lines.append(
+            f"  PARTIAL: trace missing span types {d['missing_spans']}"
+        )
     return "\n".join(lines)

@@ -1,0 +1,435 @@
+"""Analytic per-step cost model: FLOPs + HBM bytes from the jaxpr, split
+by phase, combined with measured step time into MFU and a roofline point.
+
+The repo's only hardware-efficiency number used to be the XLA
+`cost_analysis()` FLOP count bench.py computes on TPU — opaque
+(backend-dependent, unavailable on the CPU tiers) and unattributable (one
+scalar for the whole step). This module walks the traced step's jaxpr
+with the SAME nested traversal the trace auditor uses
+(`analysis/walker.sub_jaxprs` — one walker repo-wide, so the cost model
+and the wire-byte audit read one program) and counts, per primitive:
+
+  * FLOPs — `dot_general` and `conv_general_dilated` exactly from shapes
+    (2·B·M·N·K; 2·out·C_in/g·prod(kernel)), elementwise arithmetic and
+    reductions as one FLOP per operand/output element, pure data movement
+    (gather/select/reshape/convert/compares) as zero.
+  * HBM bytes — operand + result bytes of every equation: the NO-FUSION
+    traffic ceiling. XLA fuses aggressively, so the true traffic is
+    lower; the ceiling is stable across rounds (it depends only on the
+    traced program), which is exactly what a regression ledger needs.
+    `compiled_memory()` reports the backend's own peak-memory analysis
+    next to it when available.
+
+Phase attribution rides `jax.named_scope` annotations
+(`phase_scope("grad"|"gate_pack"|"exchange"|"commit_mix")` — the hooks
+live in train/steps.py; per-bucket scopes are "<phase>.b<k>" under the
+bucketed gossip schedule). Scope names survive vmap lifting AND vjp
+transposition in equation name stacks, so backward-pass work lands in
+the phase whose forward region produced it. Unannotated equations count
+under "other". Annotations are metadata only — the traced computation is
+bitwise identical with them disabled (EG_PHASE_SCOPES=0 /
+`annotations_disabled()`; regression-tested in tests/test_costmodel.py).
+
+`roofline()` turns (FLOPs, bytes, measured step seconds) plus an
+`obs.devicespec.DeviceSpec` into MFU, achieved bytes/s, arithmetic
+intensity, and the compute/memory verdict. `compile_timed()` records the
+trace/lower/compile/first-dispatch wall spans into an `obs.Registry`.
+
+Scan bodies multiply their equation counts by the scan length; `while`
+trip counts are unknowable statically — their bodies count ONCE and the
+result carries `unbounded_loops` so a consumer can see the caveat.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import re
+from typing import Any, Dict, Optional
+
+from eventgrad_tpu.obs.devicespec import DeviceSpec
+
+# --- phase annotation hooks (train/steps.py wraps its regions) -------------
+
+#: named-scope prefix the cost model recognizes; everything else in a
+#: name stack (vmap/transpose wrappers, user scopes) is ignored
+PHASE_PREFIX = "egphase."
+
+#: the canonical step phases, in pipeline order (docs/OBSERVABILITY.md
+#: "Reading the roofline"); "other" absorbs unannotated equations
+PHASES = ("grad", "gate_pack", "exchange", "commit_mix", "other")
+
+_PHASE_RE = re.compile(r"egphase\.([a-z_]+)(?:\.b(\d+))?")
+
+_annotations_on = os.environ.get("EG_PHASE_SCOPES", "1") != "0"
+
+
+def annotations_enabled() -> bool:
+    return _annotations_on
+
+
+@contextlib.contextmanager
+def annotations_disabled():
+    """Trace with phase scopes off — the pre-annotation program, for the
+    bitwise-equivalence regression test."""
+    global _annotations_on
+    prev, _annotations_on = _annotations_on, False
+    try:
+        yield
+    finally:
+        _annotations_on = prev
+
+
+def phase_scope(name: str):
+    """`jax.named_scope(PHASE_PREFIX + name)` — or a no-op context when
+    annotations are disabled. Purely trace-time metadata: never changes
+    the computation, only the name stacks the cost model reads."""
+    if not _annotations_on:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.named_scope(PHASE_PREFIX + name)
+
+
+def phase_of(eqn) -> str:
+    """Full phase label of an equation ("grad", "exchange.b2", ... or
+    "other") from its source-info name stack."""
+    m = _PHASE_RE.search(str(eqn.source_info.name_stack))
+    if not m:
+        return "other"
+    return m.group(1) if m.group(2) is None else f"{m.group(1)}.b{m.group(2)}"
+
+
+# --- per-primitive FLOP rules ----------------------------------------------
+
+#: one FLOP per OUTPUT element
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "neg", "abs", "sign",
+    "pow", "integer_pow", "exp", "exp2", "expm1", "log", "log1p", "sqrt",
+    "rsqrt", "cbrt", "tanh", "sin", "cos", "tan", "atan2", "erf", "erfc",
+    "erf_inv", "logistic", "floor", "ceil", "round", "nextafter",
+    "square",
+})
+
+#: one FLOP per INPUT element (tree reductions / scans over the operand)
+_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumprod", "cummax",
+    "cummin", "cumlogsumexp", "reduce_precision",
+})
+
+#: equations owning sub-jaxprs whose own operands must not be charged
+#: (their bodies are walked instead — charging the boundary would double
+#: count every byte the inner equations already account)
+_CONTAINERS = frozenset({
+    "pjit", "jit", "xla_call", "closed_call", "core_call", "remat",
+    "remat2", "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "scan", "while",
+    "cond", "custom_vjp_call_custom_transpose",
+})
+
+
+def _aval_bytes(v) -> float:
+    aval = getattr(v, "aval", None)
+    dtype = getattr(aval, "dtype", None)
+    if aval is None or dtype is None:
+        return 0.0
+    return float(aval.size) * float(dtype.itemsize)
+
+
+def _dot_flops(eqn) -> float:
+    """2·B·M·N·K from the dot_general dimension numbers — exact."""
+    (lhs_c, rhs_c), (lhs_b, rhs_b) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = math.prod(lhs[d] for d in lhs_b)
+    contract = math.prod(lhs[d] for d in lhs_c)
+    m = math.prod(
+        d for i, d in enumerate(lhs) if i not in lhs_b and i not in lhs_c
+    )
+    n = math.prod(
+        d for i, d in enumerate(rhs) if i not in rhs_b and i not in rhs_c
+    )
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_flops(eqn) -> float:
+    """2 · out_elements · (C_in / feature_groups) · prod(kernel spatial) —
+    exact for the conv as traced (forward convs AND the transposed convs
+    the backward pass emits are each counted from their own shapes)."""
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = dn.rhs_spec  # (out_ch, in_ch/g, *spatial)
+    rhs = eqn.invars[1].aval.shape
+    out_elems = math.prod(eqn.outvars[0].aval.shape)
+    in_ch_per_group = rhs[rhs_spec[1]]
+    kernel_spatial = math.prod(rhs[d] for d in rhs_spec[2:])
+    return 2.0 * out_elems * in_ch_per_group * kernel_spatial
+
+
+def _eqn_flops(eqn) -> float:
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_flops(eqn)
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if name in _ELEMENTWISE:
+        return float(eqn.outvars[0].aval.size)
+    if name in _REDUCTIONS:
+        return float(eqn.invars[0].aval.size)
+    return 0.0
+
+
+# --- the jaxpr walk --------------------------------------------------------
+
+
+def analyze_jaxpr(jaxpr) -> Dict[str, Any]:
+    """Cost model of a (Closed)Jaxpr: totals, per-phase split, and the
+    dot/conv/elementwise decomposition the oracle tests pin.
+
+    Returns
+      flops_total / hbm_bytes_total      — whole-program analytic counts
+      by_phase                           — {base phase: {flops, hbm_bytes}}
+                                           (bucket scopes fold into their
+                                           base phase here)
+      phases                             — the full-label split, buckets
+                                           separate ("exchange.b0", ...)
+      dot_flops / conv_flops / eltwise_flops — per-rule totals
+      n_eqns, unbounded_loops            — walk stats / while-loop caveat
+    """
+    from eventgrad_tpu.analysis import walker
+
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+
+    phases: Dict[str, Dict[str, float]] = {}
+    out = {
+        "flops_total": 0.0, "hbm_bytes_total": 0.0,
+        "dot_flops": 0.0, "conv_flops": 0.0, "eltwise_flops": 0.0,
+        "n_eqns": 0, "unbounded_loops": 0,
+    }
+
+    def _walk(jx, mult: float):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            subs = list(walker.sub_jaxprs(eqn))
+            if subs:
+                sub_mult = mult
+                if name == "scan":
+                    sub_mult = mult * float(eqn.params.get("length", 1))
+                elif name == "while":
+                    out["unbounded_loops"] += 1
+                if name in _CONTAINERS or name in ("scan", "while", "cond"):
+                    for sub in subs:
+                        _walk(sub, sub_mult)
+                    continue
+                # unknown primitive carrying a jaxpr: walk it AND fall
+                # through to charge its own boundary conservatively
+                for sub in subs:
+                    _walk(sub, sub_mult)
+            flops = _eqn_flops(eqn) * mult
+            in_bytes = sum(_aval_bytes(v) for v in eqn.invars)
+            out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
+            bytes_ = (in_bytes + out_bytes) * mult
+            out["n_eqns"] += 1
+            out["flops_total"] += flops
+            out["hbm_bytes_total"] += bytes_
+            if name == "dot_general":
+                out["dot_flops"] += flops
+            elif name == "conv_general_dilated":
+                out["conv_flops"] += flops
+            elif name in _ELEMENTWISE:
+                out["eltwise_flops"] += flops
+            label = phase_of(eqn)
+            slot = phases.setdefault(label, {"flops": 0.0, "hbm_bytes": 0.0})
+            slot["flops"] += flops
+            slot["hbm_bytes"] += bytes_
+
+    _walk(jaxpr, 1.0)
+
+    by_phase = {p: {"flops": 0.0, "hbm_bytes": 0.0} for p in PHASES}
+    for label, slot in phases.items():
+        base = label.split(".")[0]
+        tgt = by_phase.setdefault(base, {"flops": 0.0, "hbm_bytes": 0.0})
+        tgt["flops"] += slot["flops"]
+        tgt["hbm_bytes"] += slot["hbm_bytes"]
+    out["phases"] = phases
+    out["by_phase"] = by_phase
+    return out
+
+
+def analyze_step(model, tx, topo, algo, event_cfg, x, y, per_rank: int,
+                 state, **step_kwargs) -> Dict[str, Any]:
+    """Cost model of one full train step (all vmap-ranks) at this
+    op-point — trace only, nothing compiles or executes. Mirrors
+    `utils.flops.train_step_flops`'s construction exactly so the analytic
+    numbers describe the same program the XLA cost analysis measures."""
+    import jax
+    import jax.numpy as jnp
+
+    from eventgrad_tpu.parallel.spmd import spmd
+    from eventgrad_tpu.train.steps import make_train_step
+    from eventgrad_tpu.utils.flops import step_layout_kwargs
+
+    # the traced step's buffer layout must match the state's (a tree
+    # step cannot consume an arena state) — auto-detect unless the
+    # caller pinned the layout explicitly
+    for k, v in step_layout_kwargs(state).items():
+        step_kwargs.setdefault(k, v)
+    step = make_train_step(
+        model, tx, topo, algo, event_cfg=event_cfg, **step_kwargs
+    )
+    xb = jnp.asarray(x[: topo.n_ranks * per_rank]).reshape(
+        (topo.n_ranks, per_rank) + x.shape[1:]
+    )
+    yb = jnp.asarray(y[: topo.n_ranks * per_rank]).reshape(
+        (topo.n_ranks, per_rank)
+    )
+    jaxpr = jax.make_jaxpr(spmd(step, topo))(state, (xb, yb))
+    return analyze_jaxpr(jaxpr)
+
+
+# --- roofline accounting ---------------------------------------------------
+
+
+def roofline(flops: float, hbm_bytes: float, step_s: float,
+             spec: DeviceSpec) -> Dict[str, Any]:
+    """MFU + roofline position of `flops`/`hbm_bytes` of work observed to
+    take `step_s` seconds on a device with `spec` peaks.
+
+    `roofline_frac` is achieved FLOP/s over the roofline CEILING at this
+    arithmetic intensity — min(peak_flops, intensity · peak_bw) — i.e.
+    "how close to the attainable line", which is the honest utilization
+    number for memory-bound kernels where MFU alone reads unfairly low.
+    """
+    if not (flops and step_s):
+        return {
+            "mfu": None, "achieved_flops_per_s": None,
+            "achieved_bytes_per_s": None, "arithmetic_intensity": None,
+            "ridge_intensity": spec.ridge_intensity,
+            "roofline_bound": None, "roofline_frac": None,
+            "device_spec": spec.name, "nominal_spec": spec.nominal,
+            "peak_flops": spec.peak_flops,
+            "peak_hbm_bytes_per_s": spec.peak_hbm_bytes_per_s,
+        }
+    achieved_f = flops / step_s
+    achieved_b = (hbm_bytes / step_s) if hbm_bytes else None
+    intensity = (flops / hbm_bytes) if hbm_bytes else None
+    ridge = spec.ridge_intensity
+    bound = None
+    ceiling = spec.peak_flops
+    if intensity is not None:
+        bound = "compute" if intensity >= ridge else "memory"
+        ceiling = min(spec.peak_flops, intensity * spec.peak_hbm_bytes_per_s)
+    return {
+        "mfu": achieved_f / spec.peak_flops,
+        "achieved_flops_per_s": achieved_f,
+        "achieved_bytes_per_s": achieved_b,
+        "arithmetic_intensity": intensity,
+        "ridge_intensity": ridge,
+        "roofline_bound": bound,
+        "roofline_frac": achieved_f / ceiling if ceiling else None,
+        "device_spec": spec.name,
+        "nominal_spec": spec.nominal,
+        "peak_flops": spec.peak_flops,
+        "peak_hbm_bytes_per_s": spec.peak_hbm_bytes_per_s,
+    }
+
+
+def record_block(cm: Dict[str, Any], rl: Dict[str, Any]) -> Dict[str, Any]:
+    """The `costmodel` block bench.py and tools/tpu_flagship.py attach
+    to their records — ONE definition (obs/schema.py PERF_FIELDS names
+    the fields), so the two surfaces can never drift apart."""
+    return {
+        "flops_per_step": cm["flops_total"],
+        "hbm_bytes_per_step": cm["hbm_bytes_total"],
+        "flops_by_phase": {
+            k: round(v["flops"]) for k, v in cm["by_phase"].items()
+        },
+        "hbm_bytes_by_phase": {
+            k: round(v["hbm_bytes"]) for k, v in cm["by_phase"].items()
+        },
+        "mfu": round(rl["mfu"], 6) if rl["mfu"] is not None else None,
+        "achieved_flops_per_s": rl["achieved_flops_per_s"],
+        "achieved_bytes_per_s": rl["achieved_bytes_per_s"],
+        "arithmetic_intensity": rl["arithmetic_intensity"],
+        "ridge_intensity": rl["ridge_intensity"],
+        "roofline_bound": rl["roofline_bound"],
+        "roofline_frac": rl["roofline_frac"],
+        "device_spec": rl["device_spec"],
+        "nominal_spec": rl["nominal_spec"],
+    }
+
+
+# --- compiled-program facts (backend-reported, not analytic) ---------------
+
+
+def compiled_memory(compiled) -> Optional[Dict[str, float]]:
+    """The backend's own memory analysis of a compiled executable
+    (argument/output/temp/code bytes + their peak sum), or None where the
+    backend doesn't report one (some CPU builds)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out: Dict[str, float] = {}
+    for attr in (
+        "argument_size_in_bytes", "output_size_in_bytes",
+        "temp_size_in_bytes", "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[attr] = float(v)
+    if not out:
+        return None
+    out["peak_bytes"] = (
+        out.get("argument_size_in_bytes", 0.0)
+        + out.get("output_size_in_bytes", 0.0)
+        + out.get("temp_size_in_bytes", 0.0)
+        - out.get("alias_size_in_bytes", 0.0)
+    )
+    return out
+
+
+def compile_timed(fn, *args, registry=None, label: str = "step"):
+    """Trace, lower, compile, and first-dispatch `fn(*args)`, recording
+    one span per stage ("compile_trace" / "compile_lower" /
+    "compile_compile" / "first_dispatch", cat="compile") into `registry`
+    when given. Returns (compiled, {stage: seconds}, memory) where
+    `memory` is `compiled_memory(compiled)`.
+
+    The lower stage re-traces internally (jax's `.lower()` has no
+    public trace-only entry in this version), so compile_trace measures a
+    `make_jaxpr` of the same call — the honest per-stage approximation,
+    documented here rather than hidden."""
+    import time
+
+    import jax
+
+    spans: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(name):
+        cm = (
+            registry.span(name, cat="compile", label=label)
+            if registry is not None else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with cm:
+            yield
+        spans[name] = time.perf_counter() - t0
+
+    with stage("compile_trace"):
+        jax.make_jaxpr(fn)(*args)
+    jitted = jax.jit(fn)
+    with stage("compile_lower"):
+        lowered = jitted.lower(*args)
+    with stage("compile_compile"):
+        compiled = lowered.compile()
+    with stage("first_dispatch"):
+        out = compiled(*args)
+        jax.block_until_ready(out)
+    return compiled, spans, compiled_memory(compiled)

@@ -1,0 +1,90 @@
+"""Device peak specs: the denominators of MFU and the roofline.
+
+One table owns (peak FLOP/s, peak HBM bytes/s) per device kind —
+`utils.flops.chip_peak_flops` reads its TPU peaks from here, and
+`obs.costmodel.roofline` divides its analytic FLOP/byte counts by the
+same numbers, so the MFU in bench.py and the roofline position in the
+perf ledger can never disagree about what "peak" means.
+
+TPU entries carry the public peak dense-matmul throughput (bf16) and the
+public HBM bandwidth of the generation. Non-TPU backends fall back to
+GENERIC_CPU, a NOMINAL spec (order-of-magnitude single-core numbers,
+`nominal=True`): the CPU "MFU" it yields is a cross-round regression
+TRACKING number for the perf ledger — comparable between rounds on the
+same container, never a hardware-utilization claim. Every consumer that
+prints a nominal-spec MFU must carry the spec name next to it
+(`device_spec` in obs.schema.PERF_FIELDS) so a reader can tell the two
+apart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Peak throughputs of one device (a single chip / a single core)."""
+
+    name: str
+    #: peak dense-matmul FLOP/s (bf16 on TPU; nominal f32 on generic-cpu)
+    peak_flops: float
+    #: peak main-memory bandwidth, bytes/s (HBM on TPU; DRAM on CPU)
+    peak_hbm_bytes_per_s: float
+    #: True = documented placeholder numbers for regression tracking,
+    #: not a measured/published hardware peak
+    nominal: bool = False
+
+    @property
+    def ridge_intensity(self) -> float:
+        """FLOP/byte where the roofline's memory slope meets the compute
+        ceiling: below it a kernel is bandwidth-bound, above compute-bound."""
+        return self.peak_flops / self.peak_hbm_bytes_per_s
+
+
+#: device-kind substring -> spec, most-specific first (same matching rule
+#: as the pre-existing utils.flops.PEAK_FLOPS_BY_KIND, which now reads
+#: its peaks from this table)
+TPU_SPECS: Tuple[Tuple[str, DeviceSpec], ...] = (
+    ("v5 lite", DeviceSpec("tpu-v5e", 197e12, 819e9)),
+    ("v5litepod", DeviceSpec("tpu-v5e", 197e12, 819e9)),
+    ("v5e", DeviceSpec("tpu-v5e", 197e12, 819e9)),
+    ("v5p", DeviceSpec("tpu-v5p", 459e12, 2765e9)),
+    ("v6 lite", DeviceSpec("tpu-v6e", 918e12, 1640e9)),
+    ("v6e", DeviceSpec("tpu-v6e", 918e12, 1640e9)),
+    ("v4", DeviceSpec("tpu-v4", 275e12, 1228e9)),
+    ("v3", DeviceSpec("tpu-v3", 123e12, 900e9)),
+    ("v2", DeviceSpec("tpu-v2", 46e12, 700e9)),
+)
+
+#: the non-TPU fallback: one nominal modern core (~50 f32 GFLOP/s, ~20
+#: GB/s effective stream bandwidth). Deliberately round placeholder
+#: numbers — they make CPU MFU/roofline figures comparable ACROSS ROUNDS
+#: on the same container (the ledger's regression signal), nothing more.
+GENERIC_CPU = DeviceSpec("generic-cpu", 5e10, 2e10, nominal=True)
+
+
+def spec_for_kind(platform: Optional[str], device_kind: Optional[str]) -> DeviceSpec:
+    """Spec from the (platform, device_kind) STRINGS a committed record
+    carries — so the perf ledger can assign peaks to rounds captured on
+    hardware this process doesn't have. Same matching rule as
+    `device_spec`; unknown kinds and non-TPU platforms get GENERIC_CPU."""
+    if platform == "tpu" and device_kind:
+        kind = device_kind.lower()
+        for sub, spec in TPU_SPECS:
+            if sub in kind:
+                return spec
+    return GENERIC_CPU
+
+
+def device_spec(device: Optional[Any] = None) -> DeviceSpec:
+    """Spec of `device` (default: jax.devices()[0]). Unknown TPU kinds and
+    every non-TPU backend get GENERIC_CPU — recognizable by `.nominal`."""
+    import jax
+
+    device = device or jax.devices()[0]
+    return spec_for_kind(
+        getattr(device, "platform", None),
+        getattr(device, "device_kind", None),
+    )

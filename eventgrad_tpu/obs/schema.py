@@ -263,6 +263,94 @@ PREEMPTION_FIELDS = {
 }
 
 
+#: Performance-ledger surfaces (obs/costmodel.py + obs/devicespec.py +
+#: tools/perf_ledger.py): the `costmodel` block bench.py and
+#: tools/tpu_flagship.py attach to their records, and the per-round
+#: trajectory fields of artifacts/perf_ledger*.json.
+#: name -> (units, modes, description)
+PERF_FIELDS = {
+    "flops_per_step": (
+        "FLOP", "all",
+        "analytic FLOPs of one full train step (all vmap-ranks), from "
+        "the obs.costmodel jaxpr walk: dot_general/conv exactly from "
+        "shapes, elementwise/reductions per operand element — "
+        "backend-independent, unlike the XLA cost_analysis number it "
+        "rides next to",
+    ),
+    "hbm_bytes_per_step": (
+        "bytes", "all",
+        "analytic per-step memory-traffic CEILING (operand + result "
+        "bytes of every traced equation, no fusion credit) — stable "
+        "across rounds by construction, the regression ledger's "
+        "bytes denominator",
+    ),
+    "flops_by_phase": (
+        "FLOP[phase]", "all",
+        "the per-phase split grad / gate_pack / exchange / commit_mix "
+        "/ other from the egphase named scopes in train/steps.py "
+        "(per-bucket labels <phase>.bK under bucketed=K)",
+    ),
+    "hbm_bytes_by_phase": (
+        "bytes[phase]", "all",
+        "the same phase split for the analytic byte ceiling",
+    ),
+    "mfu": (
+        "fraction", "all",
+        "model-FLOPs utilization: flops_per_step / (step_s * "
+        "peak_flops) of the device spec — on a nominal spec "
+        "(generic-cpu) a cross-round TRACKING number, not a hardware "
+        "claim (obs/devicespec.py)",
+    ),
+    "achieved_flops_per_s": (
+        "FLOP/s", "all", "flops_per_step / measured step seconds",
+    ),
+    "achieved_bytes_per_s": (
+        "bytes/s", "all",
+        "hbm_bytes_per_step / measured step seconds (against the "
+        "analytic ceiling, so a lower bound on achieved bandwidth "
+        "efficiency)",
+    ),
+    "arithmetic_intensity": (
+        "FLOP/byte", "all",
+        "flops_per_step / hbm_bytes_per_step — the roofline x-axis",
+    ),
+    "ridge_intensity": (
+        "FLOP/byte", "all",
+        "peak_flops / peak_hbm_bytes_per_s of the device spec: the "
+        "roofline ridge — below it memory-bound, above compute-bound",
+    ),
+    "roofline_bound": (
+        "compute|memory", "all",
+        "which roofline regime the step sits in (arithmetic_intensity "
+        "vs ridge_intensity)",
+    ),
+    "roofline_frac": (
+        "fraction", "all",
+        "achieved FLOP/s over the ATTAINABLE ceiling at this "
+        "intensity, min(peak_flops, intensity * peak_bw) — the honest "
+        "utilization for memory-bound steps where raw MFU reads low",
+    ),
+    "device_spec": (
+        "str", "all",
+        "obs.devicespec name the peaks came from (tpu-v5e, ..., "
+        "generic-cpu); nominal_spec=true marks placeholder peaks",
+    ),
+    "peak_hbm_bytes": (
+        "bytes", "all",
+        "the backend's own compiled-program memory analysis "
+        "(obs.costmodel.compiled_memory: argument/output/temp/code "
+        "bytes + peak_bytes), when the backend reports one",
+    ),
+    "compile_spans": (
+        "seconds[stage]", "all",
+        "trace / lower / compile / first-dispatch wall spans "
+        "(obs.costmodel.compile_timed; span names compile_trace, "
+        "compile_lower, compile_compile, first_dispatch in the span "
+        "registry, cat=\"compile\")",
+    ),
+}
+
+
 #: derived series emitted by obs.report.build_report (tools/obs_report.py)
 REPORT_FIELDS = {
     "msgs_saved_pct_per_leaf": (
@@ -297,5 +385,5 @@ def all_field_names():
     names = set(TELEMETRY_FIELDS) | set(RECORD_FIELDS)
     names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
     names |= set(MEMBERSHIP_FIELDS) | set(INTEGRITY_FIELDS)
-    names |= set(PREEMPTION_FIELDS)
+    names |= set(PREEMPTION_FIELDS) | set(PERF_FIELDS)
     return sorted(names)

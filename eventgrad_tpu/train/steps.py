@@ -39,6 +39,7 @@ from jax import lax
 from eventgrad_tpu.chaos import inject as chaos_inject
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.obs.costmodel import phase_scope as _phase
 from eventgrad_tpu.chaos.policy import RecoveryPolicy, alive_mask
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
@@ -389,11 +390,14 @@ def make_train_step(
         # cotangent pull-back): the backward pass is a plain function
         # call here, so the bucketed schedule below can begin emitting
         # per-bucket exchange work against its outputs with no
-        # value_and_grad closure in between
-        loss, vjp_fn, (out, new_stats) = jax.vjp(
-            loss_fn, state.params, has_aux=True
-        )
-        (grads,) = vjp_fn(jnp.ones((), loss.dtype))
+        # value_and_grad closure in between. The phase scope is trace
+        # metadata for the cost model (obs/costmodel.py) — the backward
+        # equations inherit it through vjp transposition.
+        with _phase("grad"):
+            loss, vjp_fn, (out, new_stats) = jax.vjp(
+                loss_fn, state.params, has_aux=True
+            )
+            (grads,) = vjp_fn(jnp.ones((), loss.dtype))
 
         # auxiliary (non-gossip) parallelism axes — e.g. sequence parallelism:
         # ranks along them hold identical parameters and share one logical
@@ -554,12 +558,13 @@ def make_train_step(
             wire_real = sent_bytes
 
         elif algo == "dpsgd":
-            if use_arena:
-                arena_bufs = collectives.neighbor_vals_flat(
-                    params, topo, spec, wire
-                )
-            else:
-                bufs = collectives.neighbor_vals(params, topo, wire)
+            with _phase("exchange"):
+                if use_arena:
+                    arena_bufs = collectives.neighbor_vals_flat(
+                        params, topo, spec, wire
+                    )
+                else:
+                    bufs = collectives.neighbor_vals(params, topo, wire)
             if deliver is not None:
                 # lossy D-PSGD has no stale buffer to fall back to: a
                 # dropped edge leaves this pass's mix and the weight
@@ -584,52 +589,53 @@ def make_train_step(
                 if (chaos is not None and chaos_policy.sync_after)
                 else None
             )
-            prop = propose(
-                params, event_state, pass_num, event_cfg,
-                force_fire=force_fire,
-            )
-            fire_raw = prop.fire_vec
-            if quar is not None:
-                fire_raw = fire_raw & ~jnp.broadcast_to(
-                    quar, fire_raw.shape
+            with _phase("gate_pack"):
+                prop = propose(
+                    params, event_state, pass_num, event_cfg,
+                    force_fire=force_fire,
                 )
-            leaves = spec.treedef.flatten_up_to(params)
-            B = len(buckets_eff)
-            caps = None
-            pri = None
-            if gossip_wire == "compact":
-                # per-bucket capacity split: element-proportional with
-                # per-bucket floors, exact total (split_capacity);
-                # admission and deferral re-contention are BUCKET-LOCAL
-                caps = collectives.split_capacity(
-                    compact_capacity, buckets_eff
-                )
-                if event_cfg.max_silence > 0:
-                    pri = prop.iter_diff >= event_cfg.max_silence
-                if force_fire is not None:
-                    ff = jnp.broadcast_to(force_fire, fire_raw.shape)
-                    pri = ff if pri is None else (pri | ff)
-            fire_bs = []
-            for b in buckets_eff:
-                fb = fire_raw[b.lo:b.hi]
-                if caps is not None:
-                    pb = pri[b.lo:b.hi] if pri is not None else None
-                    fb = capacity_gate(
-                        fb, b.sizes, caps[b.index], priority=pb
+                fire_raw = prop.fire_vec
+                if quar is not None:
+                    fire_raw = fire_raw & ~jnp.broadcast_to(
+                        quar, fire_raw.shape
                     )
-                fire_bs.append(fb)
-            fire_vec = jnp.concatenate(fire_bs)
-            event_state = commit(
-                event_state, prop, fire_vec, event_cfg, n_nb
-            )
-            obs_prop, obs_fire_vec = prop, fire_vec
-            arena_fire_vec = fire_vec
-            scale_vec = (
-                collectives._masked_scales(
-                    collectives._leaf_absmax(leaves), fire_vec
+                leaves = spec.treedef.flatten_up_to(params)
+                B = len(buckets_eff)
+                caps = None
+                pri = None
+                if gossip_wire == "compact":
+                    # per-bucket capacity split: element-proportional with
+                    # per-bucket floors, exact total (split_capacity);
+                    # admission and deferral re-contention are BUCKET-LOCAL
+                    caps = collectives.split_capacity(
+                        compact_capacity, buckets_eff
+                    )
+                    if event_cfg.max_silence > 0:
+                        pri = prop.iter_diff >= event_cfg.max_silence
+                    if force_fire is not None:
+                        ff = jnp.broadcast_to(force_fire, fire_raw.shape)
+                        pri = ff if pri is None else (pri | ff)
+                fire_bs = []
+                for b in buckets_eff:
+                    fb = fire_raw[b.lo:b.hi]
+                    if caps is not None:
+                        pb = pri[b.lo:b.hi] if pri is not None else None
+                        fb = capacity_gate(
+                            fb, b.sizes, caps[b.index], priority=pb
+                        )
+                    fire_bs.append(fb)
+                fire_vec = jnp.concatenate(fire_bs)
+                event_state = commit(
+                    event_state, prop, fire_vec, event_cfg, n_nb
                 )
-                if wire == "int8" else None
-            )
+                obs_prop, obs_fire_vec = prop, fire_vec
+                arena_fire_vec = fire_vec
+                scale_vec = (
+                    collectives._masked_scales(
+                        collectives._leaf_absmax(leaves), fire_vec
+                    )
+                    if wire == "int8" else None
+                )
             lasts = event_state.bufs  # per-neighbor tuples of buckets
             shipped = [None] * B      # (cands, effs, raws) per bucket
             new_bufs_b = [None] * B   # per bucket: per-neighbor tuple
@@ -650,50 +656,60 @@ def make_train_step(
                     else None
                 )
                 if caps is not None:
-                    packed, leaf_id = collectives._compact_pack(
-                        _bflat(lv), fire_bs[bi], b.sizes, b.starts_rel,
-                        caps[bi],
-                    )
-                    shipped[bi] = collectives.compact_neighbor_vals_bucket(
-                        packed, leaf_id, fire_bs[bi], topo, b, caps[bi],
-                        spec.dtype, wire, deliver=deliver, scale_vec=sv,
-                    )
+                    with _phase(f"gate_pack.b{bi}"):
+                        packed, leaf_id = collectives._compact_pack(
+                            _bflat(lv), fire_bs[bi], b.sizes,
+                            b.starts_rel, caps[bi],
+                        )
+                    with _phase(f"exchange.b{bi}"):
+                        shipped[bi] = (
+                            collectives.compact_neighbor_vals_bucket(
+                                packed, leaf_id, fire_bs[bi], topo, b,
+                                caps[bi], spec.dtype, wire,
+                                deliver=deliver, scale_vec=sv,
+                            )
+                        )
                 else:
-                    shipped[bi] = collectives.masked_neighbor_vals_bucket(
-                        lv, fire_bs[bi], topo, b, spec.dtype, wire,
-                        deliver=deliver, scale_vec=sv,
-                    )
+                    with _phase(f"exchange.b{bi}"):
+                        shipped[bi] = (
+                            collectives.masked_neighbor_vals_bucket(
+                                lv, fire_bs[bi], topo, b, spec.dtype,
+                                wire, deliver=deliver, scale_vec=sv,
+                            )
+                        )
 
             def _commit_bufs(bi):
-                b = buckets_eff[bi]
-                cands, effs, _raws = shipped[bi]
-                last_b = tuple(lasts[i][bi] for i in range(n_nb))
-                new_bufs_b[bi] = collectives.commit_bufs_flat(
-                    cands, effs, last_b, b
-                )
+                with _phase(f"commit_mix.b{bi}"):
+                    b = buckets_eff[bi]
+                    cands, effs, _raws = shipped[bi]
+                    last_b = tuple(lasts[i][bi] for i in range(n_nb))
+                    new_bufs_b[bi] = collectives.commit_bufs_flat(
+                        cands, effs, last_b, b
+                    )
 
             def _mix(bi, w, gate):
                 # per-leaf slices of the bucket buffers feeding the
                 # optax tail directly — the bucketed twin of
                 # mix_flat_into_tree, same neighbor add order, bitwise
-                b = buckets_eff[bi]
-                use_b = (
-                    tuple(lasts[i][bi] for i in range(n_nb))
-                    if staleness else new_bufs_b[bi]
-                )
-                for j, k in enumerate(range(b.lo, b.hi)):
-                    p = leaves[k]
-                    acc = p
-                    for i, buf in enumerate(use_b):
-                        piece = lax.dynamic_slice_in_dim(
-                            buf, b.starts_rel[j], b.sizes[j], 0
-                        ).reshape(p.shape)
-                        if gate is not None:
-                            piece = jnp.where(
-                                gate[i], piece, jnp.zeros_like(piece)
-                            )
-                        acc = jnp.add(acc, piece)
-                    mixed_leaves[k] = acc * w
+                with _phase(f"commit_mix.b{bi}"):
+                    b = buckets_eff[bi]
+                    use_b = (
+                        tuple(lasts[i][bi] for i in range(n_nb))
+                        if staleness else new_bufs_b[bi]
+                    )
+                    for j, k in enumerate(range(b.lo, b.hi)):
+                        p = leaves[k]
+                        acc = p
+                        for i, buf in enumerate(use_b):
+                            piece = lax.dynamic_slice_in_dim(
+                                buf, b.starts_rel[j], b.sizes[j], 0
+                            ).reshape(p.shape)
+                            if gate is not None:
+                                piece = jnp.where(
+                                    gate[i], piece, jnp.zeros_like(piece)
+                                )
+                            acc = jnp.add(acc, piece)
+                        mixed_leaves[k] = acc * w
 
             if use_fused:
                 # per-bucket fused tail: commit + mix + SGD in one
@@ -717,33 +733,34 @@ def make_train_step(
                 )
 
                 def _fused_tail(bi):
-                    b = buckets_eff[bi]
-                    cands, effs, _raws = shipped[bi]
-                    seg_b = b.seg_expand()
-                    keeps = tuple(e[seg_b] for e in effs)
-                    last_b = tuple(lasts[i][bi] for i in range(n_nb))
-                    flat_b = _bflat(leaves[b.lo:b.hi])
-                    g_b = _bflat(g_leaves[b.lo:b.hi])
-                    t_b = (
-                        _bflat(t_leaves[b.lo:b.hi]) if mom_f
-                        else jnp.zeros_like(flat_b)
-                    )
-                    p_b, t_b2, nb_b = tail_fn(
-                        flat_b, cands, keeps, last_b, g_b, t_b,
-                        float(lr_f), float(mom_f), topo.mix_weight,
-                        mix_stale=bool(staleness),
-                    )
-                    new_bufs_b[bi] = nb_b
-                    for j, k in enumerate(range(b.lo, b.hi)):
-                        sl = slice(
-                            b.starts_rel[j],
-                            b.starts_rel[j] + b.sizes[j],
+                    with _phase(f"commit_mix.b{bi}"):
+                        b = buckets_eff[bi]
+                        cands, effs, _raws = shipped[bi]
+                        seg_b = b.seg_expand()
+                        keeps = tuple(e[seg_b] for e in effs)
+                        last_b = tuple(lasts[i][bi] for i in range(n_nb))
+                        flat_b = _bflat(leaves[b.lo:b.hi])
+                        g_b = _bflat(g_leaves[b.lo:b.hi])
+                        t_b = (
+                            _bflat(t_leaves[b.lo:b.hi]) if mom_f
+                            else jnp.zeros_like(flat_b)
                         )
-                        p_new[k] = p_b[sl].reshape(leaves[k].shape)
-                        if mom_f:
-                            t_new[k] = t_b2[sl].reshape(
-                                t_leaves[k].shape
+                        p_b, t_b2, nb_b = tail_fn(
+                            flat_b, cands, keeps, last_b, g_b, t_b,
+                            float(lr_f), float(mom_f), topo.mix_weight,
+                            mix_stale=bool(staleness),
+                        )
+                        new_bufs_b[bi] = nb_b
+                        for j, k in enumerate(range(b.lo, b.hi)):
+                            sl = slice(
+                                b.starts_rel[j],
+                                b.starts_rel[j] + b.sizes[j],
                             )
+                            p_new[k] = p_b[sl].reshape(leaves[k].shape)
+                            if mom_f:
+                                t_new[k] = t_b2[sl].reshape(
+                                    t_leaves[k].shape
+                                )
 
                 _ship(0)
                 for bi in range(1, B):
@@ -841,24 +858,32 @@ def make_train_step(
             # ONE fused sender pass: trigger -> gate -> pack
             # (ops/event_engine.py), replacing the tree path's flatten /
             # propose / capacity_gate / _compact_pack chain below
-            prop, fire_vec, packed, leaf_id = event_engine.event_propose_pack(
-                params, event_state, pass_num, event_cfg, spec,
-                capacity=(
-                    compact_capacity if gossip_wire == "compact" else None
-                ),
-                force_fire=force_fire,
-                suppress_fire=quar,  # quarantine: send nothing this pass
-            )
-            event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
+            with _phase("gate_pack"):
+                prop, fire_vec, packed, leaf_id = (
+                    event_engine.event_propose_pack(
+                        params, event_state, pass_num, event_cfg, spec,
+                        capacity=(
+                            compact_capacity if gossip_wire == "compact"
+                            else None
+                        ),
+                        force_fire=force_fire,
+                        # quarantine: send nothing this pass
+                        suppress_fire=quar,
+                    )
+                )
+                event_state = commit(
+                    event_state, prop, fire_vec, event_cfg, n_nb
+                )
             obs_prop, obs_fire_vec = prop, fire_vec
             arena_fire_vec = fire_vec
             if gossip_wire == "compact":
-                res = collectives.compact_neighbor_vals_flat(
-                    params, fire_vec, packed, leaf_id, topo,
-                    compact_capacity, spec, wire, deliver=deliver,
-                    checksum=integ_checksum, finite=integ_quar,
-                    corrupt=corrupt_fn,
-                )
+                with _phase("exchange"):
+                    res = collectives.compact_neighbor_vals_flat(
+                        params, fire_vec, packed, leaf_id, topo,
+                        compact_capacity, spec, wire, deliver=deliver,
+                        checksum=integ_checksum, finite=integ_quar,
+                        corrupt=corrupt_fn,
+                    )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
                         n_params_static, n_leaves_static, wire,
@@ -875,12 +900,13 @@ def make_train_step(
                     wb = lambda f, fe, se: event_engine.masked_wire(
                         f, fe, se, interpret=False
                     )
-                res = collectives.masked_neighbor_vals_flat(
-                    params, fire_vec, topo, spec, wire, deliver=deliver,
-                    wire_builder=wb,
-                    checksum=integ_checksum, finite=integ_quar,
-                    corrupt=corrupt_fn,
-                )
+                with _phase("exchange"):
+                    res = collectives.masked_neighbor_vals_flat(
+                        params, fire_vec, topo, spec, wire,
+                        deliver=deliver, wire_builder=wb,
+                        checksum=integ_checksum, finite=integ_quar,
+                        corrupt=corrupt_fn,
+                    )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
                         n_params_static, n_leaves_static, wire,
@@ -914,9 +940,10 @@ def make_train_step(
                 # (fused_mix_commit): the stale buffers are read once
                 arena_pending = (cands, effs, lasts)
             else:
-                new_bufs = collectives.commit_bufs_flat(
-                    cands, effs, lasts, spec
-                )
+                with _phase("commit_mix"):
+                    new_bufs = collectives.commit_bufs_flat(
+                        cands, effs, lasts, spec
+                    )
                 # staleness=1: mix with what had arrived as of the
                 # PREVIOUS step; this step's exchange lands for the next
                 arena_bufs = lasts if staleness else new_bufs
@@ -936,44 +963,49 @@ def make_train_step(
                 else None
             )
             p_leaves, p_def = jax.tree.flatten(params)
-            prop = propose(
-                params, event_state, pass_num, event_cfg,
-                force_fire=force_fire,
-            )
-            fire_vec = prop.fire_vec
-            if quar is not None:
-                # quarantine: send nothing this pass (suppression wins
-                # over force_fire — never answer a sync request with
-                # poisoned values); suppressed leaves re-contend next
-                # pass like a capacity deferral
-                fire_vec = fire_vec & ~quar
-            if gossip_wire == "compact":
-                # wire-budget admission: overdue leaves (max_silence) and
-                # chaos forced syncs claim capacity first; the overflow is
-                # deferred — commit() below rolls its state back so it
-                # re-contends next pass
-                leaf_sizes = tuple(int(l.size) for l in p_leaves)
-                pri = None
-                if event_cfg.max_silence > 0:
-                    pri = prop.iter_diff >= event_cfg.max_silence
-                if force_fire is not None:
-                    ff = jnp.broadcast_to(force_fire, fire_vec.shape)
-                    pri = ff if pri is None else (pri | ff)
-                fire_vec = capacity_gate(
-                    fire_vec, leaf_sizes, compact_capacity, priority=pri
+            with _phase("gate_pack"):
+                prop = propose(
+                    params, event_state, pass_num, event_cfg,
+                    force_fire=force_fire,
                 )
-            event_state = commit(event_state, prop, fire_vec, event_cfg, n_nb)
+                fire_vec = prop.fire_vec
+                if quar is not None:
+                    # quarantine: send nothing this pass (suppression wins
+                    # over force_fire — never answer a sync request with
+                    # poisoned values); suppressed leaves re-contend next
+                    # pass like a capacity deferral
+                    fire_vec = fire_vec & ~quar
+                if gossip_wire == "compact":
+                    # wire-budget admission: overdue leaves (max_silence)
+                    # and chaos forced syncs claim capacity first; the
+                    # overflow is deferred — commit() below rolls its
+                    # state back so it re-contends next pass
+                    leaf_sizes = tuple(int(l.size) for l in p_leaves)
+                    pri = None
+                    if event_cfg.max_silence > 0:
+                        pri = prop.iter_diff >= event_cfg.max_silence
+                    if force_fire is not None:
+                        ff = jnp.broadcast_to(force_fire, fire_vec.shape)
+                        pri = ff if pri is None else (pri | ff)
+                    fire_vec = capacity_gate(
+                        fire_vec, leaf_sizes, compact_capacity,
+                        priority=pri,
+                    )
+                event_state = commit(
+                    event_state, prop, fire_vec, event_cfg, n_nb
+                )
             obs_prop, obs_fire_vec = prop, fire_vec
             fire = jax.tree.unflatten(
                 p_def, [fire_vec[i] for i in range(len(p_leaves))]
             )
             if gossip_wire == "compact":
-                res = collectives.compact_neighbor_vals(
-                    params, fire, event_state.bufs, topo, compact_capacity,
-                    wire, deliver=deliver,
-                    checksum=integ_checksum, finite=integ_quar,
-                    corrupt=corrupt_fn,
-                )
+                with _phase("exchange"):
+                    res = collectives.compact_neighbor_vals(
+                        params, fire, event_state.bufs, topo,
+                        compact_capacity, wire, deliver=deliver,
+                        checksum=integ_checksum, finite=integ_quar,
+                        corrupt=corrupt_fn,
+                    )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
                         n_params_static, n_leaves_static, wire,
@@ -981,12 +1013,13 @@ def make_train_step(
                     )
                 )
             else:
-                res = collectives.masked_neighbor_vals(
-                    params, fire, event_state.bufs, topo, wire,
-                    deliver=deliver,
-                    checksum=integ_checksum, finite=integ_quar,
-                    corrupt=corrupt_fn,
-                )
+                with _phase("exchange"):
+                    res = collectives.masked_neighbor_vals(
+                        params, fire, event_state.bufs, topo, wire,
+                        deliver=deliver,
+                        checksum=integ_checksum, finite=integ_quar,
+                        corrupt=corrupt_fn,
+                    )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
                         n_params_static, n_leaves_static, wire,
@@ -1036,20 +1069,22 @@ def make_train_step(
             # lift leaves sp alone: its top-k scatter replicas are
             # tree-shaped state, and the trigger already reads leaves
             # leaf-parallel.)
-            prop = propose(params, event_state, pass_num, event_cfg)
-            event_state = commit(
-                event_state, prop, prop.fire_vec, event_cfg, n_nb
-            )
+            with _phase("gate_pack"):
+                prop = propose(params, event_state, pass_num, event_cfg)
+                event_state = commit(
+                    event_state, prop, prop.fire_vec, event_cfg, n_nb
+                )
             p_leaves, p_def = jax.tree.flatten(params)
             fire = jax.tree.unflatten(
                 p_def, [prop.fire_vec[i] for i in range(len(p_leaves))]
             )
             obs_prop, obs_fire_vec = prop, prop.fire_vec
             stale_replicas = sparse_state.replicas
-            sparse_state = sparse_exchange(
-                params, fire, sparse_state, topo, sparse_cfg, wire,
-                buckets=buckets_eff,
-            )
+            with _phase("exchange"):
+                sparse_state = sparse_exchange(
+                    params, fire, sparse_state, topo, sparse_cfg, wire,
+                    buckets=buckets_eff,
+                )
             bufs = stale_replicas if staleness else sparse_state.replicas
             ks = tuple(
                 sparse_cfg.k_for(p.size) for p in jax.tree.leaves(params)
@@ -1086,125 +1121,129 @@ def make_train_step(
                     per_bucket, jnp.float32
                 )
 
-        if bucketed_tail_done:
-            # bucketed fused tail: params/opt_state already updated per
-            # bucket inside the pipelined schedule above
-            pass
-        elif bucketed_mixed is not None:
-            # bucketed mix emitted per bucket above; the optimizer tail
-            # stays the monolithic optax call on the assembled mixed
-            # pytree — bitwise the arena tail (same values, same order)
-            updates, opt_state = tx.update(
-                grads, state.opt_state, bucketed_mixed
-            )
-            params = optax.apply_updates(bucketed_mixed, updates)
-        elif use_fused and (arena_pending is not None or arena_bufs is not None):
-            # arena fused tail: buffer commit + mix + momentum-SGD in one
-            # flat pass (ops/arena_update.fused_mix_commit); dpsgd has no
-            # commit, so it rides fused_mix_sgd on the single flat leaf
-            lr_f, mom_f = fused_sgd
-            flat = spec.ravel(params)
-            g_flat = spec.ravel(grads)
-            if mom_f:
-                t_flat = spec.ravel(state.opt_state[0].trace)
-            else:
-                t_flat = jnp.zeros_like(flat)
-            if arena_pending is not None:
-                cands, effs, lasts = arena_pending
-                seg = spec.seg_expand()  # [n] keeps for the kernel only
-                keeps = tuple(e[seg] for e in effs)
-                tail_fn = (
-                    functools.partial(
-                        fused_mix_commit, interpret=fused_interpret
+        # the whole receive-commit / mix / optimizer tail is ONE
+        # cost-model phase (obs/costmodel.py "commit_mix"); the
+        # bucketed schedule annotated its per-bucket twins above
+        with _phase("commit_mix"):
+            if bucketed_tail_done:
+                # bucketed fused tail: params/opt_state already updated per
+                # bucket inside the pipelined schedule above
+                pass
+            elif bucketed_mixed is not None:
+                # bucketed mix emitted per bucket above; the optimizer tail
+                # stays the monolithic optax call on the assembled mixed
+                # pytree — bitwise the arena tail (same values, same order)
+                updates, opt_state = tx.update(
+                    grads, state.opt_state, bucketed_mixed
+                )
+                params = optax.apply_updates(bucketed_mixed, updates)
+            elif use_fused and (arena_pending is not None or arena_bufs is not None):
+                # arena fused tail: buffer commit + mix + momentum-SGD in one
+                # flat pass (ops/arena_update.fused_mix_commit); dpsgd has no
+                # commit, so it rides fused_mix_sgd on the single flat leaf
+                lr_f, mom_f = fused_sgd
+                flat = spec.ravel(params)
+                g_flat = spec.ravel(grads)
+                if mom_f:
+                    t_flat = spec.ravel(state.opt_state[0].trace)
+                else:
+                    t_flat = jnp.zeros_like(flat)
+                if arena_pending is not None:
+                    cands, effs, lasts = arena_pending
+                    seg = spec.seg_expand()  # [n] keeps for the kernel only
+                    keeps = tuple(e[seg] for e in effs)
+                    tail_fn = (
+                        functools.partial(
+                            fused_mix_commit, interpret=fused_interpret
+                        )
+                        if arena_tuning.mix_commit_ok() else mix_commit_reference
                     )
-                    if arena_tuning.mix_commit_ok() else mix_commit_reference
+                    p_flat, new_t_flat, new_bufs = tail_fn(
+                        flat, cands, keeps, lasts, g_flat, t_flat,
+                        float(lr_f), float(mom_f), topo.mix_weight,
+                        mix_stale=bool(staleness),
+                    )
+                    event_state = event_state.replace(bufs=new_bufs)
+                else:
+                    buf_sum = jnp.zeros_like(flat)
+                    for b in arena_bufs:
+                        buf_sum = jnp.add(buf_sum, b)
+                    p_flat, new_t_flat = fused_mix_sgd(
+                        flat, buf_sum, g_flat, t_flat, lr_f, mom_f,
+                        topo.mix_weight, interpret=fused_interpret,
+                    )
+                params = spec.unravel(p_flat)
+                if mom_f:
+                    opt_state = (
+                        state.opt_state[0]._replace(
+                            trace=spec.unravel(new_t_flat)
+                        ),
+                    ) + tuple(state.opt_state[1:])
+                else:
+                    opt_state = state.opt_state
+            elif use_fused:
+                # Pallas fused tail: mix + momentum-SGD in one HBM pass.
+                lr_f, mom_f = fused_sgd
+                buf_sum = trees.tree_zeros_like(params)
+                for buf in bufs:
+                    buf_sum = jax.tree.map(jnp.add, buf_sum, buf)
+                if mom_f:
+                    mom_trace = state.opt_state[0].trace
+                else:
+                    mom_trace = trees.tree_zeros_like(params)
+                params, new_trace = fused_mix_sgd(
+                    params, buf_sum, grads, mom_trace,
+                    lr_f, mom_f, topo.mix_weight, interpret=fused_interpret,
                 )
-                p_flat, new_t_flat, new_bufs = tail_fn(
-                    flat, cands, keeps, lasts, g_flat, t_flat,
-                    float(lr_f), float(mom_f), topo.mix_weight,
-                    mix_stale=bool(staleness),
-                )
-                event_state = event_state.replace(bufs=new_bufs)
+                if mom_f:
+                    opt_state = (state.opt_state[0]._replace(trace=new_trace),) + tuple(
+                        state.opt_state[1:]
+                    )
+                else:
+                    opt_state = state.opt_state
+            elif arena_bufs is not None:
+                # arena mix + SGD tail: the mix reads the FLAT neighbor
+                # buffers through per-leaf slices and emits the mixed pytree
+                # directly (mix_flat_into_tree) — each leaf is an
+                # independent fusion feeding the optax tail, bitwise the
+                # tree mix, with no assembled intermediate on the critical
+                # path. Chaos gate semantics identical to the tree branch.
+                gate = None
+                if deliver is not None and arena_bufs:
+                    alive = alive_mask(health.silence, chaos_policy)
+                    if algo == "dpsgd":
+                        gate = deliver if alive is None else deliver & alive
+                    elif alive is not None:
+                        gate = alive
+                if arena_bufs:
+                    mixed = collectives.mix_flat_into_tree(
+                        params, arena_bufs, spec, topo, gate=gate
+                    )
+                else:
+                    mixed = params
+                updates, opt_state = tx.update(grads, state.opt_state, mixed)
+                params = optax.apply_updates(mixed, updates)
             else:
-                buf_sum = jnp.zeros_like(flat)
-                for b in arena_bufs:
-                    buf_sum = jnp.add(buf_sum, b)
-                p_flat, new_t_flat = fused_mix_sgd(
-                    flat, buf_sum, g_flat, t_flat, lr_f, mom_f,
-                    topo.mix_weight, interpret=fused_interpret,
-                )
-            params = spec.unravel(p_flat)
-            if mom_f:
-                opt_state = (
-                    state.opt_state[0]._replace(
-                        trace=spec.unravel(new_t_flat)
-                    ),
-                ) + tuple(state.opt_state[1:])
-            else:
-                opt_state = state.opt_state
-        elif use_fused:
-            # Pallas fused tail: mix + momentum-SGD in one HBM pass.
-            lr_f, mom_f = fused_sgd
-            buf_sum = trees.tree_zeros_like(params)
-            for buf in bufs:
-                buf_sum = jax.tree.map(jnp.add, buf_sum, buf)
-            if mom_f:
-                mom_trace = state.opt_state[0].trace
-            else:
-                mom_trace = trees.tree_zeros_like(params)
-            params, new_trace = fused_mix_sgd(
-                params, buf_sum, grads, mom_trace,
-                lr_f, mom_f, topo.mix_weight, interpret=fused_interpret,
-            )
-            if mom_f:
-                opt_state = (state.opt_state[0]._replace(trace=new_trace),) + tuple(
-                    state.opt_state[1:]
-                )
-            else:
-                opt_state = state.opt_state
-        elif arena_bufs is not None:
-            # arena mix + SGD tail: the mix reads the FLAT neighbor
-            # buffers through per-leaf slices and emits the mixed pytree
-            # directly (mix_flat_into_tree) — each leaf is an
-            # independent fusion feeding the optax tail, bitwise the
-            # tree mix, with no assembled intermediate on the critical
-            # path. Chaos gate semantics identical to the tree branch.
-            gate = None
-            if deliver is not None and arena_bufs:
-                alive = alive_mask(health.silence, chaos_policy)
-                if algo == "dpsgd":
-                    gate = deliver if alive is None else deliver & alive
-                elif alive is not None:
-                    gate = alive
-            if arena_bufs:
-                mixed = collectives.mix_flat_into_tree(
-                    params, arena_bufs, spec, topo, gate=gate
-                )
-            else:
-                mixed = params
-            updates, opt_state = tx.update(grads, state.opt_state, mixed)
-            params = optax.apply_updates(mixed, updates)
-        else:
-            # chaos edge gating of the mix: dpsgd drops leave this pass's
-            # average (no stale buffer exists); a frozen edge (silence >=
-            # freeze_after) leaves it for either algorithm. Weights
-            # renormalize to 1/(1 + n_live) — with every gate on,
-            # mix_weighted is bitwise mix (the drop-rate-0 guarantee).
-            gate = None
-            if deliver is not None and bufs:
-                alive = alive_mask(health.silence, chaos_policy)
-                if algo == "dpsgd":
-                    gate = deliver if alive is None else deliver & alive
-                elif alive is not None:
-                    gate = alive
-            if gate is not None:
-                mixed = collectives.mix_weighted(params, bufs, gate)
-            else:
-                mixed = collectives.mix(params, bufs, topo) if bufs else params
-            # optimizer applies gradients (computed at pre-mix params) to the
-            # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
-            updates, opt_state = tx.update(grads, state.opt_state, mixed)
-            params = optax.apply_updates(mixed, updates)
+                # chaos edge gating of the mix: dpsgd drops leave this pass's
+                # average (no stale buffer exists); a frozen edge (silence >=
+                # freeze_after) leaves it for either algorithm. Weights
+                # renormalize to 1/(1 + n_live) — with every gate on,
+                # mix_weighted is bitwise mix (the drop-rate-0 guarantee).
+                gate = None
+                if deliver is not None and bufs:
+                    alive = alive_mask(health.silence, chaos_policy)
+                    if algo == "dpsgd":
+                        gate = deliver if alive is None else deliver & alive
+                    elif alive is not None:
+                        gate = alive
+                if gate is not None:
+                    mixed = collectives.mix_weighted(params, bufs, gate)
+                else:
+                    mixed = collectives.mix(params, bufs, topo) if bufs else params
+                # optimizer applies gradients (computed at pre-mix params) to the
+                # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
+                updates, opt_state = tx.update(grads, state.opt_state, mixed)
+                params = optax.apply_updates(mixed, updates)
 
         quar_eff = None
         if integ_quar:

@@ -18,23 +18,23 @@ from typing import Any, Optional
 
 import jax
 
+from eventgrad_tpu.obs.devicespec import TPU_SPECS
+
 #: public peak dense-matmul throughput (bf16 FLOP/s) by device-kind
-#: substring, most-specific first.
-PEAK_FLOPS_BY_KIND = (
-    ("v5 lite", 197e12),  # v5e
-    ("v5litepod", 197e12),
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6 lite", 918e12),  # Trillium / v6e
-    ("v6e", 918e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
+#: substring, most-specific first — read from the one spec table
+#: (obs/devicespec.py) so MFU here and the roofline in obs.costmodel can
+#: never disagree about the peak.
+PEAK_FLOPS_BY_KIND = tuple(
+    (sub, spec.peak_flops) for sub, spec in TPU_SPECS
 )
 
 
 def chip_peak_flops(device: Optional[Any] = None) -> float:
-    """Peak bf16 FLOP/s of one chip; 0.0 when unknown (non-TPU backends)."""
+    """Peak bf16 FLOP/s of one chip; 0.0 when unknown (non-TPU backends).
+
+    Contract kept from before the devicespec table: non-TPU backends get
+    0.0 here (so `mfu()` stays None off-chip); callers that WANT the
+    nominal generic-cpu tracking spec use obs.devicespec.device_spec."""
     device = device or jax.devices()[0]
     if device.platform != "tpu":
         return 0.0
@@ -58,6 +58,26 @@ def compiled_flops(fn, *args, **kwargs) -> float:
         return 0.0
 
 
+def step_layout_kwargs(state) -> dict:
+    """make_train_step kwargs matching the LAYOUT of `state`'s event
+    buffers. train() may have auto-enabled the flat arena (bufs carried
+    as flat arrays) or the bucketed schedule (per-bucket tuples of
+    arrays); tracing a tree-layout step against such a state fails with
+    a pytree-structure error — which compiled_flops' guard used to
+    swallow into a silent 0.0 FLOPs / None MFU. One detector shared by
+    train_step_flops and obs.costmodel.analyze_step."""
+    ev = getattr(state, "event", None)
+    bufs = getattr(ev, "bufs", None) or ()
+    if not bufs:
+        return {}
+    first = bufs[0]
+    if isinstance(first, tuple):  # per-neighbor tuple of per-bucket bufs
+        return {"arena": True, "bucketed": len(first)}
+    if getattr(first, "ndim", None) is not None:  # flat [.., n] array
+        return {"arena": True}
+    return {}  # per-neighbor pytrees: the tree layout
+
+
 def train_step_flops(model, tx, topo, algo, event_cfg, x, y,
                      per_rank: int, state) -> float:
     """Analytic FLOPs of one full train step (all vmap-ranks) of the given
@@ -69,7 +89,10 @@ def train_step_flops(model, tx, topo, algo, event_cfg, x, y,
     from eventgrad_tpu.parallel.spmd import spmd
     from eventgrad_tpu.train.steps import make_train_step
 
-    step = make_train_step(model, tx, topo, algo, event_cfg=event_cfg)
+    step = make_train_step(
+        model, tx, topo, algo, event_cfg=event_cfg,
+        **step_layout_kwargs(state),
+    )
     xb = jnp.asarray(x[: topo.n_ranks * per_rank]).reshape(
         (topo.n_ranks, per_rank) + x.shape[1:]
     )

@@ -49,6 +49,11 @@ def test_repo_artifacts_all_valid():
     # <= 1.02 vs monolithic, bitwise state, jaxpr interleaving gate
     # (BUCKETED_ABLATION_SCHEMA)
     assert "bucketed_ablation_cpu.json" in names
+    # the perf ledger (ISSUE 11): all six BENCH rounds in one
+    # trajectory with MFU/roofline populated and every
+    # ratio-vs-previous-round regression gate passing
+    # (PERF_LEDGER_SCHEMA pins gates_all_ok)
+    assert "perf_ledger_cpu.json" in names
     assert out["errors"] == []
 
 

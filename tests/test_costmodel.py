@@ -1,0 +1,304 @@
+"""Analytic cost model (obs/costmodel.py + obs/devicespec.py): FLOP
+counts proven against closed-form oracles, phase attribution through the
+egphase named scopes, bitwise neutrality of the annotations, roofline
+arithmetic, and the state-layout detection that keeps the MFU numerator
+from silently zeroing on arena states.
+
+FLOP oracles
+  * MLP (dot_general only): EXACT.  For a stack of Dense layers traced
+    through jax.vjp(loss, params), layer 1 contributes 2 dots (forward,
+    weight-grad — the INPUT grad of the first layer is never built) and
+    every deeper layer 3 (forward, weight-grad, input-grad), each
+    2·B·in·out FLOPs.  The model's dot_flops must equal that closed form
+    to the FLOP.
+  * conv (CNN2): within the DOCUMENTED bound.  The backward pass adds a
+    data-grad and a filter-grad conv of roughly forward cost each, so
+    total conv+dot FLOPs sit in [2x, 4x] the closed-form forward count —
+    the bound docs/OBSERVABILITY.md states.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import CNN2, MLP
+from eventgrad_tpu.obs import costmodel
+from eventgrad_tpu.obs.devicespec import (
+    GENERIC_CPU, DeviceSpec, device_spec, spec_for_kind,
+)
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import spmd
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+from eventgrad_tpu.utils.flops import step_layout_kwargs, train_step_flops
+
+N_RANKS = 4
+PER_RANK = 4
+IN_SHAPE = (8, 8, 1)
+CFG = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2,
+                  max_silence=4)
+
+
+def _setup(model, algo, in_shape=IN_SHAPE, arena=False, n=64):
+    topo = Ring(N_RANKS)
+    tx = optax.sgd(0.05)
+    state = init_train_state(
+        model, in_shape, tx, topo, algo, CFG, seed=0, arena=arena
+    )
+    x, y = synthetic_dataset(n, in_shape, seed=0)
+    return topo, tx, state, x, y
+
+
+# --- FLOP oracles -----------------------------------------------------------
+
+
+def test_mlp_dot_flops_match_closed_form_exactly():
+    model = MLP(hidden=16)
+    topo, tx, state, x, y = _setup(model, "dpsgd")
+    cm = costmodel.analyze_step(
+        model, tx, topo, "dpsgd", CFG, x, y, PER_RANK, state
+    )
+    batch = N_RANKS * PER_RANK
+    n_in = math.prod(IN_SHAPE)
+    layers = [(n_in, 16), (16, 10)]
+    # layer 1: forward + weight-grad (2 dots); deeper layers add the
+    # input-grad dot (3) — each dot is 2*B*in*out FLOPs
+    expected = sum(
+        (2 if i == 0 else 3) * 2 * batch * fan_in * fan_out
+        for i, (fan_in, fan_out) in enumerate(layers)
+    )
+    assert cm["dot_flops"] == expected
+    assert cm["conv_flops"] == 0.0
+    assert cm["flops_total"] > cm["dot_flops"]  # eltwise/reductions ride
+
+
+def test_cnn_conv_flops_within_documented_bound():
+    model = CNN2()
+    in_shape = (28, 28, 1)
+    topo, tx, state, x, y = _setup(model, "dpsgd", in_shape=in_shape)
+    cm = costmodel.analyze_step(
+        model, tx, topo, "dpsgd", CFG, x, y, PER_RANK, state
+    )
+    batch = N_RANKS * PER_RANK
+    # CNN2 forward closed form (models/cnn.py): conv 3x3x1->10 VALID on
+    # 28x28 -> 26x26; pool -> 13x13; conv 3x3x10->20 -> 11x11; pool ->
+    # 5x5; dense 500->50->10
+    fwd = (
+        2 * batch * 26 * 26 * 10 * (3 * 3 * 1)
+        + 2 * batch * 11 * 11 * 20 * (3 * 3 * 10)
+        + 2 * batch * (500 * 50 + 50 * 10)
+    )
+    total = cm["conv_flops"] + cm["dot_flops"]
+    # the documented training-step bound: backward adds a data-grad and
+    # a filter-grad pass of ~forward cost each
+    assert 2.0 * fwd <= total <= 4.0 * fwd, (total, fwd, total / fwd)
+    assert cm["conv_flops"] > 0
+
+
+def test_scan_bodies_multiply_by_length():
+    def body(c, _):
+        return c @ c, None
+
+    def f(c):
+        out, _ = jax.lax.scan(body, c, None, length=5)
+        return out
+
+    one = costmodel.analyze_jaxpr(
+        jax.make_jaxpr(lambda c: c @ c)(jnp.ones((8, 8)))
+    )
+    scanned = costmodel.analyze_jaxpr(jax.make_jaxpr(f)(jnp.ones((8, 8))))
+    assert scanned["dot_flops"] == 5 * one["dot_flops"]
+
+
+# --- phase attribution ------------------------------------------------------
+
+
+def test_phases_attributed_across_step():
+    model = MLP(hidden=16)
+    topo, tx, state, x, y = _setup(model, "eventgrad", arena=True)
+    cm = costmodel.analyze_step(
+        model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, state
+    )
+    by = cm["by_phase"]
+    # the backward pass lands in grad (vjp transposition keeps the
+    # scope), and grad dominates the step
+    assert by["grad"]["flops"] > 0.5 * cm["flops_total"]
+    assert by["gate_pack"]["flops"] > 0  # trigger state machine
+    assert by["exchange"]["hbm_bytes"] > 0  # wire assembly moves bytes
+    assert by["commit_mix"]["flops"] > 0
+    # the aggregate view reproduces the totals exactly
+    assert sum(p["flops"] for p in by.values()) == cm["flops_total"]
+    assert sum(p["hbm_bytes"] for p in by.values()) == cm["hbm_bytes_total"]
+
+
+def test_bucketed_phases_carry_bucket_labels():
+    model = MLP(hidden=16)
+    topo, tx, state, x, y = _setup(model, "eventgrad", arena=True)
+    # state layout must match the bucketed step it traces
+    cm = costmodel.analyze_step(
+        model, tx, topo, "eventgrad", CFG, x, y, PER_RANK,
+        init_train_state(
+            MLP(hidden=16), IN_SHAPE, tx, topo, "eventgrad", CFG,
+            seed=0, arena=True, bucketed=2,
+        ),
+        arena=True, bucketed=2,
+    )
+    labels = set(cm["phases"])
+    assert {"exchange.b0", "exchange.b1"} <= labels, labels
+    assert any(l.startswith("commit_mix.b") for l in labels), labels
+    # bucket labels fold into their base phase in the aggregate view
+    ex = cm["by_phase"]["exchange"]
+    assert ex["hbm_bytes"] == sum(
+        cm["phases"][l]["hbm_bytes"]
+        for l in labels if l.startswith("exchange")
+    )
+
+
+def test_annotations_are_bitwise_neutral():
+    """obs='off'-style guarantee: the traced step with phase scopes
+    disabled (the pre-PR program) trains bitwise identically to the
+    annotated one."""
+    model = MLP(hidden=16)
+    topo, tx, state, x, y = _setup(model, "eventgrad", arena=True)
+    xb = jnp.asarray(x[: N_RANKS * PER_RANK]).reshape(
+        (N_RANKS, PER_RANK) + IN_SHAPE
+    )
+    yb = jnp.asarray(y[: N_RANKS * PER_RANK]).reshape((N_RANKS, PER_RANK))
+
+    def _run():
+        step = jax.jit(spmd(
+            make_train_step(
+                model, tx, topo, "eventgrad", event_cfg=CFG, arena=True
+            ),
+            topo,
+        ))
+        s, m = state, None
+        for _ in range(3):
+            s, m = step(s, (xb, yb))
+        return s, m
+
+    assert costmodel.annotations_enabled()
+    s_on, m_on = _run()
+    with costmodel.annotations_disabled():
+        assert not costmodel.annotations_enabled()
+        s_off, m_off = _run()
+    assert costmodel.annotations_enabled()
+    for a, b in zip(jax.tree.leaves(s_on), jax.tree.leaves(s_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m_on:
+        np.testing.assert_array_equal(
+            np.asarray(m_on[k]), np.asarray(m_off[k]), err_msg=k
+        )
+    # and with scopes off the program really carries no phase labels
+    with costmodel.annotations_disabled():
+        cm = costmodel.analyze_step(
+            model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, state
+        )
+    assert set(cm["phases"]) == {"other"}
+
+
+# --- state-layout detection (the silent-0.0-FLOPs regression) ---------------
+
+
+def test_step_layout_detection_and_nonzero_flops():
+    model = MLP(hidden=16)
+    topo = Ring(N_RANKS)
+    tx = optax.sgd(0.05)
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=0)
+    tree_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0
+    )
+    arena_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True
+    )
+    bucketed_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        bucketed=2,
+    )
+    assert step_layout_kwargs(tree_state) == {}
+    assert step_layout_kwargs(arena_state) == {"arena": True}
+    assert step_layout_kwargs(bucketed_state) == {
+        "arena": True, "bucketed": 2,
+    }
+    # the regression this fixes: train() auto-enables the arena, and the
+    # tree-step trace against that state used to be swallowed into a
+    # silent 0.0 FLOPs (None MFU on chip)
+    assert train_step_flops(
+        model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, arena_state
+    ) > 0
+
+
+# --- roofline / device specs ------------------------------------------------
+
+
+def test_roofline_verdicts_and_mfu():
+    spec = DeviceSpec("t", peak_flops=100.0, peak_hbm_bytes_per_s=10.0)
+    assert spec.ridge_intensity == 10.0
+    # intensity 20 FLOP/B > ridge -> compute-bound; 200 FLOP over 2 s on
+    # a 100 FLOP/s peak = MFU 1.0 at the ceiling
+    r = costmodel.roofline(200.0, 10.0, 2.0, spec)
+    assert r["roofline_bound"] == "compute"
+    assert r["mfu"] == pytest.approx(1.0)
+    assert r["roofline_frac"] == pytest.approx(1.0)
+    # intensity 0.5 < ridge -> memory-bound; ceiling is bw-limited
+    r = costmodel.roofline(5.0, 10.0, 1.0, spec)
+    assert r["roofline_bound"] == "memory"
+    assert r["achieved_bytes_per_s"] == pytest.approx(10.0)
+    assert r["roofline_frac"] == pytest.approx(1.0)  # at the bw roof
+    assert r["mfu"] == pytest.approx(0.05)
+    # degenerate inputs answer None, not a crash
+    r = costmodel.roofline(0.0, 0.0, 0.0, spec)
+    assert r["mfu"] is None and r["roofline_bound"] is None
+
+
+def test_device_specs():
+    assert spec_for_kind("tpu", "TPU v5 lite").name == "tpu-v5e"
+    assert spec_for_kind("tpu", "TPU v5 lite").peak_flops == 197e12
+    assert spec_for_kind("tpu", "TPU v4").peak_flops == 275e12
+    assert spec_for_kind("cpu", "cpu") is GENERIC_CPU
+    assert spec_for_kind("tpu", "TPU v99 hyperlite") is GENERIC_CPU
+    assert GENERIC_CPU.nominal
+    if jax.default_backend() != "tpu":
+        assert device_spec() is GENERIC_CPU
+    # the one spec table: utils.flops reads its TPU peaks from here
+    from eventgrad_tpu.utils.flops import PEAK_FLOPS_BY_KIND
+
+    assert dict(PEAK_FLOPS_BY_KIND)["v5 lite"] == 197e12
+
+
+# --- compiled-program facts -------------------------------------------------
+
+
+def test_compile_timed_records_stage_spans():
+    from eventgrad_tpu.obs import Registry
+
+    reg = Registry()
+
+    def f(a, b):
+        return a @ b + 1.0
+
+    args = (jnp.ones((8, 8)), jnp.ones((8, 8)))
+    compiled, spans, memory = costmodel.compile_timed(
+        f, *args, registry=reg, label="unit"
+    )
+    stages = (
+        "compile_trace", "compile_lower", "compile_compile",
+        "first_dispatch",
+    )
+    assert set(spans) == set(stages)
+    assert all(v >= 0 for v in spans.values())
+    names = [s.name for s in reg.spans]
+    for stage in stages:
+        assert stage in names
+    assert all(
+        s.cat == "compile" for s in reg.spans if s.name in stages
+    )
+    # memory analysis is backend-optional: None or a dict with the peak
+    if memory is not None:
+        assert "peak_bytes" in memory

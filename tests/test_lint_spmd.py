@@ -75,6 +75,14 @@ def test_no_host_sync_on_traced_paths():
     assert not offenders, _fmt(offenders)
 
 
+def test_wall_clock_confined():
+    """Wall-clock timing (`time.time`/`time.perf_counter`/
+    `time.monotonic`) is confined to obs/ — spans are the one timing
+    API; the pre-existing metric sites are exempt by name."""
+    offenders = _run_rule(lint.WallClockConfined())
+    assert not offenders, _fmt(offenders)
+
+
 def test_full_lint_run_clean():
     """The aggregate entry point tools/audit.py pins in the artifact."""
     violations = lint.run(root=REPO)
@@ -121,6 +129,45 @@ def test_rules_detect_seeded_violations():
         '"""exit 77 is the integrity abort."""\n# also 83 here\nX = 1\n',
     )
     assert not _run_rule(lint.ExitCodeLiterals(), [ok_comment])
+
+
+def test_wall_clock_rule_fires_on_seeded_violations():
+    """The timing-confinement rule detects a stray perf_counter on a
+    traced-adjacent path, a `from time import` alias, and a stale
+    exemption — and obs/ itself stays allowed."""
+    sep = os.sep
+    bad_call = _pkg_file(
+        f"eventgrad_tpu{sep}parallel{sep}bad6.py",
+        "import time\n\ndef f():\n    return time.perf_counter()\n",
+    )
+    bad_from = _pkg_file(
+        f"eventgrad_tpu{sep}chaos{sep}bad7.py",
+        "from time import monotonic\n",
+    )
+    bad_alias = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}bad7b.py",
+        "import time as clock\n\nT0 = clock.perf_counter()\n",
+    )
+    ok_obs = _pkg_file(
+        f"eventgrad_tpu{sep}obs{sep}ok8.py",
+        "import time\n\nT0 = time.perf_counter()\n",
+    )
+    assert _run_rule(lint.WallClockConfined(), [bad_call])
+    assert _run_rule(lint.WallClockConfined(), [bad_from])
+    assert _run_rule(lint.WallClockConfined(), [bad_alias])
+    assert not _run_rule(lint.WallClockConfined(), [ok_obs])
+    # comments/docstrings never false-positive (AST, not grep)
+    ok_prose = _pkg_file(
+        f"eventgrad_tpu{sep}ok9.py",
+        '"""never call time.perf_counter() here"""\nX = 1\n',
+    )
+    assert not _run_rule(lint.WallClockConfined(), [ok_prose])
+    # a stale exemption (file stopped reading the clock) fires too
+    rel = f"eventgrad_tpu{sep}supervise.py"
+    stale = _pkg_file(rel, "X = 1\n")
+    live = _pkg_file(rel, "import time\n\nNOW = time.time()\n")
+    assert _run_rule(lint.WallClockConfined(), [stale])
+    assert not _run_rule(lint.WallClockConfined(), [live])
 
 
 def test_exempt_file_exemption_stays_honest():

@@ -425,6 +425,71 @@ def test_report_from_jsonl_stream(tmp_path):
     assert report["capacity_utilization"] is None  # dense run
 
 
+def test_bubble_truncated_trace_degrades_gracefully(tmp_path):
+    """A trace missing span types — a run killed before the pipelined
+    block_ready readbacks landed, or with no train root at all — yields
+    a NAMED warning and a PARTIAL decomposition instead of a KeyError
+    (the report of a dead run is exactly when the tool is needed)."""
+    from eventgrad_tpu.obs import bubble
+    from eventgrad_tpu.obs.bubble import IncompleteTraceWarning
+
+    truncated = [
+        {"name": "train", "ph": "X", "ts": 0.0, "dur": 1e6, "args": {}},
+        {"name": "dispatch_block", "ph": "X", "ts": 100.0, "dur": 1000.0,
+         "args": {"block": 0, "pipelined": True}},
+        # block 1's block_ready made it; block 0's was lost to the kill
+        {"name": "dispatch_block", "ph": "X", "ts": 2e5, "dur": 1000.0,
+         "args": {"block": 1, "pipelined": True}},
+        {"name": "block_ready", "ph": "X", "ts": 3e5, "dur": 50.0,
+         "args": {"block": 1}},
+    ]
+    with pytest.warns(IncompleteTraceWarning, match="block_ready"):
+        d = bubble.decompose(truncated)
+    assert d["missing_spans"] == ["block_ready"]
+    assert d["n_blocks"] == 2 and d["pipelined"]
+    assert 0.0 <= d["host_bubble_frac"] <= 1.0
+    # rootless trace: envelope fallback, named as missing
+    with pytest.warns(IncompleteTraceWarning, match="train"):
+        d2 = bubble.decompose(truncated[1:])
+    assert "train" in d2["missing_spans"]
+    # a COMPLETE trace stays warning-free
+    complete = truncated[:1] + [
+        {"name": "dispatch_block", "ph": "X", "ts": 100.0, "dur": 1000.0,
+         "args": {"block": 0, "pipelined": False}},
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", IncompleteTraceWarning)
+        bubble.decompose(complete)
+    # render_text tolerates partial dicts (older-tool artifacts) and
+    # flags partial decompositions
+    assert "PARTIAL" in bubble.render_text(d)
+    assert bubble.render_text({"wall_s": 1.0})  # no KeyError
+    # the CLI path: a truncated/broken trace file degrades the same way
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_tool",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "obs_report.py",
+        ),
+    )
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    hist = tmp_path / "h.jsonl"
+    hist.write_text('{"epoch": 1, "loss": 1.0}\n')
+    broken = tmp_path / "broken.json"
+    broken.write_text('{"traceEvents": [')
+    with pytest.warns(IncompleteTraceWarning, match="unreadable"):
+        rc = tool.main([str(hist), "--trace", str(broken), "--quiet"])
+    assert rc == 0
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"otherData": {}}')
+    with pytest.warns(IncompleteTraceWarning, match="no traceEvents"):
+        rc = tool.main([str(hist), "--trace", str(empty), "--quiet"])
+    assert rc == 0
+
+
 def test_docs_cover_every_schema_field():
     """docs/OBSERVABILITY.md mirrors obs/schema.py field-for-field — the
     doc is the schema's human surface and must not drift."""

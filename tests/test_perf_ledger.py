@@ -1,0 +1,180 @@
+"""Perf ledger (tools/perf_ledger.py): backfill ingestion of the
+committed BENCH rounds, the regression gates (real trajectory passes, a
+seeded 2x step_ms regression fails), and the committed artifact's schema
+gate.  The ingestion tests run --no-costmodel style (no traces) so the
+suite stays fast; the committed artifact proves the backfill WITH the
+cost model ran.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+pl = _load("perf_ledger")
+va = _load("validate_artifacts")
+
+_LEDGER_PATH = os.path.join(_ROOT, "artifacts", "perf_ledger_cpu.json")
+
+
+def _committed_ledger():
+    with open(_LEDGER_PATH) as f:
+        return json.load(f)
+
+
+# --- ingestion --------------------------------------------------------------
+
+
+def test_ingests_every_bench_round():
+    ledger = pl.build_ledger(_ROOT, with_costmodel=False)
+    assert ledger["n_rounds"] >= 6
+    rounds = {e["round"]: e for e in ledger["rounds"]}
+    assert set(rounds) >= {1, 2, 3, 4, 5, 6}
+    # r01 stalled (rc=124, no metric line) — an explicit no-data entry,
+    # not a silently dropped round
+    assert rounds[1]["status"] == "no-data"
+    assert "no parseable metric line" in rounds[1]["note"]
+    # data rounds carry the trajectory fields + provenance + git round
+    for n in (2, 3, 4, 5, 6):
+        e = rounds[n]
+        assert e["status"] == "ok"
+        assert e["git_round"] == n
+        assert e["provenance"] == "synthetic-prototype"
+        assert e["step_ms"] and e["msgs_saved_pct"] is not None
+    # the chip rounds keep their record-carried (XLA-compiled) MFU even
+    # without the cost-model backfill
+    assert rounds[5]["mfu"] == 0.1669
+    assert rounds[5]["mfu_source"] == "record"
+    # multichip + ablation snapshots ride along
+    assert len(ledger["multichip"]) >= 5
+    assert "bucketed" in ledger["ablations"]
+    assert ledger["ablations"]["bucketed"]["value"] is not None
+
+
+def test_comparability_groups_separate_platforms_and_tiers():
+    ledger = pl.build_ledger(_ROOT, with_costmodel=False)
+    rounds = {e["round"]: e for e in ledger["rounds"]}
+    # the r06 tiny CPU smoke must never be gated against the r05 TPU
+    # flagship or the r03 reduced tier
+    assert pl.comparable_key(rounds[6]) != pl.comparable_key(rounds[5])
+    assert pl.comparable_key(rounds[6]) != pl.comparable_key(rounds[3])
+    assert pl.comparable_key(rounds[2]) == pl.comparable_key(rounds[3])
+    gated_pairs = {
+        (g["prev_round"], g["round"]) for g in ledger["gates"]
+    }
+    assert (2, 3) in gated_pairs
+    assert (4, 5) in gated_pairs
+    assert (3, 6) not in gated_pairs and (5, 6) not in gated_pairs
+
+
+# --- regression gates -------------------------------------------------------
+
+
+def test_real_trajectory_passes_gates():
+    ledger = pl.build_ledger(_ROOT, with_costmodel=False)
+    bad = [g for g in ledger["gates"] if not g["ok"]]
+    assert ledger["gates_all_ok"], bad
+
+
+def test_seeded_2x_step_ms_regression_fails_gate():
+    ledger = pl.build_ledger(_ROOT, with_costmodel=False)
+    entries = ledger["rounds"]
+    last = max(
+        (e for e in entries if e["status"] == "ok"
+         and pl.comparable_key(e) is not None),
+        key=lambda e: e["round"],
+    )
+    seeded = copy.deepcopy(last)
+    seeded["round"] = last["round"] + 1
+    seeded["source"] = "BENCH_seeded.json"
+    seeded["step_ms"] = 2.0 * float(last["step_ms"])
+    gates = pl.evaluate_gates(entries + [seeded])
+    failing = [g for g in gates if not g["ok"]]
+    assert failing, "2x step_ms regression was not caught"
+    assert any(
+        g["metric"] == "step_ms" and g["round"] == seeded["round"]
+        for g in failing
+    )
+    # and the un-seeded trajectory still passes the same evaluator
+    assert all(g["ok"] for g in pl.evaluate_gates(entries))
+
+
+def test_seeded_mfu_collapse_fails_gate():
+    ledger = pl.build_ledger(_ROOT, with_costmodel=False)
+    entries = [e for e in ledger["rounds"]]
+    base = next(e for e in entries if e["round"] == 5)
+    seeded = copy.deepcopy(base)
+    seeded["round"] = 7
+    seeded["mfu"] = 0.5 * float(base["mfu"]) - 1e-6
+    gates = pl.evaluate_gates(entries + [seeded])
+    assert any(
+        g["metric"] == "mfu" and not g["ok"] and g["round"] == 7
+        for g in gates
+    )
+
+
+# --- the committed artifact -------------------------------------------------
+
+
+def test_committed_ledger_covers_six_rounds_with_mfu_and_roofline():
+    led = _committed_ledger()
+    assert led["n_rounds"] >= 6
+    assert led["rounds_with_mfu"] >= 5
+    assert led["gates_all_ok"] is True
+    for e in led["rounds"]:
+        if e["status"] != "ok":
+            continue
+        # the acceptance instrument: every data round carries MFU and a
+        # roofline verdict (cost-model-backfilled on the CPU rounds,
+        # record-carried on chip), nominal-spec flagged honestly
+        assert e["mfu"] is not None, e["round"]
+        assert e["roofline_bound"] in ("compute", "memory"), e["round"]
+        assert e["mfu_source"] in ("record", "costmodel")
+        if e["platform"] == "cpu":
+            assert e["nominal_spec"] is True
+            assert e["device_spec"] == "generic-cpu"
+        else:
+            assert e["device_spec"].startswith("tpu-")
+
+
+def test_committed_ledger_schema_gated():
+    errs = va.validate_json_file(_LEDGER_PATH, va.PERF_LEDGER_SCHEMA)
+    assert errs == []
+    # the schema actually bites: a failing gate or a thin trajectory is
+    # a schema violation, so neither can be committed silently
+    led = _committed_ledger()
+    broken = dict(led, gates_all_ok=False)
+    assert va.validate(broken, va.PERF_LEDGER_SCHEMA)
+    thin = dict(led, rounds_with_mfu=2)
+    assert va.validate(thin, va.PERF_LEDGER_SCHEMA)
+
+
+# --- bench's trajectory-delta helpers ---------------------------------------
+
+
+def test_last_comparable_and_format_delta():
+    led = _committed_ledger()
+    cur = {
+        "platform": "cpu", "model": "LeNetCifar", "config": "reduced",
+        "step_ms": 100.0, "mfu": 0.05, "round": 99, "source": "(run)",
+        "status": "ok",
+    }
+    prev = pl.last_comparable(led, cur)
+    assert prev is not None and prev["round"] == 3
+    line = pl.format_delta(prev, cur)
+    assert "step_ms" in line and "->" in line and "mfu" in line
+    # no comparable group -> None, caller prints the no-prior line
+    assert pl.last_comparable(led, {
+        "platform": "cpu", "model": "ViT", "config": "reduced",
+    }) is None

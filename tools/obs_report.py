@@ -26,9 +26,17 @@ With --trace TRACE.json (a Chrome-trace span export — cli.py
 renders the HOST-BUBBLE decomposition (obs.bubble): wall = steps +
 flush + eval + checkpoint + data + other, the dispatch-pipeline metric
 of docs/ARCHITECTURE.md "The dispatch pipeline" — one `bubble` section
-per train() window in the trace.
+per train() window in the trace. A truncated or partial trace (a run
+killed mid-flight, a raw event list, missing span types) degrades to a
+NAMED warning and a partial decomposition instead of a crash — the
+report of a dead run is exactly when you need this tool.
+
+With --ledger LEDGER.json (a tools/perf_ledger.py artifact), the
+cross-round perf trajectory (step_ms / MFU / roofline per round, with
+the regression-gate verdicts) renders after the run report.
 
 Usage: python tools/obs_report.py HISTORY.jsonl [--trace TRACE.json]
+                                  [--ledger LEDGER.json]
                                   [--out PATH] [--quiet]
 """
 
@@ -53,6 +61,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default=None, metavar="TRACE.json",
                     help="span-trace export (Chrome-trace JSON): adds "
                          "the host-bubble decomposition (obs.bubble)")
+    ap.add_argument("--ledger", default=None, metavar="LEDGER.json",
+                    help="perf-ledger artifact (tools/perf_ledger.py): "
+                         "renders the cross-round trajectory + gates")
     ap.add_argument("--out", default=None, help="report JSON path")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress the text summary on stdout")
@@ -65,14 +76,47 @@ def main(argv=None) -> int:
     report = build_report(history)
     bubbles = []
     if args.trace:
-        from eventgrad_tpu.obs import bubble as obs_bubble
+        import warnings
 
-        with open(args.trace) as f:
-            events = json.load(f).get("traceEvents", [])
-        windows = obs_bubble.train_windows(events) or [events]
-        bubbles = [obs_bubble.decompose(w) for w in windows]
-        report["bubble"] = bubbles
-        report["bubble_source"] = os.path.basename(args.trace)
+        from eventgrad_tpu.obs import bubble as obs_bubble
+        from eventgrad_tpu.obs.bubble import IncompleteTraceWarning
+
+        events = None
+        try:
+            with open(args.trace) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            warnings.warn(
+                f"trace {args.trace} unreadable ({e}); skipping the "
+                "bubble decomposition",
+                IncompleteTraceWarning, stacklevel=1,
+            )
+            data = None
+        if isinstance(data, dict):
+            events = data.get("traceEvents")
+        elif isinstance(data, list):  # a raw event list still decomposes
+            events = data
+        if not isinstance(events, list) or not events:
+            if data is not None:
+                warnings.warn(
+                    f"trace {args.trace} carries no traceEvents; "
+                    "skipping the bubble decomposition",
+                    IncompleteTraceWarning, stacklevel=1,
+                )
+        else:
+            windows = obs_bubble.train_windows(events) or [events]
+            bubbles = [obs_bubble.decompose(w) for w in windows]
+            report["bubble"] = bubbles
+            report["bubble_source"] = os.path.basename(args.trace)
+    ledger = None
+    if args.ledger:
+        with open(args.ledger) as f:
+            ledger = json.load(f)
+        report["perf_ledger"] = {
+            "source": os.path.basename(args.ledger),
+            "n_rounds": ledger.get("n_rounds"),
+            "gates_all_ok": ledger.get("gates_all_ok"),
+        }
     report["source"] = os.path.basename(args.history)
     report["generated_at"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -88,6 +132,10 @@ def main(argv=None) -> int:
 
             for i, d in enumerate(bubbles):
                 print(obs_bubble.render_text(d, label=f"train window {i}"))
+        if ledger is not None:
+            from tools import perf_ledger as perf_ledger_mod
+
+            print(perf_ledger_mod.render_text(ledger))
     return 0
 
 

@@ -1,0 +1,468 @@
+"""Performance ledger: one schema-gated trajectory across every round.
+
+Six BENCH_r*.json rounds existed with no perf trajectory between them —
+nothing detected a step_ms or msgs-saved regression from one PR to the
+next, and the repo's only hardware-efficiency number was a hand-derived
+MFU in a ROADMAP aside. This tool ingests every committed driver record
+(BENCH_r*.json, MULTICHIP_r*.json) plus the perf-ablation artifacts into
+ONE ledger (`artifacts/perf_ledger_<backend>.json`, PERF_LEDGER_SCHEMA
+in tools/validate_artifacts.py):
+
+  * per-round trajectory — step_ms, MFU, msgs-saved-%, acc-gap,
+    sent_bytes_wire_real, host-bubble-frac, with data provenance
+    (`synthetic-prototype` vs real) and the producing git round;
+  * MFU/roofline backfill — rounds whose records predate the cost model
+    get analytic FLOPs/bytes from `obs.costmodel.analyze_step` at the
+    round's recorded op-point and peaks from `obs.devicespec` (CPU
+    rounds use the NOMINAL generic-cpu spec: a cross-round tracking
+    number, never a hardware claim — `nominal_spec` marks it);
+  * regression gates — explicit ratio-vs-previous-round thresholds,
+    evaluated within comparability groups (same platform+model+config;
+    a TPU flagship round is never compared against a CPU tiny smoke).
+    A failed gate fails `--check` (exit 1) AND the committed artifact
+    (the schema pins `gates_all_ok: true`), so a regression cannot be
+    committed silently.
+
+bench.py prints a one-line trajectory delta against this ledger at the
+end of every run (`format_delta`); `tools/obs_report.py --ledger`
+renders the trajectory. The acceptance instrument for ROADMAP open
+item 1: the shard_map lift must MOVE the MFU/roofline trajectory, not
+just pass parity.
+
+Usage: python tools/perf_ledger.py [--root PATH] [--out PATH]
+                                   [--no-costmodel] [--check] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LEDGER_SCHEMA_VERSION = 1
+
+#: regression gates: (metric, kind, threshold). Ratios are cur/prev over
+#: consecutive rounds of one comparability group; both values must be
+#: present (a round that lacks a metric is not a regression — the gate
+#: for a VANISHED metric is the schema's required-fields list).
+#:   max-ratio:    cur/prev <= t   (step time, wire bytes may not blow up)
+#:   min-ratio:    cur/prev >= t   (MFU, msgs-saved may not collapse)
+#:   max-abs-rise: |cur| <= |prev| + t  (accuracy gap, bubble fraction)
+GATES: Tuple[Tuple[str, str, float], ...] = (
+    ("step_ms", "max-ratio", 1.5),
+    ("mfu", "min-ratio", 0.6),
+    ("msgs_saved_pct", "min-ratio", 0.75),
+    ("sent_bytes_wire_real", "max-ratio", 1.5),
+    ("acc_gap_vs_dpsgd", "max-abs-rise", 1.0),
+    ("host_bubble_frac", "max-abs-rise", 0.05),
+)
+
+#: per-rank batch by bench tier (bench.py op-points: global 256 on the
+#: full tier, 64 on the CPU tiers, 8 ranks) — the records don't carry
+#: the batch size, the tier pins it
+_PER_RANK_BY_CONFIG = {
+    "full": 32, "full-rehearsal": 8, "reduced": 8, "tiny": 8,
+}
+
+
+def comparable_key(rec: Dict[str, Any]) -> Optional[Tuple[str, str, str]]:
+    """Comparability group of a bench record/ledger entry: rounds are
+    gated against each other ONLY within (platform, model, config)."""
+    plat, model, cfg = (
+        rec.get("platform"), rec.get("model"), rec.get("config"),
+    )
+    if not (plat and model and cfg):
+        return None
+    return (str(plat), str(model), str(cfg))
+
+
+# --- ingestion -------------------------------------------------------------
+
+
+def _round_of(name: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", name)
+    return int(m.group(1)) if m else 0
+
+
+def _bench_entry(path: str) -> Dict[str, Any]:
+    name = os.path.basename(path)
+    with open(path) as f:
+        raw = json.load(f)
+    n = int(raw.get("n") or _round_of(name))
+    rec = raw.get("parsed")
+    if not isinstance(rec, dict) or "metric" not in rec:
+        return {
+            "round": n, "source": name, "status": "no-data",
+            "git_round": n, "provenance": None,
+            "note": f"rc={raw.get('rc')}; no parseable metric line "
+                    "(device stalled / bench failed)",
+        }
+    return {
+        "round": n, "source": name, "status": "ok", "git_round": n,
+        # bench data has always been the synthetic class-prototype set;
+        # records before the `data` field default to that, the real-data
+        # flagship (ROADMAP open item 2) will stamp "real"
+        "provenance": rec.get("data", "synthetic-prototype"),
+        "platform": rec.get("platform"),
+        "device_kind": rec.get("device_kind"),
+        "config": rec.get("config"),
+        "model": rec.get("model"),
+        "passes": rec.get("passes"),
+        "collapsed": rec.get("collapsed", False),
+        "step_ms": rec.get("step_ms"),
+        "step_ms_dpsgd": rec.get("step_ms_dpsgd"),
+        "step_overhead_ratio": rec.get("step_overhead_ratio"),
+        "msgs_saved_pct": rec.get("value"),
+        "mnist_msgs_saved": rec.get("mnist_msgs_saved"),
+        "acc_gap_vs_dpsgd": rec.get("acc_gap_vs_dpsgd"),
+        "sent_bytes_wire_real": rec.get("sent_bytes_wire_real"),
+        "host_bubble_frac": rec.get("host_bubble_frac"),
+        "buckets": rec.get("buckets"),
+        "horizon": rec.get("horizon"),
+        "max_silence": rec.get("max_silence"),
+        "warmup_passes": rec.get("warmup_passes"),
+        "flops_per_step": rec.get("flops_per_step"),
+        "mfu": rec.get("mfu"),
+        "mfu_source": "record" if rec.get("mfu") is not None else None,
+    }
+
+
+def _multichip_entry(path: str) -> Dict[str, Any]:
+    name = os.path.basename(path)
+    with open(path) as f:
+        raw = json.load(f)
+    return {
+        "round": _round_of(name), "source": name,
+        "n_devices": raw.get("n_devices"), "ok": raw.get("ok"),
+        "skipped": raw.get("skipped"),
+    }
+
+
+#: perf-ablation artifacts folded in as trajectory snapshots: each is
+#: already schema-gated on its own acceptance bound; the ledger records
+#: the headline number so one file answers "where does the perf stand"
+_ABLATIONS = (
+    ("arena", "arena_ablation_cpu.json", "overhead_ratio_after"),
+    ("bucketed", "bucketed_ablation_cpu.json", "overhead_ratio"),
+    ("pipeline_bubble", "pipeline_bubble_cpu.json", "bubble_ratio"),
+    ("obs_overhead", "obs_overhead_cpu.json", "overhead_pct_p50"),
+)
+
+
+def _ablation_snapshot(root: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, name, field in _ABLATIONS:
+        path = os.path.join(root, "artifacts", name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out[key] = {
+            "metric": field, "value": rec.get(field),
+            "artifact": f"artifacts/{name}",
+        }
+    ipath = os.path.join(root, "artifacts", "integrity_cpu.json")
+    try:
+        with open(ipath) as f:
+            irec = json.load(f)
+        out["integrity"] = {
+            "metric": "overhead_ratio_p50",
+            "value": irec.get("overhead", {}).get("overhead_ratio_p50"),
+            "artifact": "artifacts/integrity_cpu.json",
+        }
+    except (OSError, json.JSONDecodeError):
+        pass
+    return out
+
+
+# --- cost-model backfill ---------------------------------------------------
+
+
+def _costmodel_fill(entries: List[Dict[str, Any]], quiet: bool) -> None:
+    """Populate flops/hbm/MFU/roofline for every ok entry from the
+    analytic cost model at the entry's recorded op-point. One trace per
+    distinct (model, per_rank) — results are cached. Entries whose
+    record already carries an XLA-compiled MFU keep it (mfu_source
+    "record"); the analytic roofline fields ride next to it either way."""
+    from eventgrad_tpu.obs import costmodel
+    from eventgrad_tpu.obs.devicespec import spec_for_kind
+
+    cache: Dict[Tuple[str, int], Dict[str, Any]] = {}
+
+    def _analyze(model_name: str, per_rank: int) -> Optional[Dict[str, Any]]:
+        key = (model_name, per_rank)
+        if key in cache:
+            return cache[key]
+        import jax.numpy as jnp
+        import optax
+
+        from eventgrad_tpu.data.datasets import load_or_synthesize
+        from eventgrad_tpu.parallel.events import EventConfig
+        from eventgrad_tpu.parallel.topology import Ring
+        from eventgrad_tpu.train.state import init_train_state
+
+        if model_name in ("ResNet", "ResNet18"):
+            from eventgrad_tpu.models import ResNet18
+
+            model = ResNet18(dtype=jnp.bfloat16)
+        elif model_name == "LeNetCifar":
+            from eventgrad_tpu.models import LeNetCifar
+
+            model = LeNetCifar()
+        else:
+            cache[key] = None
+            return None
+        topo = Ring(8)
+        tx = optax.sgd(1e-2, momentum=0.9)
+        cfg = EventConfig(
+            adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50,
+        )
+        n = topo.n_ranks * per_rank
+        x, y = load_or_synthesize("cifar10", None, "train", n_synth=n)
+        state = init_train_state(
+            model, x.shape[1:], tx, topo, "eventgrad", cfg, seed=0
+        )
+        if not quiet:
+            print(
+                f"costmodel: tracing {model_name} @ {per_rank}/rank ...",
+                file=sys.stderr,
+            )
+        cm = costmodel.analyze_step(
+            model, tx, topo, "eventgrad", cfg, x, y, per_rank, state
+        )
+        cache[key] = cm
+        return cm
+
+    for e in entries:
+        if e.get("status") != "ok" or not e.get("step_ms"):
+            continue
+        per_rank = _PER_RANK_BY_CONFIG.get(e.get("config") or "", None)
+        cm = _analyze(e.get("model") or "", per_rank) if per_rank else None
+        if cm is None:
+            continue
+        spec = spec_for_kind(e.get("platform"), e.get("device_kind"))
+        step_s = float(e["step_ms"]) / 1000.0
+        rl = costmodel.roofline(
+            cm["flops_total"], cm["hbm_bytes_total"], step_s, spec
+        )
+        e["hbm_bytes_per_step"] = cm["hbm_bytes_total"]
+        e["arithmetic_intensity"] = rl["arithmetic_intensity"]
+        e["ridge_intensity"] = rl["ridge_intensity"]
+        e["roofline_bound"] = rl["roofline_bound"]
+        e["roofline_frac"] = rl["roofline_frac"]
+        e["achieved_bytes_per_s"] = rl["achieved_bytes_per_s"]
+        e["device_spec"] = rl["device_spec"]
+        e["nominal_spec"] = rl["nominal_spec"]
+        if e.get("mfu") is None:
+            # the backfilled MFU is the ANALYTIC meter end to end
+            # (rl["mfu"] = cm flops / step_s / spec peak) and the
+            # entry's flops_per_step is set to the same analytic count,
+            # so a backfilled entry's mfu is always derivable from its
+            # own fields; record-carried (XLA-compiled) MFUs are kept
+            # as-is and never mixed with the analytic numerator
+            e["flops_per_step"] = cm["flops_total"]
+            e["mfu"] = round(rl["mfu"], 6)
+            e["mfu_source"] = "costmodel"
+
+
+# --- regression gates ------------------------------------------------------
+
+
+def evaluate_gates(entries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Pairwise ratio gates over consecutive ok entries of each
+    comparability group. Pure on the entry dicts — the seeded-regression
+    test drives this directly."""
+    results: List[Dict[str, Any]] = []
+    by_group: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
+    for e in sorted(
+        (e for e in entries if e.get("status") == "ok"),
+        key=lambda e: e["round"],
+    ):
+        key = comparable_key(e)
+        if key is not None:
+            by_group.setdefault(key, []).append(e)
+    for key, group in sorted(by_group.items()):
+        for prev, cur in zip(group, group[1:]):
+            for metric, kind, thr in GATES:
+                a, b = prev.get(metric), cur.get(metric)
+                if a is None or b is None:
+                    continue
+                a, b = float(a), float(b)
+                if kind == "max-ratio":
+                    if a <= 0:
+                        continue
+                    ratio = b / a
+                    ok = ratio <= thr
+                elif kind == "min-ratio":
+                    if a <= 0:
+                        continue
+                    ratio = b / a
+                    ok = ratio >= thr
+                else:  # max-abs-rise
+                    ratio = abs(b) - abs(a)
+                    ok = ratio <= thr
+                results.append({
+                    "metric": metric, "kind": kind, "threshold": thr,
+                    "group": list(key), "prev_round": prev["round"],
+                    "round": cur["round"], "prev": a, "cur": b,
+                    "ratio": round(ratio, 4), "ok": bool(ok),
+                })
+    return results
+
+
+# --- assembly --------------------------------------------------------------
+
+
+def build_ledger(root: str, with_costmodel: bool = True,
+                 quiet: bool = False) -> Dict[str, Any]:
+    entries = [
+        _bench_entry(p)
+        for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    ]
+    entries.sort(key=lambda e: e["round"])
+    if with_costmodel:
+        _costmodel_fill(entries, quiet)
+    gates = evaluate_gates(entries)
+    return {
+        "bench": "perf_ledger",
+        "schema_version": LEDGER_SCHEMA_VERSION,
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "n_rounds": len(entries),
+        "rounds_with_mfu": sum(
+            1 for e in entries if e.get("mfu") is not None
+        ),
+        "rounds": entries,
+        "multichip": [
+            _multichip_entry(p)
+            for p in sorted(
+                glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
+            )
+        ],
+        "ablations": _ablation_snapshot(root),
+        "gates": gates,
+        "gates_all_ok": all(g["ok"] for g in gates),
+    }
+
+
+def format_delta(prev: Dict[str, Any], cur: Dict[str, Any]) -> str:
+    """One-line step_ms/MFU trajectory delta (bench.py prints this to
+    stderr at the end of every run)."""
+    bits = [f"perf trajectory vs round {prev['round']} ({prev['source']}):"]
+    for name, key in (("step_ms", "step_ms"), ("mfu", "mfu")):
+        a, b = prev.get(key), cur.get(key)
+        if a and b:
+            bits.append(f"{name} {a:g} -> {b:g} ({float(b) / float(a):.2f}x)")
+        elif b is not None:
+            bits.append(f"{name} {b:g} (no prior)")
+    return " ".join(bits)
+
+
+def last_comparable(ledger: Dict[str, Any],
+                    rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Most recent ledger entry in `rec`'s comparability group."""
+    key = comparable_key(rec)
+    if key is None:
+        return None
+    matches = [
+        e for e in ledger.get("rounds", ())
+        if e.get("status") == "ok" and comparable_key(e) == key
+    ]
+    return matches[-1] if matches else None
+
+
+def render_text(ledger: Dict[str, Any]) -> str:
+    lines = [
+        f"perf ledger — {ledger['n_rounds']} rounds "
+        f"({ledger['rounds_with_mfu']} with MFU), gates "
+        + ("ALL OK" if ledger["gates_all_ok"] else "FAILING"),
+        f"{'rnd':>3} {'cfg':<14} {'model':<10} {'plat':<4} "
+        f"{'step_ms':>8} {'mfu':>8} {'saved%':>7} {'gap':>6} "
+        f"{'bound':>7} prov",
+    ]
+    for e in ledger["rounds"]:
+        if e.get("status") != "ok":
+            lines.append(
+                f"{e['round']:>3} -- no data ({e.get('note', '')})"
+            )
+            continue
+
+        def _f(v, fmt):
+            return format(v, fmt) if v is not None else "-"
+
+        lines.append(
+            f"{e['round']:>3} {e.get('config') or '-':<14} "
+            f"{e.get('model') or '-':<10} {e.get('platform') or '-':<4} "
+            f"{_f(e.get('step_ms'), '8.2f'):>8} "
+            f"{_f(e.get('mfu'), '8.4f'):>8} "
+            f"{_f(e.get('msgs_saved_pct'), '7.2f'):>7} "
+            f"{_f(e.get('acc_gap_vs_dpsgd'), '6.2f'):>6} "
+            f"{e.get('roofline_bound') or '-':>7} "
+            f"{e.get('provenance') or '-'}"
+        )
+    bad = [g for g in ledger["gates"] if not g["ok"]]
+    lines.append(
+        f"gates: {len(ledger['gates'])} evaluated, {len(bad)} failing"
+    )
+    for g in bad:
+        lines.append(
+            f"  FAIL {g['metric']} r{g['prev_round']}->r{g['round']} "
+            f"{g['prev']:g} -> {g['cur']:g} ({g['kind']} {g['ratio']} "
+            f"vs {g['threshold']}) group={g['group']}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    ap.add_argument("--out", default=None,
+                    help="ledger path (default artifacts/"
+                         "perf_ledger_<backend>.json)")
+    ap.add_argument("--no-costmodel", action="store_true",
+                    help="skip the analytic backfill traces (MFU only "
+                         "where records carry it)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any regression gate fails")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if not args.no_costmodel:
+        from eventgrad_tpu.utils import compile_cache
+
+        compile_cache.honor_cpu_pin()
+    ledger = build_ledger(
+        args.root, with_costmodel=not args.no_costmodel, quiet=args.quiet
+    )
+    out = args.out
+    if out is None:
+        import jax
+
+        out = os.path.join(
+            args.root, "artifacts",
+            f"perf_ledger_{jax.default_backend()}.json",
+        )
+    with open(out, "w") as f:
+        json.dump(ledger, f, indent=1)
+        f.write("\n")
+    if not args.quiet:
+        print(render_text(ledger))
+    print(f"wrote {out}", file=sys.stderr)
+    if args.check and not ledger["gates_all_ok"]:
+        print("perf ledger: regression gates FAILING", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,27 @@ def main() -> None:
     out["chip_peak_flops"] = peak or None
     got = mfu(flops, step_s) if flops else None
     out["mfu_eventgrad"] = round(got, 4) if got else None
+
+    # analytic cost model + roofline (obs/costmodel.py, one definition
+    # with bench.py's `costmodel` block — obs/schema.py PERF_FIELDS):
+    # phase-split FLOPs/bytes of the same step, against the
+    # obs/devicespec.py peaks; trace-only, so it costs seconds, and a
+    # failure here must never lose the already-measured leg
+    try:
+        from eventgrad_tpu.obs import costmodel as _costmodel
+        from eventgrad_tpu.obs.devicespec import device_spec
+
+        tx_cm = optax.sgd(1e-2, momentum=0.9)
+        cm = _costmodel.analyze_step(
+            model, tx_cm, topo, "eventgrad", cfg, x, y, per_rank, state
+        )
+        rl = _costmodel.roofline(
+            cm["flops_total"], cm["hbm_bytes_total"], step_s,
+            device_spec(),
+        )
+        out["costmodel"] = _costmodel.record_block(cm, rl)
+    except Exception as e:
+        print(f"costmodel block skipped: {e!r}", file=sys.stderr)
     publish()
 
     t0 = time.perf_counter()

@@ -550,6 +550,61 @@ AUDIT_SCHEMA = {
     },
 }
 
+_LEDGER_ROUND = {
+    "type": "object",
+    "required": ["round", "source", "status", "provenance"],
+    "properties": {
+        "round": {"type": "integer", "minimum": 1},
+        "source": {"type": "string"},
+        "status": {"enum": ["ok", "no-data"]},
+        "provenance": {"type": ["string", "null"]},
+        "step_ms": {"type": ["number", "null"], "minimum": 0},
+        "mfu": {"type": ["number", "null"], "minimum": 0},
+        "roofline_bound": {"enum": ["compute", "memory", None]},
+        "mfu_source": {"enum": ["record", "costmodel", None]},
+    },
+}
+
+PERF_LEDGER_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "schema_version", "n_rounds", "rounds_with_mfu",
+        "rounds", "multichip", "ablations", "gates", "gates_all_ok",
+    ],
+    "properties": {
+        "bench": {"enum": ["perf_ledger"]},
+        "schema_version": {"type": "integer", "minimum": 1},
+        # the perf-ledger acceptance gates (ISSUE 11): every existing
+        # BENCH round is in the trajectory (r01's stalled round rides
+        # as an explicit no-data entry), at least the five data rounds
+        # carry a populated MFU (record-carried on chip rounds,
+        # cost-model-backfilled on CPU rounds), and EVERY
+        # ratio-vs-previous-round regression gate passes — a committed
+        # ledger with a failing gate is a schema violation, so a perf
+        # regression cannot land silently
+        "n_rounds": {"type": "integer", "minimum": 6},
+        "rounds_with_mfu": {"type": "integer", "minimum": 5},
+        "rounds": {"type": "array", "minItems": 6, "items": _LEDGER_ROUND},
+        "gates": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": [
+                    "metric", "kind", "threshold", "prev_round", "round",
+                    "ratio", "ok",
+                ],
+                "properties": {
+                    "ok": {"type": "boolean"},
+                    "ratio": {"type": "number"},
+                },
+            },
+        },
+        "gates_all_ok": {"enum": [True]},
+        "multichip": {"type": "array"},
+        "ablations": {"type": "object"},
+    },
+}
+
 #: artifacts/ families with real schemas (filename prefix match); every
 #: other artifacts/*.json only needs to parse into an object/array
 _ARTIFACT_FAMILIES = (
@@ -563,6 +618,7 @@ _ARTIFACT_FAMILIES = (
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
+    ("perf_ledger", PERF_LEDGER_SCHEMA),
     ("soak_", SOAK_SCHEMA),
     ("tpu_flagship", FLAGSHIP_SCHEMA),
 )
