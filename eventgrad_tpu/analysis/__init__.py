@@ -1,23 +1,30 @@
 """Static analysis over the traced train step + the project lint rules.
 
-Three layers, each importable on its own:
+Four layers, each importable on its own:
 
   * `walker` — structure-blind traversal of a jaxpr through every nested
-    sub-jaxpr (pjit / scan / cond / while / custom_jvp / remat), plus the
-    op-accounting primitives the regression gates are built from
-    (`count_primitives`, `count_full_ravels`).
+    sub-jaxpr (pjit / scan / cond / while / custom_jvp / remat /
+    pallas_call kernel bodies), plus the op-accounting primitives the
+    regression gates are built from (`count_primitives`,
+    `count_full_ravels`).
   * `rankflow` — a dataflow analysis over the vmap-lifted step proving
     RANK ISOLATION: every intermediate is tracked for which array axis
-    (if any) carries the rank coordinate, and the only equations allowed
+    (if any) carries the rank coordinate — pure or BLOCKED (the conv
+    batching rules' rank-major merges) — and the only equations allowed
     to move information ACROSS that axis are the declared neighbor
     exchanges (the constant-permutation gathers `lax.ppermute` lowers to
     under vmap) — anything else is a violation.
-  * `audit` — the per-configuration auditor: rank isolation, wire-byte
-    truth (bytes derived from the exchange lanes' shapes/dtypes ==
-    the independent formula == the step's `sent_bytes_wire_real`
-    metric), and step hygiene (no host callbacks, full-model ravel
-    budget, wire dtype fidelity, donation aliasing) — with seeded
-    ORACLE violations proving each check can actually fire.
+  * `kernels` — the declared-kernel registry: rank-dim signatures for
+    opaque `pallas_call` boundaries (the flash family, the arena/event
+    engines); unregistered kernels stay rankflow violations.
+  * `audit` — the per-configuration auditor ON the production
+    geometries (LeNetCifar / ResNet18 / transformer full+flash / MLP
+    base): rank isolation, wire-byte truth (bytes derived from the
+    exchange lanes' shapes/dtypes == the independent formula == the
+    step's `sent_bytes_wire_real` metric), and step hygiene (no host
+    callbacks, full-model ravel budget, wire dtype fidelity, donation
+    aliasing) — with seeded ORACLE violations proving each check can
+    actually fire.
 
 `lint` is the AST-based source lint framework (exit-code literals,
 `os._exit` confinement, host syncs in traced paths, the shard_map

@@ -1,8 +1,11 @@
 """The trace auditor: machine-checked invariants of the fused train step.
 
-For each configuration of the step (algo x wire x gossip wire x arena x
-obs x chaos x integrity x staleness) the auditor traces the vmap-lifted
-step to a closed jaxpr and proves:
+For each configuration of the step (model geometry x algo x wire x
+gossip wire x arena x obs x chaos x integrity x staleness x bucketed)
+the auditor traces the vmap-lifted step to a closed jaxpr and proves,
+ON THE MODELS THE HEADLINE NUMBERS SHIP — LeNetCifar, ResNet18, and a
+small TransformerLM (full + flash attention) alongside the cheap MLP
+regression base:
 
   1. RANK ISOLATION (analysis/rankflow.py): the only cross-rank
      information flow is the declared neighbor exchange — constant-
@@ -27,7 +30,9 @@ step to a closed jaxpr and proves:
 Every check has a seeded ORACLE violation (`run_oracles`) proving it
 can fire: an undeclared ppermute offset, a cross-rank roll, a wire
 dtype upcast, an extra full-tree ravel, a broken byte formula, a host
-callback.  `tools/audit.py` runs the matrix + oracles and commits the
+callback, a conv whose rank-merged features contract across ranks, an
+unregistered pallas kernel, a data-dependent cross-rank attention
+gather.  `tools/audit.py` runs the matrix + oracles and commits the
 schema-gated artifacts/audit_cpu.json.  See docs/ANALYSIS.md.
 """
 
@@ -50,7 +55,11 @@ from eventgrad_tpu.chaos.integrity import IntegrityConfig
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.models import MLP
+from eventgrad_tpu.models.cnn import LeNetCifar
+from eventgrad_tpu.models.resnet import ResNet18
+from eventgrad_tpu.models.transformer import TransformerLM
 from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
@@ -60,14 +69,40 @@ from eventgrad_tpu.train.state import init_train_state
 from eventgrad_tpu.train.steps import make_train_step
 from eventgrad_tpu.utils import trees
 
-#: the audit geometry: the MLP's 4-leaf tree (a dominant kernel plus
-#: ragged tails) on a Ring(4) — the step's exchange structure is
-#: model-independent, and the MLP avoids the conv batching rule's
-#: rank-axis merge that rankflow cannot track (docs/ANALYSIS.md)
+#: the audit geometries: the MLP's 4-leaf tree (a dominant kernel plus
+#: ragged tails) remains the cheap regression base for the algo/obs/
+#: chaos/integrity dimensions, and the PRODUCTION models join the
+#: matrix at real geometry (ISSUE 12) — LeNetCifar and ResNet18
+#: (rankflow tracks the conv batching rule's rank-major feature merge
+#: as a BLOCKED layout) and a small TransformerLM, full-attention and
+#: flash (the Pallas kernel passes via the declared-kernel registry,
+#: analysis/kernels.py).  All on a Ring(4).
 N_RANKS = 4
 IN_SHAPE = (8, 8, 1)
 PER_RANK = 4
+#: production-geometry cells trace bigger programs; a smaller per-rank
+#: batch keeps the executed metric leg tractable on CPU
+PER_RANK_PROD = 2
+SEQ_LEN = 16
+VOCAB = 32
 MODEL = dict(hidden=16)
+#: model name -> (constructor, input shape, input dtype, per-rank batch)
+GEOMETRIES = {
+    "mlp": (lambda attn: MLP(**MODEL), IN_SHAPE, jnp.float32, PER_RANK),
+    "lenet": (
+        lambda attn: LeNetCifar(), (32, 32, 3), jnp.float32, PER_RANK_PROD
+    ),
+    "resnet18": (
+        lambda attn: ResNet18(), (32, 32, 3), jnp.float32, PER_RANK_PROD
+    ),
+    "transformer": (
+        lambda attn: TransformerLM(
+            vocab=VOCAB, dim=16, n_heads=2, n_layers=1, max_len=SEQ_LEN,
+            attn=attn,
+        ),
+        (SEQ_LEN,), jnp.int32, PER_RANK_PROD,
+    ),
+}
 CFG = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2,
                   max_silence=4)
 #: fits Dense_0's kernel+bias, defers the second layer when all fire
@@ -92,8 +127,16 @@ class AuditConfig:
 
     name: str
     algo: str = "eventgrad"
+    #: audit geometry (GEOMETRIES key): mlp | lenet | resnet18 | transformer
+    model: str = "mlp"
+    #: attention mode for the transformer geometry ("full" | "flash";
+    #: flash exercises the Pallas kernels through the declared-kernel
+    #: registry, analysis/kernels.py)
+    attn: str = "full"
     wire: Optional[str] = None
     gossip_wire: str = "dense"
+    #: compact wire capacity; -1 = auto (the model's static capacity
+    #: floor — largest leaf, or the bucketed floor sum at K)
     capacity: Optional[int] = None
     arena: bool = False
     obs: bool = False
@@ -112,6 +155,10 @@ class AuditConfig:
     #: verify donation aliasing under the loop's donate_argnums=(0,)
     #: jit (a second trace+lower — run on representative cells only)
     donation: bool = False
+    #: heavy cells (ResNet18's 17.4M-param trace, the flash interpret
+    #: run) stay out of the fast tier-1 matrix — tests mark them `slow`;
+    #: tools/audit.py always runs them
+    heavy: bool = False
 
 
 #: the audit matrix: every dimension of the step's configuration space
@@ -143,6 +190,28 @@ CONFIGS: Tuple[AuditConfig, ...] = (
     AuditConfig("event_compact_int8_arena_b4", gossip_wire="compact",
                 capacity=BUCKETED_CAPACITY, wire="int8", arena=True,
                 bucketed=4),
+    # production geometries (ISSUE 12): the models the headline numbers
+    # ship on, audited at real geometry — conv rank-major feature
+    # merges tracked as BLOCKED layouts, the flash Pallas kernels
+    # passing via the declared-kernel registry — across masked|compact
+    # x f32/int8 x arena x bucketed K=4
+    AuditConfig("lenet_masked_f32_arena", model="lenet", arena=True,
+                donation=True),
+    AuditConfig("lenet_compact_int8_arena", model="lenet",
+                gossip_wire="compact", capacity=-1, wire="int8",
+                arena=True),
+    AuditConfig("lenet_masked_f32_arena_b4", model="lenet", arena=True,
+                bucketed=4),
+    AuditConfig("resnet18_masked_f32_arena", model="resnet18", arena=True,
+                heavy=True),
+    AuditConfig("resnet18_compact_f32_arena", model="resnet18",
+                gossip_wire="compact", capacity=-1, arena=True,
+                heavy=True),
+    AuditConfig("xfmr_masked_f32_arena", model="transformer", arena=True),
+    AuditConfig("xfmr_compact_int8_tree", model="transformer",
+                gossip_wire="compact", capacity=-1, wire="int8"),
+    AuditConfig("xfmr_flash_masked_f32_tree", model="transformer",
+                attn="flash", heavy=True),
 )
 
 
@@ -156,12 +225,44 @@ def config_by_name(name: str) -> AuditConfig:
 # --- building the step under audit -----------------------------------------
 
 
-def _batch():
-    x, y = synthetic_dataset(N_RANKS * PER_RANK, IN_SHAPE, seed=0)
+def _geometry(cfg: AuditConfig):
+    """(model, input shape, input dtype, per-rank batch) of a cell."""
+    make, in_shape, in_dtype, per_rank = GEOMETRIES[cfg.model]
+    return make(cfg.attn), in_shape, in_dtype, per_rank
+
+
+def _batch(cfg: AuditConfig):
+    _, in_shape, in_dtype, per_rank = GEOMETRIES[cfg.model]
+    if in_dtype == jnp.int32:
+        # token LM: next-token targets on a fixed random sequence
+        toks = jax.random.randint(
+            jax.random.PRNGKey(0), (N_RANKS, per_rank) + tuple(in_shape),
+            0, VOCAB,
+        )
+        return toks, jnp.roll(toks, -1, axis=-1)
+    x, y = synthetic_dataset(N_RANKS * per_rank, in_shape, seed=0)
     return (
-        jnp.asarray(x.reshape((N_RANKS, PER_RANK) + IN_SHAPE)),
-        jnp.asarray(y.reshape((N_RANKS, PER_RANK))),
+        jnp.asarray(x.reshape((N_RANKS, per_rank) + tuple(in_shape))),
+        jnp.asarray(y.reshape((N_RANKS, per_rank))),
     )
+
+
+def resolved_capacity(cfg: AuditConfig, state) -> Optional[int]:
+    """The compact capacity a cell actually runs at.  `capacity=-1`
+    means auto: the model's STATIC capacity floor (largest leaf, or the
+    sum of per-bucket floors under a bucketed schedule) — derived from
+    the same ArenaSpec / collectives helpers the step itself uses, so
+    the audited wire format can never drift from the program's."""
+    if cfg.gossip_wire != "compact":
+        return None
+    if cfg.capacity is not None and cfg.capacity >= 0:
+        return cfg.capacity
+    params = jax.tree.map(lambda x: x[0], state.params)
+    if cfg.bucketed and cfg.bucketed >= 2:
+        buckets = arena_lib.arena_spec(params).buckets(cfg.bucketed)
+        return int(collectives.bucketed_capacity_floor(buckets))
+    sizes = [int(p.size) for p in jax.tree.leaves(params)]
+    return int(collectives.compact_capacity_floor(sizes))
 
 
 def build(cfg: AuditConfig):
@@ -169,12 +270,12 @@ def build(cfg: AuditConfig):
     construction tests/test_arena.py uses, so the audited program IS the
     tested program."""
     topo = Ring(N_RANKS)
-    model = MLP(**MODEL)
+    model, in_shape, in_dtype, _ = _geometry(cfg)
     tx = optax.sgd(0.05)
     chaos = ChaosSchedule(seed=3, drop_p=0.4) if cfg.chaos else None
     state = init_train_state(
-        model, IN_SHAPE, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
-        bucketed=cfg.bucketed or 1,
+        model, in_shape, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
+        bucketed=cfg.bucketed or 1, input_dtype=in_dtype,
     )
     if chaos is not None:
         state = state.replace(
@@ -191,7 +292,8 @@ def build(cfg: AuditConfig):
         )
     step = make_train_step(
         model, tx, topo, cfg.algo, event_cfg=CFG, wire=cfg.wire,
-        gossip_wire=cfg.gossip_wire, compact_capacity=cfg.capacity,
+        gossip_wire=cfg.gossip_wire,
+        compact_capacity=resolved_capacity(cfg, state),
         staleness=cfg.staleness, obs=cfg.obs, chaos=chaos,
         arena=cfg.arena,
         integrity=IntegrityConfig() if cfg.integrity else None,
@@ -219,19 +321,17 @@ def _bucket_info(cfg: AuditConfig, state):
     expected lanes and formula can never drift from the program."""
     if not cfg.bucketed or cfg.bucketed < 2:
         return None
-    from eventgrad_tpu.parallel import arena as arena_lib
-
     params = jax.tree.map(lambda x: x[0], state.params)
     buckets = arena_lib.arena_spec(params).buckets(cfg.bucketed)
     caps = (
-        collectives.split_capacity(cfg.capacity, buckets)
+        collectives.split_capacity(resolved_capacity(cfg, state), buckets)
         if cfg.gossip_wire == "compact" else None
     )
     return buckets, caps
 
 
 def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int,
-                    binfo=None):
+                    binfo=None, capacity: Optional[int] = None):
     """[(role, elems, dtype)] one neighbor's exchange must ship; riders
     are transfer metadata documented OUTSIDE the wire-byte formula.
     Bucketed cells expect K lane GROUPS per neighbor — one value lane
@@ -250,7 +350,7 @@ def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int,
                 lanes.append(("scale", b.n_leaves, "float32"))
         return lanes, []
     val_elems = (
-        cfg.capacity if cfg.gossip_wire == "compact" else n_params
+        capacity if cfg.gossip_wire == "compact" else n_params
     )
     lanes = [("value", val_elems, _WIRE_DTYPE[cfg.wire])]
     if cfg.algo == "eventgrad":
@@ -263,7 +363,7 @@ def _expected_lanes(cfg: AuditConfig, n_params: int, n_leaves: int,
 
 def _formula_bytes_per_neighbor(
     cfg: AuditConfig, n_params: int, n_leaves: int, k_total: int,
-    binfo=None,
+    binfo=None, capacity: Optional[int] = None,
 ) -> float:
     """The SHIPPED accounting formula the metric is built from — what
     the jaxpr-derived truth is checked against. Bucketed cells sum the
@@ -280,7 +380,7 @@ def _formula_bytes_per_neighbor(
     return collectives.wire_real_bytes_per_neighbor(
         n_params, n_leaves, cfg.wire,
         compact_capacity=(
-            cfg.capacity if cfg.gossip_wire == "compact" else None
+            capacity if cfg.gossip_wire == "compact" else None
         ),
         fire_bits=(cfg.algo == "eventgrad"),
     )
@@ -292,6 +392,7 @@ def _classify_exchanges(
     n_params: int,
     n_leaves: int,
     binfo=None,
+    capacity: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Group the detected exchange lanes by ring offset and check them
     against the expected wire format; returns per-neighbor derived
@@ -302,7 +403,7 @@ def _classify_exchanges(
     problems: List[str] = []
     per_offset_bytes: Dict[int, float] = {}
     rider_bytes: Dict[int, float] = {}
-    expected = _expected_lanes(cfg, n_params, n_leaves, binfo)
+    expected = _expected_lanes(cfg, n_params, n_leaves, binfo, capacity)
     for off, lanes in groups.items():
         got = sorted((e.lane_elems, e.dtype) for e in lanes)
         if expected is None:
@@ -445,10 +546,11 @@ def audit_config(
     dict `tools/audit.py` serializes (all findings, no asserts — the
     caller decides what is fatal)."""
     state, step, topo = build(cfg)
-    batch = _batch()
+    batch = _batch(cfg)
     lifted = spmd(step, topo)
     closed = jax.make_jaxpr(lifted)(state, batch)
     n_params, n_leaves, k_total = _meta(state)
+    capacity = resolved_capacity(cfg, state)
 
     report = rankflow.analyze(closed, N_RANKS)
     violations = [
@@ -465,12 +567,14 @@ def audit_config(
 
     declared = sorted(nb.offset for nb in topo.neighbors)
     binfo = _bucket_info(cfg, state)
-    wire = _classify_exchanges(cfg, report, n_params, n_leaves, binfo)
+    wire = _classify_exchanges(
+        cfg, report, n_params, n_leaves, binfo, capacity
+    )
     undeclared_offsets = sorted(set(wire["offsets"]) - set(declared))
     missing_offsets = sorted(set(declared) - set(wire["offsets"]))
 
     formula = _formula_bytes_per_neighbor(
-        cfg, n_params, n_leaves, k_total, binfo
+        cfg, n_params, n_leaves, k_total, binfo, capacity
     )
     derived_each = list(wire["per_offset_bytes"].values())
     derived_total = float(sum(derived_each))
@@ -486,7 +590,11 @@ def audit_config(
     if run_metric:
         _, m = lifted(state, batch)  # eager vmap: no jit required
         metric_total = float(np.asarray(m["sent_bytes_wire_real"])[0])
-        metric_match = metric_total == derived_total
+        # the step carries the metric as an f32 scalar (train/steps.py);
+        # at ResNet18 scale (~1.4e8 B/step) integer byte counts exceed
+        # f32's 24-bit mantissa, so the derived truth is compared AFTER
+        # the same quantization — still exact, in the metric's carrier
+        metric_match = metric_total == float(np.float32(derived_total))
 
     n_total = int(n_params)
     ravels = walker.count_full_ravels(closed.jaxpr, n_total)
@@ -499,6 +607,9 @@ def audit_config(
     return {
         "name": cfg.name,
         "algo": cfg.algo,
+        "model": cfg.model,
+        "attn": cfg.attn,
+        "capacity": capacity,
         "wire": cfg.wire,
         "gossip_wire": cfg.gossip_wire,
         "arena": cfg.arena,
@@ -594,7 +705,7 @@ def audit_shard_lift(cfg: AuditConfig) -> Dict[str, Any]:
     state, step, topo = build(cfg)
     mesh = build_mesh(topo)
     lifted = spmd(step, topo, mesh=mesh)
-    closed = jax.make_jaxpr(lifted)(state, _batch())
+    closed = jax.make_jaxpr(lifted)(state, _batch(cfg))
     declared = sorted(nb.offset for nb in topo.neighbors)
     colls = collect_collectives(closed.jaxpr, topo.n_ranks)
     bad = []
@@ -625,13 +736,16 @@ def audit_shard_lift(cfg: AuditConfig) -> Dict[str, Any]:
 def _audit_lifted(cfg, lifted, state, batch, run_metric=False):
     closed = jax.make_jaxpr(lifted)(state, batch)
     n_params, n_leaves, k_total = _meta(state)
+    capacity = resolved_capacity(cfg, state)
     report = rankflow.analyze(closed, N_RANKS)
     topo = Ring(N_RANKS)
     declared = sorted(nb.offset for nb in topo.neighbors)
     binfo = _bucket_info(cfg, state)
-    wire = _classify_exchanges(cfg, report, n_params, n_leaves, binfo)
+    wire = _classify_exchanges(
+        cfg, report, n_params, n_leaves, binfo, capacity
+    )
     formula = _formula_bytes_per_neighbor(
-        cfg, n_params, n_leaves, k_total, binfo
+        cfg, n_params, n_leaves, k_total, binfo, capacity
     )
     derived_total = float(sum(wire["per_offset_bytes"].values()))
     out = {
@@ -648,7 +762,11 @@ def _audit_lifted(cfg, lifted, state, batch, run_metric=False):
     if run_metric:
         _, m = lifted(state, batch)
         out["metric_total"] = float(np.asarray(m["sent_bytes_wire_real"])[0])
-        out["metric_match"] = out["metric_total"] == derived_total
+        # f32-quantized comparison — the metric's on-device carrier
+        # (see audit_config)
+        out["metric_match"] = (
+            out["metric_total"] == float(np.float32(derived_total))
+        )
     return out
 
 
@@ -667,7 +785,7 @@ def oracle_rank_coupling() -> Tuple[bool, str]:
         )
         return ns, m
 
-    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch())
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch(cfg))
     detected = bool(rep["undeclared_offsets"]) or bool(rep["wire_problems"])
     return detected, (
         f"undeclared exchange offsets {rep['undeclared_offsets']}"
@@ -690,7 +808,7 @@ def oracle_rank_roll() -> Tuple[bool, str]:
         ))
         return ns, m
 
-    rep = _audit_lifted(cfg, bad, state, _batch())
+    rep = _audit_lifted(cfg, bad, state, _batch(cfg))
     return rep["violations"] > 0, (
         f"{rep['violations']} rank-flow violations: "
         f"{rep['violation_details'][:2]}"
@@ -705,7 +823,7 @@ def oracle_wire_dtype_upcast() -> Tuple[bool, str]:
     try:
         collectives._wire_out = lambda x, wire: x  # the sabotage
         state, step, topo = build(cfg)
-        rep = _audit_lifted(cfg, spmd(step, topo), state, _batch())
+        rep = _audit_lifted(cfg, spmd(step, topo), state, _batch(cfg))
     finally:
         collectives._wire_out = orig
     detected = bool(rep["wire_problems"]) and not rep["formula_match"]
@@ -724,7 +842,7 @@ def oracle_extra_ravel() -> Tuple[bool, str]:
         m["extra"] = jnp.sum(ravel_pytree(ns.params)[0])
         return ns, m
 
-    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch())
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch(cfg))
     return rep["ravel_count"] > cfg.ravel_budget, (
         f"{rep['ravel_count']} full-model ravels > budget "
         f"{cfg.ravel_budget}"
@@ -746,7 +864,7 @@ def oracle_byte_formula_drift() -> Tuple[bool, str]:
         collectives.wire_real_bytes_per_neighbor = broken
         state, step, topo = build(cfg)
         rep = _audit_lifted(
-            cfg, spmd(step, topo), state, _batch(), run_metric=True
+            cfg, spmd(step, topo), state, _batch(cfg), run_metric=True
         )
     finally:
         collectives.wire_real_bytes_per_neighbor = orig
@@ -766,7 +884,7 @@ def oracle_host_callback() -> Tuple[bool, str]:
         jax.debug.callback(lambda x: None, m["loss"])
         return ns, m
 
-    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch())
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch(cfg))
     return rep["callbacks"] > 0, f"{rep['callbacks']} host callbacks"
 
 
@@ -788,10 +906,110 @@ def oracle_bucket_undeclared_offset() -> Tuple[bool, str]:
         )
         return ns, m
 
-    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch())
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch(cfg))
     detected = bool(rep["undeclared_offsets"]) or bool(rep["wire_problems"])
     return detected, (
         f"undeclared exchange offsets {rep['undeclared_offsets']}"
+    )
+
+
+def oracle_conv_rank_merge() -> Tuple[bool, str]:
+    """The conv batching rule's rank-major feature merge WITHOUT the
+    group confinement that makes it legal: per-rank channels folded
+    into one feature dim and convolved with feature_group_count=1 —
+    every output channel reads every rank's channels (ISSUE 12's conv
+    seeded oracle; the legal merge carries feature_group_count
+    divisible by n_ranks and audits clean in the lenet/resnet cells)."""
+    cfg = config_by_name("lenet_masked_f32_arena")
+    state, step, topo = build(cfg)
+    inner = spmd(step, topo)
+
+    def bad(state, batch):
+        ns, m = inner(state, batch)
+        x, _ = batch  # stacked [n, B, H, W, C]
+        n, b = x.shape[0], x.shape[1]
+        # the rank-major merge itself is the LEGAL blocked layout...
+        merged = jnp.transpose(x, (1, 2, 3, 0, 4)).reshape(
+            b, x.shape[2], x.shape[3], n * x.shape[4]
+        )
+        # ...but convolving it with fgc=1 contracts across ranks
+        kern = jnp.ones((3, 3, n * x.shape[4], 2), x.dtype)
+        mixed = lax.conv_general_dilated(
+            merged, kern, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        m = dict(m)
+        m["leak"] = jnp.sum(mixed)
+        return ns, m
+
+    rep = _audit_lifted(cfg, bad, state, _batch(cfg))
+    detected = any(
+        "feature groups" in r or "conv" in r
+        for r in rep["violation_details"]
+    )
+    return detected and rep["violations"] > 0, (
+        f"{rep['violations']} violations: {rep['violation_details'][:1]}"
+    )
+
+
+def oracle_unregistered_kernel() -> Tuple[bool, str]:
+    """A pallas_call whose kernel has NO declared rank-dim signature —
+    an opaque boundary the dataflow cannot see through must stay a
+    violation, or any future kernel would silently bypass the audit."""
+    from jax.experimental import pallas as pl
+
+    cfg = config_by_name("event_masked_f32_arena_obs")
+    state, step, topo = build(cfg)
+
+    def _leak_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def bad(state, batch):
+        ns, m = step(state, batch)
+        val = jnp.broadcast_to(m["loss"], (8, 128)).astype(jnp.float32)
+        m = dict(m)
+        m["leak"] = jnp.sum(pl.pallas_call(
+            _leak_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True,
+        )(val))
+        return ns, m
+
+    rep = _audit_lifted(cfg, spmd(bad, topo), state, _batch(cfg))
+    detected = any(
+        "unregistered pallas kernel" in r for r in rep["violation_details"]
+    )
+    return detected, f"{rep['violation_details'][:1]}"
+
+
+def oracle_attention_cross_rank_gather() -> Tuple[bool, str]:
+    """A data-dependent gather ACROSS the rank axis — the bug a sloppy
+    cross-rank attention port would introduce (each rank attending to a
+    peer chosen by its own activations instead of the topology's
+    declared ring offsets)."""
+    cfg = config_by_name("xfmr_masked_f32_arena")
+    state, step, topo = build(cfg)
+    inner = spmd(step, topo)
+
+    def bad(state, batch):
+        ns, m = inner(state, batch)
+        leaf = jax.tree.leaves(ns.params)[0]
+        # route by data: the 'key' rank each rank reads is picked by
+        # the per-rank losses, not a declared constant permutation
+        idx = jnp.argsort(m["loss"])
+        m = dict(m)
+        m["leak"] = jnp.sum(
+            jnp.take(leaf, idx, axis=0),
+            axis=tuple(range(1, leaf.ndim)),
+        )
+        return ns, m
+
+    rep = _audit_lifted(cfg, bad, state, _batch(cfg))
+    detected = any(
+        "across the rank axis" in r for r in rep["violation_details"]
+    )
+    return detected and rep["violations"] > 0, (
+        f"{rep['violations']} violations: {rep['violation_details'][:1]}"
     )
 
 
@@ -803,6 +1021,10 @@ ORACLES = {
     "extra_full_ravel": oracle_extra_ravel,
     "byte_formula_drift": oracle_byte_formula_drift,
     "host_callback": oracle_host_callback,
+    # ISSUE 12: the full-geometry legs
+    "conv_rank_merge": oracle_conv_rank_merge,
+    "unregistered_kernel": oracle_unregistered_kernel,
+    "attention_cross_rank_gather": oracle_attention_cross_rank_gather,
 }
 
 

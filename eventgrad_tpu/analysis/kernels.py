@@ -1,0 +1,128 @@
+"""The declared-kernel registry: rank-dim signatures for opaque kernels.
+
+`pallas_call` (and any future custom-call) is an opaque boundary to the
+rank-isolation dataflow (analysis/rankflow.py): the abstract interpreter
+cannot look through the kernel body's ref semantics to prove the rank
+axis is treated pointwise.  Soundness therefore demands an EXPLICIT
+trust declaration: a kernel may appear in the audited step only if it
+is registered here with a rank-dim signature, and rankflow checks every
+call site against that signature —
+
+  * every rank-carrying operand must carry the rank axis at the
+    signature's `lifted_dim` (the grid dim vmap prepends when it
+    batches a `pallas_call`), un-merged (no blocked/folded layout);
+  * every output inherits the rank axis at `lifted_dim` and must be
+    shaped `n_ranks` there;
+  * an UNREGISTERED kernel is a violation, always — even on
+    rank-invariant operands.  A new kernel must be reviewed for
+    rank-pointwise semantics and declared, not waved through.
+
+Registering a kernel is a reviewed claim, not a formality: by adding an
+entry you assert the kernel body never indexes across the lifted grid
+dim (its BlockSpec index maps pass the batch grid index straight
+through).  docs/ANALYSIS.md "Registering a kernel" has the checklist.
+
+The registry is also the source of truth for the
+`pallas-kernel-registered` AST lint (analysis/lint.py): every
+`pl.pallas_call` site in the package must reference a registered kernel
+function, and every entry must still name a real call site (stale
+entries flag).  Entries are keyed by the KERNEL FUNCTION's name — the
+name `pallas_call` carries in the traced jaxpr (`name_and_src_info`),
+modulo the `_batched` suffixes vmap appends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+#: suffix vmap's pallas batching rule appends to the traced kernel name
+#: (once per nested vmap level)
+_BATCH_SUFFIX = "_batched"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSig:
+    """Rank-dim signature of one declared kernel.
+
+    `name` — the kernel function's name (jaxpr `name_and_src_info`).
+    `module` — where the kernel lives (docs + the lint's cross-check).
+    `lifted_dim` — the array dim every rank-carrying operand/output
+    must carry the rank coordinate at under the vmap lift (the
+    prepended batch-grid dim; 0 for every kernel we ship).
+    `reviewed` — one line recording WHY the kernel is rank-pointwise.
+    """
+
+    name: str
+    module: str
+    lifted_dim: int = 0
+    reviewed: str = ""
+
+
+#: the declared kernels.  First entries (ISSUE 12): the FlashAttention
+#: family (ops/attention.py — also the kernels parallel/ring_attention.py
+#: runs per hop under use_flash=True) and the arena/event engines.
+REGISTRY: Dict[str, KernelSig] = {}
+
+
+def register(sig: KernelSig) -> KernelSig:
+    if sig.name in REGISTRY:
+        raise ValueError(f"kernel {sig.name!r} already registered")
+    REGISTRY[sig.name] = sig
+    return sig
+
+
+for _sig in (
+    KernelSig(
+        "_fwd_kernel", "eventgrad_tpu/ops/attention.py",
+        reviewed="flash fwd: grid (B,H,nQ,nK); B carries the lifted batch "
+        "straight through every BlockSpec index map — no cross-batch read "
+        "(ring_attention's use_flash hop runs this same kernel per hop)",
+    ),
+    KernelSig(
+        "_dq_kernel", "eventgrad_tpu/ops/attention.py",
+        reviewed="flash bwd dQ: same (B,H,·,·) grid discipline as _fwd_kernel",
+    ),
+    KernelSig(
+        "_dkv_kernel", "eventgrad_tpu/ops/attention.py",
+        reviewed="flash bwd dK/dV: same (B,H,·,·) grid discipline as "
+        "_fwd_kernel",
+    ),
+    KernelSig(
+        "_kernel", "eventgrad_tpu/ops/fused_update.py",
+        reviewed="fused mix+SGD: 1-D row grid over the padded flat arena; "
+        "index map i -> (i, 0) never crosses rows of the lifted dim",
+    ),
+    KernelSig(
+        "_commit_kernel", "eventgrad_tpu/ops/arena_update.py",
+        reviewed="bucketed commit+mix+SGD tail: 1-D row grid, pointwise "
+        "row blocks",
+    ),
+    KernelSig(
+        "_mask_kernel", "eventgrad_tpu/ops/event_engine.py",
+        reviewed="masked-wire build: 1-D row grid, per-row select",
+    ),
+    KernelSig(
+        "_mask_quant_kernel", "eventgrad_tpu/ops/event_engine.py",
+        reviewed="masked-wire build + int8 quantize: 1-D row grid, "
+        "per-row select/scale",
+    ),
+):
+    register(_sig)
+
+
+def base_name(traced_name: str) -> str:
+    """Strip the `_batched` suffix(es) vmap's pallas batching rule
+    appends, recovering the registry key."""
+    while traced_name.endswith(_BATCH_SUFFIX):
+        traced_name = traced_name[: -len(_BATCH_SUFFIX)]
+    return traced_name
+
+
+def lookup(traced_name: str) -> Optional[KernelSig]:
+    """Signature for a jaxpr-traced kernel name, or None if undeclared."""
+    return REGISTRY.get(base_name(traced_name))
+
+
+def registered_names() -> Tuple[str, ...]:
+    return tuple(sorted(REGISTRY))

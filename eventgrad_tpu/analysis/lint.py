@@ -32,6 +32,12 @@ The rules (docs/ANALYSIS.md has the rationale for each):
     timing API; tools/ and bench.py are host-side tooling outside this
     lint's scope).  Pre-existing metric sites are EXEMPT by name with
     the reason on record, honesty-checked like os-exit-confined.
+  * pallas-kernel-registered — every `pl.pallas_call` site in the
+    package must reference a kernel with a declared rank-dim signature
+    in analysis/kernels.py (the trace auditor refuses unregistered
+    kernels; this rule catches the drift at the SOURCE before a trace
+    ever runs), and every registry entry must still name a live call
+    site in its declared module (stale entries flag).
 
 Adding a rule: subclass `Rule`, implement `check(files)`, append to
 `RULES`.  Scope rules by `rel` prefix; prefer AST matching; when a
@@ -384,6 +390,141 @@ class WallClockConfined(Rule):
         return out
 
 
+class PallasKernelRegistered(Rule):
+    """Every `pallas_call` site in the package references a kernel with
+    a declared rank-dim signature (analysis/kernels.py).  The trace
+    auditor (analysis/rankflow.py) already refuses unregistered kernels
+    at trace time; this rule catches the drift at the SOURCE — a new
+    kernel fails lint the moment it is called, not the first time a
+    config that reaches it is audited.  Honesty runs both ways: a
+    registry entry whose declared module no longer calls the kernel has
+    gone stale and flags too."""
+
+    name = "pallas-kernel-registered"
+    #: the one named exemption: the auditor's own seeded-oracle source
+    #: DELIBERATELY calls an unregistered kernel to prove the check can
+    #: fire.  Honesty-checked — the file must still contain at least one
+    #: unregistered site, or the exemption has gone stale.
+    EXEMPT = {
+        "eventgrad_tpu/analysis/audit.py":
+            "oracle_unregistered_kernel's seeded `_leak_kernel` — the "
+            "violation that proves the auditor's registry check fires",
+    }
+
+    @staticmethod
+    def _kernel_names(node) -> Optional[List[str]]:
+        """Kernel-function candidates of a pallas_call's first arg:
+        a bare name, `functools.partial(name, ...)`, or a conditional
+        between those.  None = statically unresolvable."""
+        if isinstance(node, ast.Name):
+            return [node.id]
+        if isinstance(node, ast.Attribute):
+            return [node.attr]
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_partial = (
+                isinstance(fn, ast.Name) and fn.id == "partial"
+            ) or (
+                isinstance(fn, ast.Attribute) and fn.attr == "partial"
+            )
+            if is_partial and node.args:
+                return PallasKernelRegistered._kernel_names(node.args[0])
+            return None
+        if isinstance(node, ast.IfExp):
+            body = PallasKernelRegistered._kernel_names(node.body)
+            orelse = PallasKernelRegistered._kernel_names(node.orelse)
+            if body is None or orelse is None:
+                return None
+            return body + orelse
+        return None
+
+    def check(self, files):
+        from eventgrad_tpu.analysis import kernels
+
+        out = []
+        #: registry-module rel path -> kernel names referenced there
+        referenced: Dict[str, List[str]] = {}
+        for sf in files:
+            if not _in_package(sf):
+                continue
+            sf_viol: List[Violation] = []
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and (
+                        (
+                            isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "pallas_call"
+                        )
+                        or (
+                            isinstance(node.func, ast.Name)
+                            and node.func.id == "pallas_call"
+                        )
+                    )
+                ):
+                    continue
+                names = (
+                    self._kernel_names(node.args[0]) if node.args else None
+                )
+                if names is None:
+                    sf_viol.append(self._v(
+                        sf, node.lineno,
+                        "pallas_call kernel argument is not statically "
+                        "resolvable — pass the kernel function directly "
+                        "(or via functools.partial / a conditional of "
+                        "named kernels) so the declared-kernel registry "
+                        "lint can check it",
+                    ))
+                    continue
+                rel_posix = sf.rel.replace(os.sep, "/")
+                for nm in names:
+                    sig = kernels.REGISTRY.get(nm)
+                    if sig is None:
+                        sf_viol.append(self._v(
+                            sf, node.lineno,
+                            f"pallas_call kernel '{nm}' has no declared "
+                            "rank-dim signature — register it in "
+                            "analysis/kernels.py (the trace auditor "
+                            "refuses unregistered kernels; see "
+                            "docs/ANALYSIS.md 'Registering a kernel')",
+                        ))
+                    elif sig.module != rel_posix:
+                        sf_viol.append(self._v(
+                            sf, node.lineno,
+                            f"pallas_call kernel '{nm}' is registered "
+                            f"for {sig.module}, called from {rel_posix} "
+                            "— one signature per kernel site; register "
+                            "this module's kernel under its own entry",
+                        ))
+                    else:
+                        referenced.setdefault(rel_posix, []).append(nm)
+            if sf.rel.replace(os.sep, "/") in self.EXEMPT:
+                if not sf_viol:
+                    out.append(self._v(
+                        sf, 1,
+                        "exempt file no longer calls an unregistered "
+                        "pallas kernel — drop it from "
+                        "PallasKernelRegistered.EXEMPT ("
+                        f"{self.EXEMPT[sf.rel.replace(os.sep, '/')]})",
+                    ))
+                continue
+            out.extend(sf_viol)
+        # stale entries: a registry module present in the scanned set
+        # must still call every kernel it declares
+        scanned = {sf.rel.replace(os.sep, "/") for sf in files}
+        for nm, sig in sorted(kernels.REGISTRY.items()):
+            if sig.module in scanned and nm not in referenced.get(
+                sig.module, []
+            ):
+                out.append(Violation(
+                    self.name, sig.module, 1,
+                    f"registered kernel '{nm}' has no pallas_call site "
+                    f"left in {sig.module} — the registry entry has gone "
+                    "stale; drop it from analysis/kernels.py",
+                ))
+        return out
+
+
 # --- shard_map skip-pattern rules (tests/) ----------------------------------
 
 #: the seed's shard_map test files: the pre-existing tier-1 baseline
@@ -481,6 +622,7 @@ RULES: Sequence[Rule] = (
     NoHostSyncInTraced(),
     CrashpointInstrumented(),
     WallClockConfined(),
+    PallasKernelRegistered(),
     ShardMapMarkerImport(),
     ShardMapRespell(),
     ShardMapExemptHonest(),

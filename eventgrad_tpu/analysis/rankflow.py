@@ -8,7 +8,7 @@ form: every equation of the lifted jaxpr must treat that axis
 POINTWISE, except the equations `lax.ppermute` lowers to — under vmap,
 a gather over the rank axis whose indices are a CONSTANT permutation
 (the neighbor shift).  This module is an abstract interpreter that
-tracks, for every intermediate, which array axis (if any) carries the
+tracks, for every intermediate, which array dim (if any) carries the
 rank coordinate, and reports
 
   * `exchanges` — the constant-permutation gathers found, each with its
@@ -22,13 +22,30 @@ rank coordinate, and reports
     concatenate that cuts the axis, a reduction over it, a reshape that
     folds it away, an unknown primitive the rules cannot prove safe).
 
+The abstract value (`Abs`) carries the rank dim in one of two layouts:
+
+  * PURE — `axis` d with `block == 1`: shape[d] == n_ranks, index d
+    IS the rank coordinate (the spmd stacked layout).
+  * BLOCKED — `axis` d with `block == B > 1`: shape[d] == n_ranks * B
+    laid out RANK-MAJOR (index = r * B + j).  This is exactly what the
+    vmap batching rules for `conv_general_dilated` emit: the rank axis
+    merges into a batch or feature dim through a transpose-fused
+    reshape, the conv runs with `feature_group_count` multiplied by
+    n_ranks (group-confined — rank r's channels only convolve rank r's
+    filters), and a second reshape splits the rank axis back out.
+    Tracking the blocked layout through that sandwich is what lets the
+    audit run on the real conv models (LeNetCifar, ResNet18) instead
+    of an MLP proxy.
+
+Opaque kernels (`pallas_call`) cannot be looked through; they are legal
+ONLY when registered with an explicit rank-dim signature in
+analysis/kernels.py (the flash-attention family and the arena/event
+engines are the shipped entries) — an unregistered kernel is a
+violation even on rank-invariant operands.
+
 Soundness stance: UNKNOWN primitives are violations, not warnings — a
-new op in the step must either be provably rank-pointwise (add a rule)
-or be a declared exchange.  Known limitation: a reshape that merges the
-rank axis with another dim (the vmap batching rule for convolutions
-does this) reports as a violation; the audit matrix therefore runs on
-the MLP geometry, where the step's exchange structure is identical and
-no such merge occurs (docs/ANALYSIS.md).
+new op in the step must either be provably rank-pointwise (add a rule
+here), be a declared exchange, or carry a declared kernel signature.
 """
 
 from __future__ import annotations
@@ -40,6 +57,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from eventgrad_tpu.analysis import kernels
+
 #: cap on constant values carried through the fold (the permutation
 #: vectors are [n_ranks]; anything big is never needed for an index)
 _MAX_CONST_ELEMS = 1 << 16
@@ -49,11 +68,14 @@ _MAX_CONST_ELEMS = 1 << 16
 class Abs:
     """Abstract value: `axis` is the array dim carrying the rank
     coordinate (None = rank-invariant — the value does not depend on
-    any rank's inputs); `const` is the concrete value when statically
-    known (index pipelines), else None."""
+    any rank's inputs); `block` is the rank-major block size of that
+    dim (1 = the pure stacked layout, B > 1 = shape[axis] == n*B with
+    index = r*B + j — the conv batching rules' merged layout); `const`
+    is the concrete value when statically known (index pipelines)."""
 
     axis: Optional[int] = None
     const: Optional[np.ndarray] = None
+    block: int = 1
 
 
 @dataclasses.dataclass
@@ -118,6 +140,13 @@ _PREFIX = frozenset({
 _REDUCE = frozenset({
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
     "reduce_or", "reduce_and", "reduce_xor", "argmax", "argmin",
+})
+
+#: windowed sweeps (pooling fwd + bwd): rank-pointwise iff the window
+#: never touches the rank dim
+_WINDOW = frozenset({
+    "reduce_window_sum", "reduce_window_max", "reduce_window_min",
+    "reduce_window", "select_and_scatter_add", "select_and_scatter",
 })
 
 _CUM = frozenset({"cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp"})
@@ -186,12 +215,36 @@ class _Flow:
             return Abs(None, _const_of(v.val))
         return env.get(v, Abs(None, None))
 
-    def _common_axis(self, eqn, path, abs_in) -> Tuple[Optional[int], bool]:
-        axes = {a.axis for a in abs_in if a.axis is not None}
-        if len(axes) > 1:
-            self._bad(eqn, path, f"operands carry rank axes {sorted(axes)}")
-            return None, False
-        return (next(iter(axes)) if axes else None), True
+    def _common_rank(
+        self, eqn, path, abs_in
+    ) -> Tuple[Optional[int], int, bool]:
+        """(axis, block, ok): the single rank layout shared by every
+        ranked operand, or a violation if they disagree."""
+        layouts = {(a.axis, a.block) for a in abs_in if a.axis is not None}
+        if len(layouts) > 1:
+            self._bad(
+                eqn, path,
+                f"operands carry rank layouts {sorted(layouts)} "
+                "(axis, block) that do not agree",
+            )
+            return None, 1, False
+        if layouts:
+            d, b = next(iter(layouts))
+            return d, b, True
+        return None, 1, True
+
+    def _blocked_guard(self, eqn, path, abs_in, n_out) -> Optional[List[Abs]]:
+        """Conservative refusal: a merged (blocked) rank layout reaching
+        a primitive with no blocked rule is a violation, not a guess."""
+        for a in abs_in:
+            if a.axis is not None and a.block != 1:
+                return [self._bad(
+                    eqn, path,
+                    f"{eqn.primitive.name} over a rank-MERGED layout "
+                    f"(axis {a.axis}, block {a.block}) — no blocked rule "
+                    "proves this rank-pointwise",
+                )] * n_out
+        return None
 
     # -- entry point --------------------------------------------------------
 
@@ -235,7 +288,7 @@ class _Flow:
         p = eqn.params
 
         if prim in _ELEMENTWISE:
-            d, ok = self._common_axis(eqn, path, abs_in)
+            d, blk, ok = self._common_rank(eqn, path, abs_in)
             const = None
             if ok and all(a.const is not None for a in abs_in):
                 fn = _FOLD.get(prim)
@@ -255,19 +308,19 @@ class _Flow:
                         const = _const_of(fn(*[a.const for a in abs_in]))
                     except Exception:
                         const = None
-            return [Abs(d, const)] * n_out
+            return [Abs(d, const, blk)] * n_out
 
         if prim in _PREFIX:
             a = abs_in[0]
             d = a.axis
             out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
             if d is not None and (
-                len(out_shape) <= d or out_shape[d] != self.n
+                len(out_shape) <= d or out_shape[d] != self.n * a.block
             ):
                 return [self._bad(
                     eqn, path, f"{prim} drops the rank axis (dim {d})"
                 )] * n_out
-            return [Abs(d, None)] * n_out
+            return [Abs(d, None, a.block)] * n_out
 
         if prim == "broadcast_in_dim":
             a = abs_in[0]
@@ -284,38 +337,10 @@ class _Flow:
                     ))
                 except Exception:
                     const = None
-            return [Abs(d, const)]
+            return [Abs(d, const, a.block)]
 
         if prim == "reshape":
-            a = abs_in[0]
-            if p.get("dimensions") is not None and a.axis is not None:
-                return [self._bad(
-                    eqn, path, "reshape with permuted dimensions over a "
-                    "rank-carrying value"
-                )]
-            in_shape = tuple(eqn.invars[0].aval.shape)
-            out_shape = tuple(eqn.outvars[0].aval.shape)
-            const = None
-            if a.const is not None:
-                try:
-                    const = _const_of(a.const.reshape(out_shape))
-                except Exception:
-                    const = None
-            if a.axis is None:
-                return [Abs(None, const)]
-            pre = math.prod(in_shape[: a.axis]) if a.axis else 1
-            for d2 in range(len(out_shape)):
-                if (
-                    math.prod(out_shape[:d2]) == pre
-                    and out_shape[d2] == self.n
-                ):
-                    return [Abs(d2, const)]
-            return [self._bad(
-                eqn, path,
-                f"reshape {in_shape}->{out_shape} folds the rank axis "
-                f"(dim {a.axis}) into another dim — rank blocks are no "
-                "longer separable",
-            )]
+            return [self._reshape(eqn, abs_in, path)]
 
         if prim == "squeeze":
             a = abs_in[0]
@@ -330,7 +355,9 @@ class _Flow:
                 return [Abs(None, const)]
             if a.axis in dims:
                 return [self._bad(eqn, path, "squeeze removes the rank axis")]
-            return [Abs(a.axis - sum(1 for x in dims if x < a.axis), const)]
+            return [Abs(
+                a.axis - sum(1 for x in dims if x < a.axis), const, a.block
+            )]
 
         if prim == "transpose":
             a = abs_in[0]
@@ -342,7 +369,7 @@ class _Flow:
                     const = _const_of(np.transpose(a.const, perm))
                 except Exception:
                     const = None
-            return [Abs(d, const)]
+            return [Abs(d, const, a.block)]
 
         if prim == "slice":
             a = abs_in[0]
@@ -365,14 +392,14 @@ class _Flow:
             strides = p["strides"] or [1] * len(p["start_indices"])
             if (
                 int(p["start_indices"][d]) != 0
-                or int(p["limit_indices"][d]) != self.n
+                or int(p["limit_indices"][d]) != self.n * a.block
                 or int(strides[d]) != 1
             ):
                 return [self._bad(
                     eqn, path,
                     "slice selects a subset of ranks (cross-rank read)",
                 )]
-            return [Abs(d, const)]
+            return [Abs(d, const, a.block)]
 
         if prim == "pad":
             a = abs_in[0]
@@ -380,10 +407,10 @@ class _Flow:
                 cfg = p["padding_config"][a.axis]
                 if tuple(int(x) for x in cfg) != (0, 0, 0):
                     return [self._bad(eqn, path, "pad alters the rank axis")]
-            return [Abs(a.axis, None)]
+            return [Abs(a.axis, None, a.block)]
 
         if prim == "concatenate":
-            d, ok = self._common_axis(eqn, path, abs_in)
+            d, blk, ok = self._common_rank(eqn, path, abs_in)
             if not ok:
                 return [Abs(None, None)]
             if d is not None and int(p["dimension"]) == d:
@@ -392,7 +419,7 @@ class _Flow:
                     "concatenate along the rank axis reassembles ranks "
                     "(cross-rank write)",
                 )]
-            return [Abs(d, None)]
+            return [Abs(d, None, blk)]
 
         if prim == "iota":
             const = None
@@ -416,7 +443,10 @@ class _Flow:
                 None if a.axis is None
                 else a.axis - sum(1 for x in axes if x < a.axis)
             )
-            return [Abs(d, None)] * n_out
+            return [Abs(d, None, a.block)] * n_out
+
+        if prim in _WINDOW:
+            return self._window(eqn, abs_in, path, n_out)
 
         if prim in _CUM:
             a = abs_in[0]
@@ -424,20 +454,20 @@ class _Flow:
                 return [self._bad(
                     eqn, path, f"{prim} scans across the rank axis"
                 )]
-            return [Abs(a.axis, None)]
+            return [Abs(a.axis, None, a.block)]
 
         if prim == "sort":
-            d, ok = self._common_axis(eqn, path, abs_in)
+            d, blk, ok = self._common_rank(eqn, path, abs_in)
             if ok and d is not None and int(p["dimension"]) == d:
                 return [self._bad(eqn, path, "sort along the rank axis")] * n_out
-            return [Abs(d, None)] * n_out
+            return [Abs(d, None, blk)] * n_out
 
         if prim == "top_k":
             a = abs_in[0]
             ndim = len(eqn.invars[0].aval.shape)
             if a.axis is not None and a.axis == ndim - 1:
                 return [self._bad(eqn, path, "top_k along the rank axis")] * n_out
-            return [Abs(a.axis, None)] * n_out
+            return [Abs(a.axis, None, a.block)] * n_out
 
         if prim == "rev":
             a = abs_in[0]
@@ -448,17 +478,32 @@ class _Flow:
                     eqn, path, "rev reverses the rank axis (a cross-rank "
                     "permutation outside the declared exchange)",
                 )]
-            return [Abs(a.axis, None)]
+            return [Abs(a.axis, None, a.block)]
 
         if prim == "gather":
+            blocked = self._blocked_guard(eqn, path, abs_in, 1)
+            if blocked is not None:
+                return blocked
             return [self._gather(eqn, abs_in, path)]
 
         if prim in ("scatter", "scatter-add", "scatter-mul", "scatter-min",
                     "scatter-max"):
+            blocked = self._blocked_guard(eqn, path, abs_in, 1)
+            if blocked is not None:
+                return blocked
             return [self._scatter(eqn, abs_in, path)]
 
         if prim == "dot_general":
+            blocked = self._blocked_guard(eqn, path, abs_in, 1)
+            if blocked is not None:
+                return blocked
             return [self._dot_general(eqn, abs_in, path)]
+
+        if prim == "conv_general_dilated":
+            return [self._conv(eqn, abs_in, path)]
+
+        if prim == "pallas_call":
+            return self._pallas(eqn, abs_in, path, n_out)
 
         if prim == "dynamic_slice":
             a = abs_in[0]
@@ -466,11 +511,13 @@ class _Flow:
                 return [self._bad(
                     eqn, path, "rank-dependent dynamic_slice start index"
                 )]
-            if a.axis is not None and int(p["slice_sizes"][a.axis]) != self.n:
+            if a.axis is not None and (
+                int(p["slice_sizes"][a.axis]) != self.n * a.block
+            ):
                 return [self._bad(
                     eqn, path, "dynamic_slice cuts the rank axis"
                 )]
-            return [Abs(a.axis, None)]
+            return [Abs(a.axis, None, a.block)]
 
         if prim == "dynamic_update_slice":
             op, upd = abs_in[0], abs_in[1]
@@ -478,14 +525,16 @@ class _Flow:
                 return [self._bad(
                     eqn, path, "rank-dependent dynamic_update_slice index"
                 )]
-            d, ok = self._common_axis(eqn, path, [op, upd])
+            d, blk, ok = self._common_rank(eqn, path, [op, upd])
             if not ok:
                 return [Abs(None, None)]
-            if d is not None and tuple(eqn.invars[1].aval.shape)[d] != self.n:
+            if d is not None and (
+                tuple(eqn.invars[1].aval.shape)[d] != self.n * blk
+            ):
                 return [self._bad(
                     eqn, path, "dynamic_update_slice writes a subset of ranks"
                 )]
-            return [Abs(d, None)]
+            return [Abs(d, None, blk)]
 
         if prim == "psum":
             a = abs_in[0]
@@ -502,7 +551,7 @@ class _Flow:
                 None if a.axis is None
                 else a.axis - sum(1 for x in axes if x < a.axis)
             )
-            return [Abs(d, None)] * n_out
+            return [Abs(d, None, a.block)] * n_out
 
         if prim == "ppermute":
             # shard_map / pmap form: explicit named-axis permutation
@@ -520,7 +569,7 @@ class _Flow:
                     dtype=str(ov.aval.dtype),
                     path=path,
                 ))
-            return [Abs(a.axis, None) for a in abs_in[:n_out]]
+            return [Abs(a.axis, None, a.block) for a in abs_in[:n_out]]
 
         if prim in _COLLECTIVE_VIOLATIONS:
             return [self._bad(
@@ -569,6 +618,207 @@ class _Flow:
         )] * n_out
 
     # -- the interesting primitives -----------------------------------------
+
+    def _reshape(self, eqn, abs_in, path) -> Abs:
+        """One rule for every reshape, including the transpose-fused
+        form (`dimensions` param) the conv batching rules emit.
+
+        With the rank coordinate at dim `a` (block B) of the (possibly
+        pre-permuted) input shape, the flat index decomposes as
+        ``flat = o*(n*inner) + r*inner + i`` with ``o < outer``, where
+        ``outer = prod(shape[:a])`` and ``inner = B*prod(shape[a+1:])``.
+        The output preserves the rank-major structure iff some output
+        dim d2 satisfies ``prod(out[:d2]) == outer`` and
+        ``out[d2] % n == 0`` — then the rank coordinate sits at d2 with
+        block ``out[d2] // n`` (total-size equality makes the inner
+        extents match automatically).  This one check covers the merge
+        ([n, B, ...] -> [n*B, ...]), the split back, and every
+        rank-preserving reshape; anything else cuts rank blocks across
+        output dims and is flagged."""
+        a = abs_in[0]
+        p = eqn.params
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        dims = p.get("dimensions")
+        const = None
+        if a.const is not None:
+            try:
+                arr = a.const
+                if dims is not None:
+                    arr = np.transpose(arr, tuple(int(x) for x in dims))
+                const = _const_of(arr.reshape(out_shape))
+            except Exception:
+                const = None
+        if a.axis is None:
+            return Abs(None, const)
+        ax = a.axis
+        shape_perm = in_shape
+        if dims is not None:
+            dims = tuple(int(x) for x in dims)
+            shape_perm = tuple(in_shape[d] for d in dims)
+            ax = dims.index(ax)
+        outer = int(math.prod(shape_perm[:ax]))
+        for d2 in range(len(out_shape)):
+            if (
+                int(math.prod(out_shape[:d2])) == outer
+                and out_shape[d2] >= self.n
+                and out_shape[d2] % self.n == 0
+            ):
+                return Abs(d2, const, out_shape[d2] // self.n)
+        return self._bad(
+            eqn, path,
+            f"reshape {in_shape}->{out_shape} splits the rank axis "
+            f"(dim {a.axis}, block {a.block}) across output dims — rank "
+            "blocks are no longer separable",
+        )
+
+    def _conv(self, eqn, abs_in, path) -> Abs:
+        """`conv_general_dilated`: rank-pointwise in exactly three
+        shapes, proven via `dimension_numbers` —
+
+        * per-rank batch: lhs carries rank at the lhs BATCH dim, the
+          filters are rank-invariant; the window sweep never touches
+          the batch dim, so the output batch dim inherits the rank.
+        * per-rank filters: rhs carries rank at the OUTPUT-FEATURE dim
+          on rank-invariant data; rank r's output channels read only
+          rank r's filters.
+        * the vmap batching rule's group-confined feature merge: rank
+          merged rank-major into the lhs FEATURE dim (and the rhs
+          output-feature dim), with `feature_group_count` divisible by
+          n_ranks — grouped convolution connects input group g only to
+          filter group g, and rank-major blocking makes rank r own
+          exactly groups [r*fgc/n, (r+1)*fgc/n), so no output channel
+          ever reads another rank's channels.
+
+        Anything else (rank in a spatial dim, a feature merge without
+        group confinement, batch_group_count tricks) is a violation."""
+        lhs, rhs = abs_in[0], abs_in[1]
+        if lhs.axis is None and rhs.axis is None:
+            return Abs(None, None)
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        lhs_spec = tuple(int(x) for x in dn.lhs_spec)  # (batch, feat, *spatial)
+        rhs_spec = tuple(int(x) for x in dn.rhs_spec)  # (out_f, in_f, *spatial)
+        out_spec = tuple(int(x) for x in dn.out_spec)  # (batch, feat, *spatial)
+        fgc = int(p.get("feature_group_count", 1))
+        bgc = int(p.get("batch_group_count", 1))
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        if bgc != 1:
+            return self._bad(
+                eqn, path,
+                "conv with batch_group_count != 1 over a rank-carrying "
+                "operand has no rank-flow rule",
+            )
+        # per-rank batch, shared filters: rank rides the batch dim
+        if rhs.axis is None and lhs.axis == lhs_spec[0]:
+            return Abs(out_spec[0], None, lhs.block)
+        if lhs.axis is not None:
+            if lhs.axis != lhs_spec[1]:
+                return self._bad(
+                    eqn, path,
+                    f"conv input carries the rank axis at dim {lhs.axis} — "
+                    "neither the batch dim nor the group-confined feature "
+                    "dim; rank data would enter the spatial window",
+                )
+            if fgc % self.n != 0:
+                return self._bad(
+                    eqn, path,
+                    "conv contracts the rank axis across feature groups "
+                    f"(feature_group_count {fgc} not divisible by n_ranks "
+                    f"{self.n}) — every output channel reads every rank's "
+                    "channels",
+                )
+        if rhs.axis is not None and rhs.axis != rhs_spec[0]:
+            return self._bad(
+                eqn, path,
+                f"conv filters carry the rank axis at dim {rhs.axis}, not "
+                "the output-feature dim — rank blocks would contract "
+                "together",
+            )
+        out_feat = out_shape[out_spec[1]]
+        if out_feat % self.n != 0:
+            return self._bad(
+                eqn, path,
+                f"conv output feature dim {out_feat} does not split into "
+                f"{self.n} rank blocks",
+            )
+        return Abs(out_spec[1], None, out_feat // self.n)
+
+    def _window(self, eqn, abs_in, path, n_out) -> List[Abs]:
+        """reduce_window family + select_and_scatter_add (pooling fwd
+        and bwd): rank-pointwise iff the window sweep leaves the rank
+        dim untouched — unit window, unit stride, no padding, no
+        dilation on that dim."""
+        p = eqn.params
+        d, blk, ok = self._common_rank(eqn, path, abs_in)
+        if not ok:
+            return [Abs(None, None)] * n_out
+        if d is None:
+            return [Abs(None, None)] * n_out
+        win = tuple(int(x) for x in p["window_dimensions"])
+        strides = tuple(int(x) for x in p["window_strides"])
+        pads = tuple(tuple(int(x) for x in q) for q in p["padding"])
+        base_dil = p.get("base_dilation")
+        win_dil = p.get("window_dilation")
+        problems = (
+            len(win) <= d
+            or win[d] != 1
+            or strides[d] != 1
+            or pads[d] != (0, 0)
+            or (base_dil is not None and int(base_dil[d]) != 1)
+            or (win_dil is not None and int(win_dil[d]) != 1)
+        )
+        if problems:
+            return [self._bad(
+                eqn, path,
+                f"{eqn.primitive.name} window touches the rank dim {d} "
+                f"(window {win}, strides {strides}) — values would mix "
+                "across ranks",
+            )] * n_out
+        return [Abs(d, None, blk)] * n_out
+
+    def _pallas(self, eqn, abs_in, path, n_out) -> List[Abs]:
+        """`pallas_call` is an opaque boundary: legal ONLY under a
+        declared rank-dim signature (analysis/kernels.py).  Unknown
+        kernels are violations even on rank-invariant operands —
+        registration is the reviewed claim that the kernel body never
+        indexes across the lifted grid dim."""
+        p = eqn.params
+        nsi = p.get("name_and_src_info")
+        traced = getattr(nsi, "name", None) or p.get("name") or "<unnamed>"
+        sig = kernels.lookup(str(traced))
+        if sig is None:
+            return [self._bad(
+                eqn, path,
+                f"unregistered pallas kernel "
+                f"'{kernels.base_name(str(traced))}' — an opaque kernel is "
+                "legal only with a declared rank-dim signature "
+                "(analysis/kernels.py; docs/ANALYSIS.md 'Registering a "
+                "kernel')",
+            )] * n_out
+        ranked = [a for a in abs_in if a.axis is not None]
+        if not ranked:
+            return [Abs(None, None)] * n_out
+        for a in ranked:
+            if a.axis != sig.lifted_dim or a.block != 1:
+                return [self._bad(
+                    eqn, path,
+                    f"pallas kernel '{sig.name}' operand carries the rank "
+                    f"axis at dim {a.axis} (block {a.block}); the declared "
+                    f"signature lifts at dim {sig.lifted_dim}",
+                )] * n_out
+        outs = []
+        for ov in eqn.outvars:
+            shape = tuple(ov.aval.shape)
+            if len(shape) <= sig.lifted_dim or shape[sig.lifted_dim] != self.n:
+                return [self._bad(
+                    eqn, path,
+                    f"pallas kernel '{sig.name}' output shape {shape} does "
+                    f"not carry the rank axis at declared dim "
+                    f"{sig.lifted_dim}",
+                )] * n_out
+            outs.append(Abs(sig.lifted_dim, None))
+        return outs
 
     def _gather(self, eqn, abs_in, path) -> Abs:
         op, idx = abs_in[0], abs_in[1]
@@ -697,6 +947,8 @@ class _Flow:
         scatter_op_dims = tuple(
             int(x) for x in dn.scatter_dims_to_operand_dims
         )
+        update_window_dims = tuple(int(x) for x in dn.update_window_dims)
+        inserted = tuple(int(x) for x in dn.inserted_window_dims)
         if op.axis is None and idx.axis is None and upd.axis is None:
             return Abs(None, None)
         if op.axis is not None and op.axis in scatter_op_dims:
@@ -725,6 +977,29 @@ class _Flow:
             # rank-invariant updates written identically into every
             # rank's slice of a pass-through rank dim
             return Abs(op.axis, None)
+        if (
+            idx.axis is None
+            and upd.axis is not None and upd.axis in update_window_dims
+            and (op.axis is None or op.axis not in op_batch)
+        ):
+            # the position-embedding-gradient shape: rank rides a WINDOW
+            # dim.  Window dims map, in order, to the operand dims that
+            # are neither inserted nor operand-batching; when the
+            # update's rank dim maps to the operand's rank dim (or the
+            # operand is a rank-invariant zeros base), every scatter
+            # write stays inside its own rank's slice — the indices
+            # (rank-invariant) choose positions along OTHER dims only
+            op_ndim = len(eqn.invars[0].aval.shape)
+            window_to_op = [
+                q for q in range(op_ndim)
+                if q not in inserted and q not in op_batch
+            ]
+            mapped = window_to_op[update_window_dims.index(upd.axis)]
+            if (
+                mapped not in scatter_op_dims
+                and op.axis in (None, mapped)
+            ):
+                return Abs(mapped, None)
         return self._bad(
             eqn, path, "scatter mixes ranked and unranked operands in a "
             "shape the rules cannot prove rank-pointwise",
@@ -789,7 +1064,9 @@ class _Flow:
                     "step would see one rank's data with carried state "
                     "across ranks",
                 )] * len(eqn.outvars)
-            xs_body.append(Abs(None if a.axis is None else a.axis - 1, None))
+            xs_body.append(Abs(
+                None if a.axis is None else a.axis - 1, None, a.block
+            ))
         carry_abs = list(carries)
         body = p["jaxpr"]  # ClosedJaxpr
         mark = self._mark()
@@ -800,11 +1077,15 @@ class _Flow:
             outs = self.run(
                 body, list(consts) + carry_abs + xs_body, path + ("scan",)
             )
-            new_carry = [Abs(a.axis, None) for a in outs[:ncar]]
-            if [a.axis for a in new_carry] == [a.axis for a in carry_abs]:
+            new_carry = [Abs(a.axis, None, a.block) for a in outs[:ncar]]
+            if (
+                [(a.axis, a.block) for a in new_carry]
+                == [(a.axis, a.block) for a in carry_abs]
+            ):
                 break
             carry_abs = [
-                Abs(o.axis if o.axis is not None else i.axis, None)
+                Abs(o.axis, None, o.block) if o.axis is not None
+                else Abs(i.axis, None, i.block)
                 for i, o in zip(carry_abs, new_carry)
             ]
         else:
@@ -812,10 +1093,10 @@ class _Flow:
                 eqn, path, "scan carry rank structure did not stabilize"
             )] * len(eqn.outvars)
         ys = [
-            Abs(None if a.axis is None else a.axis + 1, None)
+            Abs(None if a.axis is None else a.axis + 1, None, a.block)
             for a in outs[ncar:]
         ]
-        return [Abs(a.axis, None) for a in outs[:ncar]] + ys
+        return [Abs(a.axis, None, a.block) for a in outs[:ncar]] + ys
 
     def _while(self, eqn, abs_in, path) -> List[Abs]:
         p = eqn.params
@@ -831,17 +1112,21 @@ class _Flow:
             outs = self.run(
                 p["body_jaxpr"], list(body_c) + carry, path + ("while.body",)
             )
-            if [a.axis for a in outs] == [a.axis for a in carry]:
+            if (
+                [(a.axis, a.block) for a in outs]
+                == [(a.axis, a.block) for a in carry]
+            ):
                 break
             carry = [
-                Abs(o.axis if o.axis is not None else i.axis, None)
+                Abs(o.axis, None, o.block) if o.axis is not None
+                else Abs(i.axis, None, i.block)
                 for i, o in zip(carry, outs)
             ]
         else:
             return [self._bad(
                 eqn, path, "while carry rank structure did not stabilize"
             )] * len(eqn.outvars)
-        return [Abs(a.axis, None) for a in carry]
+        return [Abs(a.axis, None, a.block) for a in carry]
 
     def _cond(self, eqn, abs_in, path) -> List[Abs]:
         pred, ops = abs_in[0], abs_in[1:]
@@ -877,14 +1162,20 @@ class _Flow:
             ))
         outs = []
         for k in range(len(eqn.outvars)):
-            axes = {b[k].axis for b in per_branch if b[k].axis is not None}
-            if len(axes) > 1:
+            layouts = {
+                (b[k].axis, b[k].block)
+                for b in per_branch if b[k].axis is not None
+            }
+            if len(layouts) > 1:
                 outs.append(self._bad(
                     eqn, path,
                     f"cond branches disagree on output {k}'s rank axis",
                 ))
+            elif layouts:
+                d, blk = next(iter(layouts))
+                outs.append(Abs(d, None, blk))
             else:
-                outs.append(Abs(next(iter(axes)) if axes else None, None))
+                outs.append(Abs(None, None))
         return outs
 
 

@@ -17,8 +17,13 @@ import jax
 
 def sub_jaxprs(eqn) -> Iterator["jax.core.Jaxpr"]:
     """Every jaxpr nested in an equation's params (pjit's `jaxpr`,
-    scan/while/cond bodies, custom_jvp/vjp call jaxprs, ...), as bare
-    `jax.core.Jaxpr` objects."""
+    scan/while/cond bodies, custom_jvp/vjp call jaxprs, pallas_call
+    KERNEL bodies — the `jaxpr` param is a bare Jaxpr, so the cost
+    model and auditor both see inside Pallas kernels; index-map jaxprs
+    buried in opaque GridMapping objects are intentionally not pytree
+    leaves and stay out), as bare `jax.core.Jaxpr` objects.
+    tests/test_audit.py::test_walker_counts_through_pallas pins the
+    pallas nesting."""
     for v in eqn.params.values():
         for sub in jax.tree.leaves(
             v,

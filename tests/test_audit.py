@@ -2,15 +2,22 @@
 rank-isolation dataflow, the clean full config matrix, and the seeded
 oracle violations — every check proven able to fire.
 
-Acceptance (ISSUE 9): zero violations across the full configuration
-matrix, the jaxpr-derived wire-byte count equal to the accounting
+Acceptance (ISSUE 9 + the ISSUE 12 full-geometry extension): zero
+violations across the full configuration matrix — including the
+PRODUCTION geometries (LeNetCifar / ResNet18 via the blocked-layout
+conv rules, the transformer full+flash via the declared-kernel
+registry) — the jaxpr-derived wire-byte count equal to the accounting
 formula AND to the executed step's `sent_bytes_wire_real` metric
-EXACTLY (masked and compact wires), and each seeded violation class
-(rank coupling, byte-formula drift, host sync, dtype promotion, extra
-ravel) detected.  tools/audit.py commits the same story as the
-schema-gated artifacts/audit_cpu.json.
+EXACTLY (masked and compact wires; in the metric's f32 carrier), and
+each seeded violation class (rank coupling, byte-formula drift, host
+sync, dtype promotion, extra ravel, conv rank-merge, unregistered
+kernel, attention cross-rank gather) detected.  Heavy cells (ResNet18,
+flash interpret) carry the `slow` mark; the fast conv smoke keeps the
+rankflow conv rules in tier-1.  tools/audit.py commits the same story
+as the schema-gated artifacts/audit_cpu.json.
 """
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import pytest
@@ -18,7 +25,7 @@ import pytest
 from _spmd import requires_shard_map
 from jax import lax
 
-from eventgrad_tpu.analysis import audit, rankflow, walker
+from eventgrad_tpu.analysis import audit, kernels, rankflow, walker
 from eventgrad_tpu.parallel.spmd import spmd
 from eventgrad_tpu.parallel.topology import Ring
 
@@ -58,6 +65,38 @@ def test_walker_counts_through_nesting():
     assert any("cond" in p for p in paths)
     census = walker.primitive_census(jx.jaxpr)
     assert census["concatenate"] == 4
+
+
+def test_walker_counts_through_pallas():
+    """The walker descends into pallas_call KERNEL bodies (the `jaxpr`
+    param is a bare Jaxpr): primitives inside the kernel are visible to
+    the same traversal the cost model and auditor share — an op moved
+    into a Pallas body cannot silently drop out of any census."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = jnp.tanh(x_ref[...]) * 2.0 + jnp.sin(x_ref[...])
+
+    def f(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True,
+        )(x)
+
+    jx = jax.make_jaxpr(f)(jnp.ones((8, 128), jnp.float32))
+    outer = [e.primitive.name for e in jx.jaxpr.eqns]
+    assert "pallas_call" in outer
+    assert "tanh" not in outer  # the body op is one level DOWN...
+    assert walker.count_primitives(jx.jaxpr, "tanh") == 1  # ...and seen
+    assert walker.count_primitives(jx.jaxpr, "sin") == 1
+    paths = {
+        p for eqn, p in walker.iter_eqns(jx.jaxpr)
+        if eqn.primitive.name == "tanh"
+    }
+    assert any("pallas_call" in p for p in paths)
+    census = walker.primitive_census(jx.jaxpr)
+    assert census["tanh"] == 1 and census["sin"] == 1
 
 
 def test_walker_full_ravel_counts_trailing_dim():
@@ -208,14 +247,263 @@ def test_rankflow_flags_scan_over_ranks():
                for v in rep.violations)
 
 
+# --- conv / window / blocked-layout rules (ISSUE 12) ------------------------
+
+
+def _conv_ranked(x, w, fgc=None, dn=("NHWC", "HWIO", "NHWC")):
+    return lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=dn,
+        feature_group_count=fgc or 1,
+    )
+
+
+def test_rankflow_conv_vmap_batching_clean():
+    """The full conv sandwich the vmap batching rule emits — rank-major
+    feature merge, grouped conv with fgc *= n, split back — tracks
+    clean through fwd AND bwd (the dW/dx transposed convs), with
+    pooling's reduce_window/select_and_scatter_add along for the ride."""
+
+    def per_rank(w, x):
+        y = _conv_ranked(x, w)
+        y = nn.max_pool(y, (2, 2), strides=(2, 2))
+        return jnp.sum(y ** 2)
+
+    w = jnp.zeros((audit.N_RANKS, 3, 3, 3, 6))
+    x = jnp.zeros((audit.N_RANKS, 2, 8, 8, 3))
+    jx = jax.make_jaxpr(
+        jax.vmap(jax.grad(per_rank), axis_name="ring")
+    )(w, x)
+    rep = rankflow.analyze(jx, audit.N_RANKS)
+    assert rep.violations == [], [
+        (v.prim, v.reason) for v in rep.violations
+    ]
+
+
+def test_rankflow_conv_rank_merge_without_groups_flagged():
+    """The rank-major merge is only legal UNDER group confinement: the
+    same merged layout convolved with feature_group_count=1 contracts
+    every rank's channels into every output channel — flagged at the
+    conv, not laundered through the legal-looking reshape."""
+    n = audit.N_RANKS
+
+    def leak(x):  # stacked [n, B, H, W, C]
+        merged = jnp.transpose(x, (1, 2, 3, 0, 4)).reshape(
+            x.shape[1], x.shape[2], x.shape[3], n * x.shape[4]
+        )
+        kern = jnp.ones((3, 3, n * x.shape[4], 2), x.dtype)
+        return jnp.sum(_conv_ranked(merged, kern))
+
+    jx = jax.make_jaxpr(leak)(jnp.zeros((n, 2, 8, 8, 3)))
+    rep = rankflow.analyze(jx, n)
+    assert any(
+        v.prim == "conv_general_dilated"
+        and "feature groups" in v.reason
+        for v in rep.violations
+    ), [(v.prim, v.reason) for v in rep.violations]
+
+
+def test_rankflow_reshape_merge_split_roundtrip():
+    """A rank-major merge is tracked as a BLOCKED layout and a split
+    recovers the pure axis; splitting the rank axis itself is flagged."""
+    n = audit.N_RANKS
+    x = jnp.ones((n, 3, 5))
+
+    # merge [n,3,5] -> [n*3,5] (blocked) -> split back -> reduce: clean
+    def roundtrip(v):
+        merged = v.reshape(n * 3, 5)
+        back = merged.reshape(n, 3, 5)
+        return jnp.sum(back, axis=(1, 2))
+
+    rep = rankflow.analyze(jax.make_jaxpr(roundtrip)(x), n)
+    assert rep.violations == []
+
+    # reducing over the MERGED dim crosses ranks: flagged
+    def bad_reduce(v):
+        return jnp.sum(v.reshape(n * 3, 5), axis=0)
+
+    rep2 = rankflow.analyze(jax.make_jaxpr(bad_reduce)(x), n)
+    assert any("rank axis" in v.reason for v in rep2.violations)
+
+    # splitting the rank axis across dims ([n,...] -> [2, n//2, ...])
+    def bad_split(v):
+        return v.reshape(2, n // 2, 3, 5)
+
+    rep3 = rankflow.analyze(jax.make_jaxpr(bad_split)(x), n)
+    assert any("splits the rank axis" in v.reason for v in rep3.violations)
+
+
+def test_rankflow_window_touching_rank_dim_flagged():
+    """A pooling window that sweeps ACROSS the rank dim mixes ranks."""
+    n = audit.N_RANKS
+
+    def bad(v):  # stacked [n, 8]: window of 2 over the rank dim
+        return lax.reduce_window(
+            v, -jnp.inf, lax.max, (2, 1), (1, 1), "VALID"
+        )
+
+    rep = rankflow.analyze(jax.make_jaxpr(bad)(jnp.ones((n, 8))), n)
+    assert any(
+        "window touches the rank dim" in v.reason for v in rep.violations
+    )
+
+
+def test_rankflow_embed_scatter_window_case_clean():
+    """The position-embedding-gradient scatter (rank-invariant indices,
+    rank riding a window dim of a zeros base) is rank-pointwise — and
+    the token-embedding batched gather/scatter too."""
+    n = audit.N_RANKS
+
+    def per_rank(table, pos_table, toks, g):
+        emb = table[toks] + pos_table[jnp.arange(toks.shape[-1])]
+        return jnp.sum(emb * g)
+
+    tab = jnp.zeros((n, 16, 4))
+    pos = jnp.zeros((n, 8, 4))
+    toks = jnp.zeros((n, 3, 8), jnp.int32)
+    g = jnp.zeros((n, 3, 8, 4))
+    jx = jax.make_jaxpr(
+        jax.vmap(jax.grad(per_rank, argnums=(0, 1)), axis_name="ring")
+    )(tab, pos, toks, g)
+    rep = rankflow.analyze(jx, n)
+    assert rep.violations == [], [
+        (v.prim, v.reason) for v in rep.violations
+    ]
+
+
+# --- the declared-kernel registry -------------------------------------------
+
+
+def test_rankflow_registered_kernel_clean_unregistered_flagged():
+    """A pallas_call passes ONLY under a declared signature: the flash
+    kernel (registered) audits clean; the same call under an unknown
+    kernel name is a violation; a registered kernel whose operand
+    carries the rank axis at the wrong dim is a violation too."""
+    from jax.experimental import pallas as pl
+
+    n = audit.N_RANKS
+
+    def _fwd_kernel(x_ref, o_ref):  # shadows the registered flash name
+        o_ref[...] = x_ref[...] * 2.0
+
+    def _rogue_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def call(kernel, v):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True,
+        )(v)
+
+    x = jnp.ones((n, 8, 128))
+    lifted_ok = jax.vmap(lambda v: call(_fwd_kernel, v), axis_name="ring")
+    rep = rankflow.analyze(jax.make_jaxpr(lifted_ok)(x), n)
+    assert rep.violations == [], [v.reason for v in rep.violations]
+
+    lifted_bad = jax.vmap(lambda v: call(_rogue_kernel, v), axis_name="ring")
+    rep2 = rankflow.analyze(jax.make_jaxpr(lifted_bad)(x), n)
+    assert any(
+        "unregistered pallas kernel '_rogue_kernel'" in v.reason
+        for v in rep2.violations
+    )
+
+    # registered name, rank axis at the WRONG dim (not the lifted dim)
+    def wrong_dim(v):  # rank axis declared at dim 1 via in_axes
+        return pl.pallas_call(
+            _fwd_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, n), jnp.float32),
+            interpret=True,
+        )(v)
+
+    rep3 = rankflow.analyze(
+        jax.make_jaxpr(wrong_dim)(jnp.ones((8, n))), n, in_axes=[1]
+    )
+    assert any(
+        "declared signature lifts at dim" in v.reason
+        for v in rep3.violations
+    )
+
+
+def test_kernel_registry_entries_match_sources():
+    """Every registry entry names a real module, and the traced-name
+    normalization strips vmap's `_batched` suffixes."""
+    import os
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name, sig in kernels.REGISTRY.items():
+        assert os.path.exists(os.path.join(repo, sig.module)), sig
+        assert sig.reviewed, f"{name}: a registration must say WHY"
+        with open(os.path.join(repo, sig.module)) as f:
+            assert re.search(rf"def {re.escape(name)}\(", f.read()), (
+                f"registered kernel {name} not defined in {sig.module}"
+            )
+    assert kernels.lookup("_fwd_kernel_batched") is not None
+    assert kernels.lookup("_fwd_kernel_batched_batched") is not None
+    assert kernels.lookup("_nope") is None
+    assert kernels.base_name("_dq_kernel_batched") == "_dq_kernel"
+
+
+# --- the fast tier-1 conv smoke ---------------------------------------------
+
+
+def test_conv_audit_smoke():
+    """ISSUE 12 tier-1 smoke: a tiny conv net (conv-pool-conv-dense at
+    12x12) through the FULL lifted train-step audit machinery — so a
+    rankflow conv-rule regression fails here in seconds, not only in
+    the slow full-matrix tools/audit.py run."""
+    import optax
+
+    from eventgrad_tpu.parallel.events import EventConfig
+    from eventgrad_tpu.train.state import init_train_state
+    from eventgrad_tpu.train.steps import make_train_step
+
+    class TinyConv(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), padding="VALID")(x)
+            x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+            x = nn.Conv(8, (3, 3), padding="VALID")(x)
+            x = nn.relu(x)
+            x = x.reshape((x.shape[0], -1))
+            return nn.log_softmax(nn.Dense(10)(x), axis=-1)
+
+    topo = Ring(audit.N_RANKS)
+    model = TinyConv()
+    tx = optax.sgd(0.05)
+    cfg = EventConfig(adaptive=True, horizon=0.95, warmup_passes=2)
+    state = init_train_state(
+        model, (12, 12, 1), tx, topo, "eventgrad", cfg, seed=0
+    )
+    step = make_train_step(model, tx, topo, "eventgrad", event_cfg=cfg)
+    x = jnp.zeros((audit.N_RANKS, 2, 12, 12, 1))
+    y = jnp.zeros((audit.N_RANKS, 2), jnp.int32)
+    closed = jax.make_jaxpr(spmd(step, topo))(state, (x, y))
+    rep = rankflow.analyze(closed, audit.N_RANKS)
+    assert rep.violations == [], [
+        (v.prim, v.reason) for v in rep.violations
+    ]
+    assert rep.exchange_offsets() == [-1, 1]
+    # ... and the conv oracle fires (one conv cell + one oracle)
+    detected, reason = audit.ORACLES["conv_rank_merge"]()
+    assert detected, reason
+
+
 # --- the clean matrix -------------------------------------------------------
 
 
-@pytest.mark.parametrize("name", [c.name for c in audit.CONFIGS])
+@pytest.mark.parametrize("name", [
+    pytest.param(c.name, marks=pytest.mark.slow) if c.heavy else c.name
+    for c in audit.CONFIGS
+])
 def test_audit_matrix_config_clean(name):
     """Every cell: zero rank-isolation violations, declared offsets
     only, wire bytes derived == formula == executed metric EXACTLY,
-    ravel budget, no callbacks, donation aliasing where checked."""
+    ravel budget, no callbacks, donation aliasing where checked.
+    Heavy cells (ResNet18, flash interpret) ride the slow mark — the
+    full matrix runs in tools/audit.py; the fast cells (incl. the
+    LeNetCifar conv and full-attention transformer geometries) keep
+    rankflow's production rules in tier-1."""
     r = audit.audit_config(audit.config_by_name(name), run_metric=True)
     assert r["violations"] == 0, r["violation_details"]
     assert r["undeclared_offsets"] == [] and r["missing_offsets"] == []
@@ -286,6 +574,22 @@ def test_audit_shard_lift_clean():
     if len(jax.devices()) < audit.N_RANKS:
         pytest.skip(f"needs {audit.N_RANKS} devices")
     r = audit.audit_shard_lift(audit.config_by_name("event_masked_f32_tree"))
+    assert r["offsets_ok"], (r["exchange_offsets"], r["declared_offsets"])
+    assert r["undeclared_collectives"] == []
+    assert r["callbacks"] == 0
+
+
+@requires_shard_map
+def test_audit_shard_lift_conv_clean():
+    """The same real-mesh question at CONV geometry (ISSUE 12): the
+    LeNetCifar cell's shard_map lift keeps its collectives declared —
+    conv batching rewrites are a vmap artifact, so the mesh program
+    must show nothing but the ring ppermutes."""
+    if len(jax.devices()) < audit.N_RANKS:
+        pytest.skip(f"needs {audit.N_RANKS} devices")
+    r = audit.audit_shard_lift(
+        audit.config_by_name("lenet_masked_f32_arena")
+    )
     assert r["offsets_ok"], (r["exchange_offsets"], r["declared_offsets"])
     assert r["undeclared_collectives"] == []
     assert r["callbacks"] == 0

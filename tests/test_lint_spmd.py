@@ -83,6 +83,15 @@ def test_wall_clock_confined():
     assert not offenders, _fmt(offenders)
 
 
+def test_pallas_kernels_registered():
+    """Every pallas_call site in the package references a kernel with a
+    declared rank-dim signature (analysis/kernels.py), and no registry
+    entry has gone stale (one named exemption: the auditor's seeded
+    oracle source)."""
+    offenders = _run_rule(lint.PallasKernelRegistered())
+    assert not offenders, _fmt(offenders)
+
+
 def test_full_lint_run_clean():
     """The aggregate entry point tools/audit.py pins in the artifact."""
     violations = lint.run(root=REPO)
@@ -168,6 +177,71 @@ def test_wall_clock_rule_fires_on_seeded_violations():
     live = _pkg_file(rel, "import time\n\nNOW = time.time()\n")
     assert _run_rule(lint.WallClockConfined(), [stale])
     assert not _run_rule(lint.WallClockConfined(), [live])
+
+
+def test_pallas_rule_fires_on_seeded_violations():
+    """The declared-kernel lint detects an unregistered kernel, an
+    unresolvable kernel argument, a kernel registered for a DIFFERENT
+    module, and a stale registry entry — while the real call sites
+    (functools.partial / conditional kernels included) stay clean."""
+    sep = os.sep
+    rule = lint.PallasKernelRegistered()
+    bad_unreg = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}bad10.py",
+        "import jax.experimental.pallas as pl\n"
+        "def _mystery_kernel(x_ref, o_ref):\n    o_ref[...] = x_ref[...]\n"
+        "out = pl.pallas_call(_mystery_kernel, out_shape=None)(1)\n",
+    )
+    viols = rule.check([bad_unreg])
+    assert any("_mystery_kernel" in v.message for v in viols), _fmt(viols)
+    bad_opaque = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}bad11.py",
+        "import jax.experimental.pallas as pl\n"
+        "KERNELS = {}\n"
+        "out = pl.pallas_call(KERNELS['k'], out_shape=None)(1)\n",
+    )
+    viols = rule.check([bad_opaque])
+    assert any("not statically resolvable" in v.message for v in viols)
+    # keyword-form calls cannot dodge the rule either
+    bad_kw = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}bad11b.py",
+        "import jax.experimental.pallas as pl\n"
+        "def _mystery_kernel(x_ref, o_ref):\n    o_ref[...] = x_ref[...]\n"
+        "out = pl.pallas_call(kernel=_mystery_kernel, out_shape=None)(1)\n",
+    )
+    viols = rule.check([bad_kw])
+    assert any("not statically resolvable" in v.message for v in viols)
+    # a registered kernel name called from the WRONG module
+    bad_module = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}bad12.py",
+        "import jax.experimental.pallas as pl\n"
+        "def _fwd_kernel(x_ref, o_ref):\n    o_ref[...] = x_ref[...]\n"
+        "out = pl.pallas_call(_fwd_kernel, out_shape=None)(1)\n",
+    )
+    viols = rule.check([bad_module])
+    assert any("registered for" in v.message for v in viols), _fmt(viols)
+    # a registry module that stopped calling its kernel = stale entry
+    stale = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}fused_update.py", "X = 1\n"
+    )
+    viols = rule.check([stale])
+    assert any("gone stale" in v.message for v in viols), _fmt(viols)
+    # partial(...) and conditional kernels resolve (the shipped idioms)
+    ok_partial = _pkg_file(
+        f"eventgrad_tpu{sep}ops{sep}fused_update.py",
+        "import functools\nimport jax.experimental.pallas as pl\n"
+        "def _kernel(*refs, lr): pass\n"
+        "out = pl.pallas_call(functools.partial(_kernel, lr=0.1),\n"
+        "                     out_shape=None)(1)\n",
+    )
+    assert not rule.check([ok_partial]), _fmt(rule.check([ok_partial]))
+    # the exemption stays honest: audit.py without a seeded
+    # unregistered kernel flags as stale
+    stale_exempt = _pkg_file(
+        f"eventgrad_tpu{sep}analysis{sep}audit.py", "X = 1\n"
+    )
+    viols = rule.check([stale_exempt])
+    assert any("drop it from" in v.message for v in viols), _fmt(viols)
 
 
 def test_exempt_file_exemption_stays_honest():

@@ -3,16 +3,21 @@
 Walks the full configuration matrix of the fused train step
 (eventgrad_tpu/analysis/audit.py: dpsgd/eventgrad/sp_eventgrad x
 masked|compact x arena on/off x obs/chaos/integrity on/off x wire
-dtypes x the bucketed gossip schedule at K=4), proving per cell: rank
-isolation (the only cross-rank flow is the declared neighbor
-exchange), wire-byte truth (jaxpr-derived bytes == accounting formula
-== the executed step's `sent_bytes_wire_real`, exactly — summed over
-buckets on the bucketed cells, whose offsets must carry K declared
-lane groups), and step hygiene (no host callbacks, ravel budget, wire
-dtype fidelity, donation aliasing).  Then fires every seeded ORACLE
-violation to prove each check can detect its failure class (including
-a bucket lane re-shipped at an undeclared offset), and runs the AST
-lint rules (analysis/lint.py) over the repo.
+dtypes x the bucketed gossip schedule at K=4 — ON THE PRODUCTION
+GEOMETRIES: LeNetCifar and ResNet18 (conv rank-major merges tracked as
+blocked layouts), a small transformer full+flash (Pallas kernels via
+the declared-kernel registry, analysis/kernels.py), alongside the MLP
+regression base), proving per cell: rank isolation (the only
+cross-rank flow is the declared neighbor exchange), wire-byte truth
+(jaxpr-derived bytes == accounting formula == the executed step's
+`sent_bytes_wire_real`, exactly in the metric's f32 carrier — summed
+over buckets on the bucketed cells, whose offsets must carry K
+declared lane groups), and step hygiene (no host callbacks, ravel
+budget, wire dtype fidelity, donation aliasing).  Then fires every
+seeded ORACLE violation to prove each check can detect its failure
+class (including a conv rank-merge without group confinement, an
+unregistered pallas kernel, and a data-dependent cross-rank attention
+gather), and runs the AST lint rules (analysis/lint.py) over the repo.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/audit.py [--out artifacts/audit_cpu.json]
@@ -68,7 +73,7 @@ def main(argv=None) -> int:
     if args.census:
         for cfg in audit.CONFIGS:
             state, step, topo = audit.build(cfg)
-            closed = jax.make_jaxpr(spmd(step, topo))(state, audit._batch())
+            closed = jax.make_jaxpr(spmd(step, topo))(state, audit._batch(cfg))
             print(cfg.name, json.dumps(
                 walker.primitive_census(closed.jaxpr), sort_keys=True
             ))
@@ -87,11 +92,13 @@ def main(argv=None) -> int:
         "bench": "audit",
         "platform": jax.default_backend(),
         "op_point": (
-            f"MLP(hidden={audit.MODEL['hidden']}) Ring({audit.N_RANKS}) "
-            f"compact_capacity={audit.CAPACITY}"
+            f"Ring({audit.N_RANKS}) geometries "
+            + "+".join(sorted({c.model for c in audit.CONFIGS}))
+            + f" mlp_capacity={audit.CAPACITY}"
         ),
         "n_configs": len(configs),
         "n_clean": n_clean,
+        "models": sorted({r["model"] for r in configs}),
         "configs": [
             {k: v for k, v in r.items() if k != "violation_details"}
             | {"clean": audit.clean(r)}

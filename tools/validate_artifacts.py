@@ -488,7 +488,7 @@ CRASH_MATRIX_SCHEMA = {
 _AUDIT_CELL = {
     "type": "object",
     "required": [
-        "name", "algo", "clean", "violations", "wire_match",
+        "name", "algo", "model", "clean", "violations", "wire_match",
         "metric_match", "ravel_ok", "callbacks",
         "wire_bytes_per_neighbor_derived",
         "wire_bytes_per_neighbor_formula",
@@ -496,10 +496,18 @@ _AUDIT_CELL = {
     "properties": {
         "name": {"type": "string"},
         "algo": {"enum": ["dpsgd", "eventgrad", "sp_eventgrad"]},
+        # ISSUE 12: every cell names its audit geometry — the MLP
+        # regression base or one of the PRODUCTION models the headline
+        # numbers ship on (conv nets via rankflow's blocked-layout conv
+        # rules, the transformer incl. flash via the declared-kernel
+        # registry)
+        "model": {"enum": ["mlp", "lenet", "resnet18", "transformer"]},
+        "attn": {"enum": ["full", "flash"]},
         # every committed cell is CLEAN: zero rank-isolation
         # violations, the jaxpr-derived wire bytes equal the accounting
         # formula AND the executed step's sent_bytes_wire_real metric
-        # exactly, the ravel budget holds, no host callbacks
+        # exactly (in the metric's f32 carrier), the ravel budget
+        # holds, no host callbacks
         "clean": {"enum": [True]},
         "violations": {"enum": [0]},
         "wire_match": {"enum": [True]},
@@ -515,27 +523,32 @@ AUDIT_SCHEMA = {
     "type": "object",
     "required": [
         "bench", "platform", "op_point", "n_configs", "n_clean",
-        "configs", "n_oracles", "n_detected", "oracles",
+        "configs", "models", "n_oracles", "n_detected", "oracles",
         "lint_violations", "wall_s",
     ],
     "properties": {
         "bench": {"enum": ["audit"]},
         "platform": {"type": "string"},
-        # the trace-auditor acceptance gates (ISSUE 9): the FULL config
-        # matrix (>= 10 cells covering dpsgd/eventgrad/sp x
-        # masked|compact x arena x obs/chaos/integrity) reports ZERO
-        # violations with exact wire-byte truth, EVERY seeded oracle
-        # violation (rank coupling, dtype upcast, extra ravel, byte-
-        # formula drift, host callback) is flagged, and the AST lint
-        # rules pass repo-wide
-        "n_configs": {"type": "integer", "minimum": 10},
-        "n_clean": {"type": "integer", "minimum": 10},
-        "configs": {"type": "array", "minItems": 10, "items": _AUDIT_CELL},
-        "n_oracles": {"type": "integer", "minimum": 5},
-        "n_detected": {"type": "integer", "minimum": 5},
+        # the trace-auditor acceptance gates (ISSUE 9 + the ISSUE 12
+        # full-geometry extension): the FULL config matrix (>= 18 cells
+        # covering dpsgd/eventgrad/sp x masked|compact x arena x
+        # obs/chaos/integrity x bucketed, ON the production geometries
+        # — LeNetCifar, ResNet18, transformer full+flash — alongside
+        # the MLP base) reports ZERO violations with exact wire-byte
+        # truth, EVERY seeded oracle violation (rank coupling, dtype
+        # upcast, extra ravel, byte-formula drift, host callback, conv
+        # rank-merge, unregistered kernel, attention cross-rank gather)
+        # is flagged, and the AST lint rules pass repo-wide
+        "n_configs": {"type": "integer", "minimum": 18},
+        "n_clean": {"type": "integer", "minimum": 18},
+        "configs": {"type": "array", "minItems": 18, "items": _AUDIT_CELL},
+        # the distinct audit geometries the matrix covered: all four
+        "models": {"type": "array", "minItems": 4},
+        "n_oracles": {"type": "integer", "minimum": 8},
+        "n_detected": {"type": "integer", "minimum": 8},
         "oracles": {
             "type": "array",
-            "minItems": 5,
+            "minItems": 8,
             "items": {
                 "type": "object",
                 "required": ["name", "detected"],
