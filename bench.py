@@ -313,6 +313,12 @@ def main() -> None:
     # turns it on, 0 (default) keeps the monolithic schedule. Training
     # is bitwise-identical either way (tests/test_bucketed.py).
     bench_bucketed = int(os.environ.get("EG_BENCH_BUCKETED", "0")) or None
+    # SPMD lift: vmap (single-chip simulator, the historical default) vs
+    # shard_map (real device mesh — one rank per device, the exchange is
+    # actual ppermute collectives; docs/ARCHITECTURE.md "Mesh backends").
+    # EG_BENCH_BACKEND=shard_map|auto runs the mesh; records carry the
+    # backend so the perf ledger never gates mesh rows against vmap rows.
+    bench_backend = os.environ.get("EG_BENCH_BACKEND", "vmap")
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
@@ -320,6 +326,7 @@ def main() -> None:
         epochs_per_dispatch=k_disp,
         arena=bench_arena,
         pipeline=bench_pipeline,
+        backend=bench_backend,
     )
 
     # host span trace of the bench's own phases (obs.Registry): always
@@ -392,6 +399,7 @@ def main() -> None:
             epochs=mnist_epochs, batch_size=mnist_batch,
             learning_rate=0.05, random_sampler=False, log_every_epoch=False,
             epochs_per_dispatch=k_disp, registry=obs_reg,
+            backend=bench_backend,
         )
     mnist_saved = hist_m[-1]["msgs_saved_pct"]
 
@@ -646,6 +654,10 @@ def main() -> None:
                 # step_overhead_ratio acceptance metric is arena-on;
                 # EG_BENCH_ARENA=0 gives the legacy-tree comparison)
                 "arena": bench_arena,
+                # the SPMD lift that produced these numbers (vmap sim vs
+                # shard_map device mesh) — resolved from the history
+                # records, so EG_BENCH_BACKEND=auto reports what RAN
+                "backend": hist[-1].get("backend", "vmap"),
                 # every block was cold (steady_records fell back): the
                 # step timings above include compile contamination
                 "steady_contaminated": bool(
@@ -684,8 +696,9 @@ def main() -> None:
 
     # one-line perf-trajectory delta vs the committed ledger
     # (tools/perf_ledger.py) — stderr, because stdout is the result-line
-    # contract; comparability = same (platform, model, config) so a CPU
-    # smoke never reads as a regression of a chip round
+    # contract; comparability = same (platform, model, config, backend)
+    # so a CPU smoke never reads as a regression of a chip round and a
+    # shard_map mesh run never reads against a vmap one
     try:
         import sys as _sys
 
@@ -701,6 +714,7 @@ def main() -> None:
             "round": _led["n_rounds"] + 1, "source": "(this run)",
             "status": "ok", "platform": jax.devices()[0].platform,
             "model": type(model).__name__, "config": tier,
+            "backend": hist[-1].get("backend", "vmap"),
             "step_ms": round(1000 * step_s, 2),
             "mfu": (
                 mfu if mfu is not None

@@ -695,6 +695,32 @@ def collect_collectives(jaxpr, n_ranks: int) -> List[Dict[str, Any]]:
     return out
 
 
+def shard_lift_report(closed, topo, name: str) -> Dict[str, Any]:
+    """The mesh-program analysis of `audit_shard_lift`, on an
+    already-traced jaxpr: only declared-offset ppermutes (plus
+    axis_index) may appear, zero host callbacks. Split out so the
+    seeded mesh oracle (and tools/mesh_ablation.py) can point it at a
+    SABOTAGED lift."""
+    declared = sorted(nb.offset for nb in topo.neighbors)
+    colls = collect_collectives(closed.jaxpr, topo.n_ranks)
+    bad = []
+    offsets = set()
+    for rec in colls:
+        if rec["prim"] == "ppermute":
+            offsets.update(rec["offsets"])
+        elif rec["prim"] != "axis_index":
+            bad.append(rec)
+    return {
+        "name": name,
+        "collectives": colls,
+        "undeclared_collectives": bad,
+        "exchange_offsets": sorted(offsets),
+        "declared_offsets": declared,
+        "offsets_ok": offsets == set(declared),
+        "callbacks": count_callbacks(closed.jaxpr),
+    }
+
+
 def audit_shard_lift(cfg: AuditConfig) -> Dict[str, Any]:
     """Audit the real-mesh (shard_map) lift of one cell: the only
     collectives in the traced program are ppermutes at the declared
@@ -706,24 +732,16 @@ def audit_shard_lift(cfg: AuditConfig) -> Dict[str, Any]:
     mesh = build_mesh(topo)
     lifted = spmd(step, topo, mesh=mesh)
     closed = jax.make_jaxpr(lifted)(state, _batch(cfg))
-    declared = sorted(nb.offset for nb in topo.neighbors)
-    colls = collect_collectives(closed.jaxpr, topo.n_ranks)
-    bad = []
-    offsets = set()
-    for rec in colls:
-        if rec["prim"] == "ppermute":
-            offsets.update(rec["offsets"])
-        elif rec["prim"] != "axis_index":
-            bad.append(rec)
-    return {
-        "name": cfg.name,
-        "collectives": colls,
-        "undeclared_collectives": bad,
-        "exchange_offsets": sorted(offsets),
-        "declared_offsets": declared,
-        "offsets_ok": offsets == set(declared),
-        "callbacks": count_callbacks(closed.jaxpr),
-    }
+    return shard_lift_report(closed, topo, cfg.name)
+
+
+def shard_lift_clean(report: Dict[str, Any]) -> bool:
+    """Acceptance predicate for one mesh-lift report."""
+    return (
+        report["offsets_ok"]
+        and not report["undeclared_collectives"]
+        and report["callbacks"] == 0
+    )
 
 
 # --- seeded oracle violations ----------------------------------------------
@@ -1031,6 +1049,59 @@ ORACLES = {
 def run_oracles() -> List[Dict[str, Any]]:
     out = []
     for name, fn in ORACLES.items():
+        detected, reason = fn()
+        out.append({"name": name, "detected": bool(detected),
+                    "reason": reason})
+    return out
+
+
+# --- seeded MESH oracles (shard_map lift) ----------------------------------
+#
+# Kept in their own registry: they trace real-mesh programs, so they
+# need the shard_map transform plus >= N_RANKS devices — environments
+# without either still run every vmap oracle above. Exercised tier-1
+# behind `requires_shard_map` (tests/test_audit.py) and pinned in
+# artifacts/mesh_ablation_cpu.json (tools/mesh_ablation.py).
+
+
+def oracle_mesh_undeclared_offset() -> Tuple[bool, str]:
+    """An undeclared ppermute (offset +2) smuggled into the MESH
+    program's metrics: the shard_map twin of `oracle_rank_coupling` —
+    inside the mesh lift collectives stay primitives, so the auditor
+    must flag the stray offset in `shard_lift_report` directly."""
+    from eventgrad_tpu.parallel.spmd import build_mesh
+
+    cfg = config_by_name("event_masked_f32_arena_obs")
+    state, step, topo = build(cfg)
+
+    def bad(state, batch):
+        ns, m = step(state, batch)
+        m = dict(m)
+        m["leak"] = lax.ppermute(
+            m["loss"], topo.axes[0],
+            [((r + 2) % N_RANKS, r) for r in range(N_RANKS)],
+        )
+        return ns, m
+
+    mesh = build_mesh(topo)
+    lifted = spmd(bad, topo, mesh=mesh)
+    closed = jax.make_jaxpr(lifted)(state, _batch(cfg))
+    rep = shard_lift_report(closed, topo, cfg.name + "+mesh_leak")
+    detected = not rep["offsets_ok"]
+    extra = sorted(
+        set(rep["exchange_offsets"]) - set(rep["declared_offsets"])
+    )
+    return detected, f"undeclared mesh ppermute offsets {extra}"
+
+
+MESH_ORACLES = {
+    "mesh_undeclared_offset": oracle_mesh_undeclared_offset,
+}
+
+
+def run_mesh_oracles() -> List[Dict[str, Any]]:
+    out = []
+    for name, fn in MESH_ORACLES.items():
         detected, reason = fn()
         out.append({"name": name, "detected": bool(detected),
                     "reason": reason})

@@ -132,8 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="eventgrad-tpu", description=__doc__)
     p.add_argument("--algo", choices=ALGOS, default="eventgrad")
     p.add_argument("--mesh", type=parse_mesh, default="ring:4", help="ring:N or torus:XxY")
-    p.add_argument("--backend", choices=["sim", "mesh"], default="sim",
-                   help="sim = vmap all ranks onto one chip; mesh = one rank per device")
+    p.add_argument("--backend", choices=["sim", "mesh", "auto"], default="sim",
+                   help="sim = vmap all ranks onto one chip; mesh = one rank "
+                        "per device (shard_map over a real device mesh — "
+                        "collectives ride ICI/DCN); auto = mesh whenever "
+                        "shard_map and enough devices exist, else sim")
     p.add_argument("--dataset",
                    choices=["mnist", "cifar10", "digits", "digits32",
                             "synthetic", "synthetic-lm",
@@ -368,6 +371,17 @@ def main(argv=None) -> int:
         if args.backend != "mesh":
             raise SystemExit("--coordinator requires --backend mesh")
         multihost.init(args.coordinator, args.num_processes, args.process_id)
+    elif os.environ.get("EG_COORDINATOR"):
+        # env-var twin of the flags (EG_COORDINATOR / EG_NUM_PROCESSES /
+        # EG_PROCESS_ID) — lets launchers join a multi-process mesh
+        # without threading argv through every wrapper (mpirun's
+        # environment-propagation role). Same contract as the flag:
+        # exactly --backend mesh (an "auto" that quietly fell back to
+        # vmap would run N independent full-ring simulations), checked
+        # BEFORE joining the distributed runtime.
+        if args.backend != "mesh":
+            raise SystemExit("EG_COORDINATOR requires --backend mesh")
+        multihost.init_from_env()
 
     # enable() only after distributed init — resolving the backend would
     # otherwise initialize it and break jax.distributed.initialize's
@@ -616,7 +630,14 @@ def main(argv=None) -> int:
         )
     else:
         model = MODEL_REGISTRY[args.model]()
-    mesh = build_mesh(topo) if args.backend == "mesh" else None
+    if args.backend == "mesh":
+        mesh = build_mesh(topo)
+    elif args.backend == "auto":
+        from eventgrad_tpu.parallel.spmd import resolve_backend
+
+        mesh = resolve_backend("auto", topo)
+    else:
+        mesh = None
 
     event_cfg = EventConfig(
         adaptive=args.thres_mode == "adaptive",

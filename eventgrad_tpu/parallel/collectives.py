@@ -97,10 +97,37 @@ def _wire_in(x: Any, like: Any) -> Any:
     return jax.tree.map(lambda a, ref: a.astype(ref.dtype), x, like)
 
 
+def _contract_safe(scale):
+    """Truncate an f32 quantization scale to 17 significand bits (clear
+    the low 7 stored mantissa bits), making every dequantized product
+    EXACTLY representable: |q| <= 127 carries <= 7 significand bits, so
+    q * scale needs <= 7 + 17 = 24 — f32's full significand. An exact
+    product renders the receive path contraction-invariant: fma(q, s,
+    acc) and add(round(q*s), acc) round identically, so the compiled
+    mix is bitwise the same whether or not the backend fuses the
+    dequant multiply into the gossip adds — which XLA:CPU decides
+    differently for the vmap and shard_map lifts of the same step
+    (tests/test_mesh_parity.py int8 cells caught it; XLA strips
+    `optimization_barrier` on CPU, so barriers cannot pin it). Cost:
+    <= 2^-17 relative scale perturbation — float noise against int8's
+    ~2^-8 quantization error (values that now round just past +/-127
+    hit the existing clip)."""
+    bits = lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.int32
+    )
+    return lax.bitcast_convert_type(
+        bits & jnp.int32(~0x7F), jnp.float32
+    )
+
+
 def _int8_scales(tree: Any) -> Any:
-    """Per-leaf absmax/127 quantization scales (zero-safe)."""
+    """Per-leaf absmax/127 quantization scales (zero-safe,
+    contraction-safe — see `_contract_safe`)."""
     return jax.tree.map(
-        lambda a: jnp.maximum(jnp.max(jnp.abs(a)), 1e-30) / 127.0, tree
+        lambda a: _contract_safe(
+            jnp.maximum(jnp.max(jnp.abs(a)), 1e-30) / 127.0
+        ),
+        tree,
     )
 
 
@@ -200,8 +227,10 @@ def _masked_scales(absmax_vec: jnp.ndarray, fire_vec: jnp.ndarray):
     bitwise what `_int8_scales` computes on the zero-masked pytree (a
     masked leaf's absmax is the raw absmax when fired, 0 when not). ONE
     definition shared by the masked and compact paths so their wires stay
-    bit-identical."""
-    return jnp.maximum(jnp.where(fire_vec, absmax_vec, 0.0), 1e-30) / 127.0
+    bit-identical. Contraction-safe like `_int8_scales`."""
+    return _contract_safe(
+        jnp.maximum(jnp.where(fire_vec, absmax_vec, 0.0), 1e-30) / 127.0
+    )
 
 
 def _int8_encode_flat(masked_flat: jnp.ndarray, scale_vec: jnp.ndarray,
@@ -744,7 +773,10 @@ def mix(params: Any, bufs: Tuple[Any, ...], topo: Topology) -> Any:
     exactly as in the reference (event.cpp:177-179). One fused tree pass:
     per element the adds run in the same left-to-right order as the old
     per-buffer accumulation loop, so the result is bitwise-unchanged while
-    XLA sees a single traversal instead of n_neighbors+1."""
+    XLA sees a single traversal instead of n_neighbors+1. Wire-decode
+    multiplies feeding these adds (the int8 dequant) are exact products
+    by construction (`_contract_safe`), so FMA fusion cannot change a
+    bit on either SPMD lift (tests/test_mesh_parity.py)."""
     w = topo.mix_weight
 
     def leaf(p, *bs):
@@ -823,8 +855,12 @@ def neighbor_vals_flat(
     leaves = spec.treedef.flatten_up_to(payload)
     dt = spec.dtype
     if wire == "int8":
-        # bitwise _int8_scales: per-leaf absmax/127, zero-safe
-        scale_vec = jnp.maximum(_leaf_absmax(leaves), 1e-30) / 127.0
+        # bitwise _int8_scales: per-leaf absmax/127, zero-safe,
+        # contraction-safe (the truncation must match the tree path's
+        # exactly or arena-vs-tree int8 parity breaks)
+        scale_vec = _contract_safe(
+            jnp.maximum(_leaf_absmax(leaves), 1e-30) / 127.0
+        )
         q = _wire_concat(
             [
                 jnp.clip(jnp.round(l.reshape(-1) / scale_vec[k]), -127, 127)

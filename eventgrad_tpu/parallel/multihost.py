@@ -37,6 +37,32 @@ def init(coordinator: str, num_processes: int, process_id: int) -> None:
     )
 
 
+def init_from_env() -> bool:
+    """Join a multi-process mesh from the environment (mpirun's
+    env-propagation role): EG_COORDINATOR=host:port plus
+    EG_NUM_PROCESSES / EG_PROCESS_ID. Returns True when a coordinator
+    was configured (and the runtime joined), False when unset — callers
+    (cli.py, drivers) call this unconditionally before any device
+    computation. Missing count/id with a set coordinator raise rather
+    than silently running single-process."""
+    import os
+
+    coordinator = os.environ.get("EG_COORDINATOR")
+    if not coordinator:
+        return False
+    try:
+        num = int(os.environ["EG_NUM_PROCESSES"])
+        pid = int(os.environ["EG_PROCESS_ID"])
+    except KeyError as e:
+        raise RuntimeError(
+            f"EG_COORDINATOR={coordinator!r} is set but {e.args[0]} is "
+            "not — a multi-process mesh needs all three of "
+            "EG_COORDINATOR / EG_NUM_PROCESSES / EG_PROCESS_ID"
+        ) from None
+    init(coordinator, num, pid)
+    return True
+
+
 def is_multiprocess() -> bool:
     return jax.process_count() > 1
 

@@ -31,6 +31,38 @@ from jax.sharding import Mesh, PartitionSpec as P
 from eventgrad_tpu.parallel.topology import Topology
 
 
+def _resolve_shard_map():
+    """(shard_map callable, replication-check kwarg name) for this jax.
+
+    Newer jax exposes `jax.shard_map(..., check_vma=)` at top level;
+    the 0.4.x line ships the same transform as
+    `jax.experimental.shard_map.shard_map(..., check_rep=)`. One
+    resolution point so the mesh lift (and the tier-1 skip condition in
+    tests/_spmd.py) sees "shard_map available" wherever EITHER spelling
+    exists — the pre-shim skip keyed on `hasattr(jax, "shard_map")`
+    alone, which mis-read every 0.4.x environment as mesh-less and left
+    the whole shard_map test surface dark.
+    """
+    fn = getattr(jax, "shard_map", None)
+    if callable(fn):
+        return fn, "check_vma"
+    try:
+        from jax.experimental.shard_map import shard_map as exp_fn
+    except ImportError:
+        return None, None
+    return exp_fn, "check_rep"
+
+
+_SHARD_MAP, _CHECK_KW = _resolve_shard_map()
+
+
+def shard_map_available() -> bool:
+    """True when this jax provides the shard_map transform under either
+    spelling (the condition `tests/_spmd.py:requires_shard_map` skips
+    on — genuinely unavailable, not merely renamed)."""
+    return _SHARD_MAP is not None
+
+
 def build_mesh(topo: Topology, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """A `jax.sharding.Mesh` shaped like the topology.
 
@@ -48,6 +80,47 @@ def build_mesh(topo: Topology, devices: Optional[Sequence[jax.Device]] = None) -
         )
     dev_array = np.asarray(devices[:n]).reshape(topo.shape)
     return Mesh(dev_array, topo.axes)
+
+
+#: the two lifting paths of `spmd` (docs/ARCHITECTURE.md "Mesh backends")
+BACKENDS = ("vmap", "shard_map")
+
+
+def resolve_backend(
+    backend: Optional[str],
+    topo: Topology,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Optional[Mesh]:
+    """Resolve a backend request to the mesh `spmd` should lift over
+    (None = the single-chip vmap simulator).
+
+    "vmap" pins the simulator; "shard_map" demands a real device mesh
+    (one rank per device — raises when shard_map or the devices are
+    missing, never silently downgrades a mesh request); "auto" takes the
+    mesh whenever shard_map exists and enough devices are attached, and
+    falls back to vmap otherwise — the default-capable path callers like
+    train(backend="auto") ride. None defers to the caller's explicit
+    `mesh` argument (legacy wiring).
+    """
+    if backend is None or backend == "vmap":
+        return None
+    if backend not in BACKENDS + ("auto",):
+        raise ValueError(
+            f"backend must be one of {BACKENDS + ('auto',)} or None; "
+            f"got {backend!r}"
+        )
+    if devices is None:
+        devices = jax.devices()
+    if backend == "auto":
+        if not shard_map_available() or len(devices) < topo.n_ranks:
+            return None
+        return build_mesh(topo, devices)
+    if not shard_map_available():
+        raise RuntimeError(
+            "backend='shard_map' requested but this jax provides no "
+            "shard_map transform (see parallel/spmd.py:_resolve_shard_map)"
+        )
+    return build_mesh(topo, devices)
 
 
 def stacked_spec(topo: Topology) -> P:
@@ -103,6 +176,13 @@ def spmd(
     # shard_map path: leading stacked axis sharded over all mesh axes
     # (row-major, matching the stacked layout); per-shard leading dim is 1,
     # squeezed away so `fn` sees true per-rank shapes.
+    if _SHARD_MAP is None:
+        raise RuntimeError(
+            "spmd(fn, topo, mesh=...) needs the shard_map transform, "
+            "which this jax provides under neither `jax.shard_map` nor "
+            "`jax.experimental.shard_map.shard_map`; run the vmap lift "
+            "(mesh=None) instead"
+        )
     spec = stacked_spec(topo)
 
     def shard_body(*args):
@@ -110,8 +190,9 @@ def spmd(
         out = fn(*args)
         return jax.tree.map(lambda x: x[None], out)
 
-    mapped = jax.shard_map(
-        shard_body, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=check_vma
+    mapped = _SHARD_MAP(
+        shard_body, mesh=mesh, in_specs=spec, out_specs=spec,
+        **{_CHECK_KW: check_vma},
     )
 
     @functools.wraps(fn)

@@ -42,7 +42,7 @@ from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives, multihost
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
-from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
+from eventgrad_tpu.parallel.spmd import resolve_backend, spmd, stack_for_ranks
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.data.sharding import expand_to_mesh
 from eventgrad_tpu.train.state import init_train_state, init_train_state_spmd
@@ -248,6 +248,7 @@ def train(
     random_sampler: bool = False,
     sync_bn: bool = False,
     mesh=None,
+    backend: Optional[str] = None,
     seed: int = 0,
     x_test: Optional[np.ndarray] = None,
     y_test: Optional[np.ndarray] = None,
@@ -277,6 +278,21 @@ def train(
     pipeline: Optional[bool] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
+
+    backend (None | "vmap" | "shard_map" | "auto") picks the SPMD lift
+    (docs/ARCHITECTURE.md "Mesh backends"): "vmap" is the single-chip
+    simulator (all ranks batched onto one device), "shard_map" the real
+    device mesh — one rank per device, the gossip exchange runs as
+    actual `ppermute` collectives over ICI/DCN (ROADMAP open item 1);
+    "auto" takes the mesh whenever the shard_map transform and enough
+    devices exist and falls back to vmap otherwise. None (the default)
+    defers to the explicit `mesh` argument (parallel/spmd.build_mesh) —
+    legacy wiring; `backend="shard_map"` with mesh=None builds the mesh
+    itself. Training is BITWISE identical across the lifts on full
+    state, metrics, and history (tests/test_mesh_parity.py,
+    tests/test_cli.py::test_mesh_backend_matches_sim); every history
+    record carries `rec["backend"]` so downstream consumers
+    (tools/perf_ledger.py) never compare mesh rows against vmap rows.
 
     arena (None = auto) routes the gossip hot path through the flat
     parameter arena (parallel/arena.py + ops/event_engine.py): params,
@@ -487,6 +503,19 @@ def train(
     boundaries (blocks are split there). fault_inject forces K=1 (the
     fault must land at an exact epoch boundary).
     """
+    # mesh-backend resolution (parallel/spmd.resolve_backend): an
+    # explicit mesh wins ("auto"/"shard_map" just confirm it); a
+    # backend request with no mesh builds one — "vmap" pins the
+    # simulator and contradicts an explicit mesh loudly
+    if backend is not None:
+        if mesh is not None and backend == "vmap":
+            raise ValueError(
+                "backend='vmap' contradicts an explicit mesh= argument; "
+                "drop one of them"
+            )
+        if mesh is None:
+            mesh = resolve_backend(backend, topo)
+    backend_name = "shard_map" if mesh is not None else "vmap"
     if gossip_wire not in ("dense", "compact"):
         raise ValueError(
             f"gossip_wire must be 'dense' or 'compact', got {gossip_wire!r}"
@@ -1312,6 +1341,10 @@ def train(
                 ),
                 "n_params": n_params,
                 "arena": bool(arena_on),
+                # which SPMD lift ran this block (vmap sim vs shard_map
+                # device mesh) — the perf ledger's comparability-group
+                # key, so mesh rows never gate against vmap rows
+                "backend": backend_name,
             }
             if bucketed_k > 1:
                 # bucketed gossip schedule: the bucket count and the

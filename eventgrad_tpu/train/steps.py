@@ -427,23 +427,53 @@ def make_train_step(
 
             grads = jax.tree_util.tree_map_with_path(fix, grads)
 
-        # chaos nanstep= injection: poison this rank's gradients with NaN
-        # on the scheduled pass — BEFORE the quarantine guard, so the
-        # defense (or, with integrity off, the counterfactual poisoning)
-        # sees exactly what a sick rank would produce
+        # chaos nanstep= injection: poison this rank's step with NaN on
+        # the scheduled pass. The poison is a SCALAR NaN/1.0 factor per
+        # rank, so "NaN gradients" and "NaN optimizer updates/state"
+        # are the same fault (every float leaf of a poisoned rank goes
+        # NaN either way; an unpoisoned pass multiplies by exactly
+        # 1.0). It is applied to the optimizer TAIL (_poison below) — a
+        # purely elementwise chain, fusion-order-exact — rather than to
+        # `grads`: a multiply consuming the vjp outputs hands XLA:CPU
+        # an extra dataflow edge into the batch-reduction fusion group,
+        # which it resolves DIFFERENTLY under the vmap and shard_map
+        # lifts (optimization barriers are stripped on CPU, so they
+        # cannot pin it), breaking the cross-lift bitwise contract
+        # (tests/test_integrity.py test_integrity_bitwise_shard_map,
+        # tests/test_mesh_parity.py).
+        poison = None
+        bad = None
         if chaos is not None and chaos.has_nansteps:
             poison = chaos_inject.nanstep_mask(chaos, topo, pass_num)
             bad = jnp.where(poison, jnp.float32(jnp.nan), jnp.float32(1.0))
-            grads = jax.tree.map(lambda g: g * bad.astype(g.dtype), grads)
+
+        def _poison(tree_):
+            """NaN every float leaf of a poisoned rank (identity off).
+            Applied at the three optax tails — the only tails reachable
+            here, since chaos (any clause) + fused_sgd is rejected
+            above, so no fused/bucketed-fused path can skip it."""
+            if bad is None:
+                return tree_
+            return jax.tree.map(
+                lambda v: v * bad.astype(v.dtype)
+                if jnp.issubdtype(v.dtype, jnp.inexact) else v,
+                tree_,
+            )
 
         # non-finite quarantine (chaos/integrity.py): a rank whose grads
         # went NaN/Inf skips its update and suppresses its sends this
-        # pass. One stacked [L]-scalar reduction — the guard's whole cost.
+        # pass. One stacked [L]-scalar reduction — the guard's whole
+        # cost. The verdict on a poisoned step is (organically
+        # non-finite) | poison — bitwise what a finite-check on
+        # post-poison gradients returns (the scalar factor NaNs every
+        # element), with the reduction reading the PRISTINE vjp outputs.
         quar = None
         if integ_quar:
             quar = ~jnp.all(jnp.stack(
                 [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
             ))
+            if poison is not None:
+                quar = quar | poison
 
         # chaos bitflip= injection: the per-edge in-transit corruption
         # transform the event exchanges apply to received wire buffers
@@ -691,6 +721,9 @@ def make_train_step(
                 # per-leaf slices of the bucket buffers feeding the
                 # optax tail directly — the bucketed twin of
                 # mix_flat_into_tree, same neighbor add order, bitwise
+                # (int8 dequant products are exactly representable —
+                # collectives._contract_safe — so FMA fusion into these
+                # adds cannot change a bit on either SPMD lift)
                 with _phase(f"commit_mix.b{bi}"):
                     b = buckets_eff[bi]
                     use_b = (
@@ -1136,6 +1169,7 @@ def make_train_step(
                 updates, opt_state = tx.update(
                     grads, state.opt_state, bucketed_mixed
                 )
+                updates, opt_state = _poison(updates), _poison(opt_state)
                 params = optax.apply_updates(bucketed_mixed, updates)
             elif use_fused and (arena_pending is not None or arena_bufs is not None):
                 # arena fused tail: buffer commit + mix + momentum-SGD in one
@@ -1222,6 +1256,7 @@ def make_train_step(
                 else:
                     mixed = params
                 updates, opt_state = tx.update(grads, state.opt_state, mixed)
+                updates, opt_state = _poison(updates), _poison(opt_state)
                 params = optax.apply_updates(mixed, updates)
             else:
                 # chaos edge gating of the mix: dpsgd drops leave this pass's
@@ -1243,6 +1278,7 @@ def make_train_step(
                 # optimizer applies gradients (computed at pre-mix params) to the
                 # mixed parameters — exact D-PSGD ordering (decent.cpp:232-246).
                 updates, opt_state = tx.update(grads, state.opt_state, mixed)
+                updates, opt_state = _poison(updates), _poison(opt_state)
                 params = optax.apply_updates(mixed, updates)
 
         quar_eff = None

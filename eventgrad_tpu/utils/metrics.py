@@ -17,6 +17,16 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+def median(vals) -> float:
+    """Plain middle-of-sorted median (even length: mean of the two
+    middles) — ONE definition for the ablation tools' paired-ratio
+    protocol (overhead_ablation / integrity_sweep / mesh_ablation),
+    which previously each carried their own copy."""
+    s = sorted(vals)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
 def _scrub_nonfinite(obj: Any, path: str, bad: List[str]) -> Any:
     """Copy `obj` with NaN/Inf number leaves replaced by None, recording
     each replaced leaf's dotted path in `bad`. Python and numpy scalars

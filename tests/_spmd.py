@@ -1,14 +1,20 @@
 """Shared SPMD test helpers: the one shard_map skip definition.
 
-The mesh lift needs `jax.shard_map`; some CPU-only environments run a
-jax without it, where the SEED's shard_map tests fail outright (the
-known pre-existing tier-1 failures). Tests added since skip instead —
-via this ONE marker, so the reason string and the condition live in a
-single place. A tier-1 lint test (tests/test_lint_spmd.py) enforces
-that every new test touching shard_map imports `requires_shard_map`
-from here rather than re-spelling the skipif — the debt stops
-spreading while ROADMAP Open item 1 (real-mesh SPMD: retire the
-single-chip vmap lift) is pending.
+The mesh lift needs the shard_map transform. Since PR 14 the package
+resolves it under either spelling — `jax.shard_map` (new) or
+`jax.experimental.shard_map.shard_map` (the 0.4.x line) — via
+`parallel.spmd.shard_map_available`, and tests/conftest.py forces an
+8-device CPU host platform, so on every supported environment the
+shard_map tests RUN (and must pass; the vmap/shard_map bitwise-parity
+matrix lives in tests/test_mesh_parity.py). The skip below fires only
+when shard_map is GENUINELY unavailable — a jax with neither spelling —
+not merely renamed, which is what the pre-shim `hasattr(jax,
+"shard_map")` condition mis-read as "mesh-less" on 0.4.x (the seed's
+10 pre-existing tier-1 failures).
+
+A tier-1 lint (tests/test_lint_spmd.py) enforces that every test
+touching shard_map imports `requires_shard_map` from here rather than
+re-spelling the skipif — one marker, one reason string.
 
 Usage:
 
@@ -20,15 +26,16 @@ Usage:
     BACKENDS = ["vmap", pytest.param("shard_map", marks=requires_shard_map)]
 """
 
-import jax
 import pytest
+
+from eventgrad_tpu.parallel.spmd import shard_map_available
 
 #: single source of truth for "this test needs the shard_map mesh lift"
 requires_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
+    not shard_map_available(),
     reason=(
-        "jax.shard_map unavailable in this environment (the vmap lift "
-        "covers the semantics until ROADMAP Open item 1 — real-mesh "
-        "SPMD — retires the single-chip vmap path)"
+        "shard_map genuinely unavailable in this jax (neither "
+        "jax.shard_map nor jax.experimental.shard_map.shard_map "
+        "resolves — see parallel/spmd.py)"
     ),
 )

@@ -49,6 +49,12 @@ SLOW_MODULES = {
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        # an explicit @pytest.mark.tier1 promotes a single test out of
+        # its module's blanket slow marker (e.g. test_cli.py's
+        # vmap-vs-shard_map backend parity — a fast tier-1 gate living
+        # in an otherwise wall-clock-heavy launcher suite)
+        if item.get_closest_marker("tier1") is not None:
+            continue
         mod = getattr(item, "module", None)
         if mod is not None and mod.__name__ in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)

@@ -1,0 +1,138 @@
+"""Subprocess worker for the 64-rank shard_map scale smoke.
+
+Runs OUTSIDE pytest (tests/test_mesh_parity.py spawns it) because the
+XLA host-platform device count is fixed at client startup: the tier-1
+process pins 8 CPU devices (tests/conftest.py), so the 64-rank leg
+needs its own interpreter with `--xla_force_host_platform_device_count
+=64` set before jax initializes. Emits ONE JSON line on stdout:
+
+  per-edge telemetry wire bytes, the step's sent_bytes_wire_real
+  metric, the analytic per-neighbor formula
+  (collectives.wire_real_bytes_per_neighbor), and the ppermute offsets
+  collected from the traced mesh program vs the topology's declared
+  neighbor offsets (analysis/audit.collect_collectives).
+
+The parent asserts the three wire numbers agree EXACTLY and the mesh
+program exchanges on the declared ring offsets only.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = " ".join(
+    t for t in _flags.split()
+    if "xla_force_host_platform_device_count" not in t
+)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=64"
+).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from eventgrad_tpu.analysis import audit  # noqa: E402
+from eventgrad_tpu.data.datasets import synthetic_dataset  # noqa: E402
+from eventgrad_tpu.data.sharding import batched_epoch  # noqa: E402
+from eventgrad_tpu.models import MLP  # noqa: E402
+from eventgrad_tpu.obs import device as obs_device  # noqa: E402
+from eventgrad_tpu.parallel import collectives  # noqa: E402
+from eventgrad_tpu.parallel.events import EventConfig  # noqa: E402
+from eventgrad_tpu.parallel.spmd import (  # noqa: E402
+    build_mesh, spmd, stack_for_ranks,
+)
+from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
+from eventgrad_tpu.train.state import init_train_state  # noqa: E402
+from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
+from eventgrad_tpu.utils import trees  # noqa: E402
+
+N_RANKS = 64
+PER_RANK = 4
+STEPS = 3
+
+
+def main() -> int:
+    topo = Ring(N_RANKS)
+    model = MLP(hidden=8)
+    tx = optax.sgd(0.05)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=1)
+    x, y = synthetic_dataset(
+        N_RANKS * PER_RANK * STEPS, (8, 8, 1), seed=3
+    )
+    xb, yb = batched_epoch(x, y, N_RANKS, PER_RANK)
+
+    state = init_train_state(
+        model, (8, 8, 1), tx, topo, "eventgrad", cfg, arena=True
+    )
+    n_leaves = len(jax.tree.leaves(state.params))
+    state = state.replace(
+        telemetry=stack_for_ranks(
+            obs_device.TelemetryState.init(n_leaves, topo.n_neighbors),
+            topo,
+        )
+    )
+    step = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=cfg, arena=True, obs=True
+    )
+    mesh = build_mesh(topo)
+    lifted = jax.jit(spmd(step, topo, mesh=mesh))
+
+    batch0 = (jnp.asarray(xb[:, 0]), jnp.asarray(yb[:, 0]))
+    closed = jax.make_jaxpr(lifted)(state, batch0)
+    colls = audit.collect_collectives(closed.jaxpr, N_RANKS)
+    offsets = sorted({
+        o for rec in colls if rec["prim"] == "ppermute"
+        for o in rec["offsets"]
+    })
+    bad = sorted({
+        rec["prim"] for rec in colls
+        if rec["prim"] not in ("ppermute", "axis_index")
+    })
+
+    m = None
+    step_s = []
+    for s in range(STEPS):
+        t0 = time.perf_counter()
+        state, m = lifted(
+            state, (jnp.asarray(xb[:, s]), jnp.asarray(yb[:, s]))
+        )
+        jax.block_until_ready(jax.tree.leaves(state.params)[0])
+        step_s.append(time.perf_counter() - t0)
+
+    n_params = trees.tree_count_params(state.params) // N_RANKS
+    per_nb = collectives.wire_real_bytes_per_neighbor(
+        n_params, n_leaves, None, fire_bits=True
+    )
+    print(json.dumps({
+        "n_devices": len(jax.devices()),
+        "n_ranks": N_RANKS,
+        "steps": STEPS,
+        "per_neighbor_bytes_formula": float(per_nb),
+        # [n_ranks, n_neighbors] cumulative per-edge wire bytes the
+        # telemetry counted on device
+        "edge_bytes": np.asarray(state.telemetry.edge_bytes).tolist(),
+        # [n_ranks] per-step metric (constant per step per mode)
+        "sent_bytes_wire_real": np.asarray(
+            m["sent_bytes_wire_real"]
+        ).tolist(),
+        "n_neighbors": topo.n_neighbors,
+        "exchange_offsets": offsets,
+        "declared_offsets": sorted(nb.offset for nb in topo.neighbors),
+        "undeclared_collectives": bad,
+        "loss_finite": bool(np.isfinite(np.asarray(m["loss"])).all()),
+        # steady step time: the first dispatch pays the 64-way compile,
+        # so the committed number is the min of the post-compile steps
+        "step_ms": round(min(step_s[1:]) * 1000, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,12 @@ def test_repo_artifacts_all_valid():
     # ratio-vs-previous-round regression gate passing
     # (PERF_LEDGER_SCHEMA pins gates_all_ok)
     assert "perf_ledger_cpu.json" in names
+    # the real-mesh SPMD proof (ISSUE 14): EventGraD-vs-D-PSGD step
+    # ratio with REAL collectives on an 8-device mesh, bitwise state
+    # across the lifts, mesh-program audit clean at production
+    # geometry with the seeded mesh oracle caught, and the 64-rank
+    # scale leg's wire bytes exact (MESH_ABLATION_SCHEMA)
+    assert "mesh_ablation_cpu.json" in names
     assert out["errors"] == []
 
 

@@ -580,6 +580,18 @@ def test_audit_shard_lift_clean():
 
 
 @requires_shard_map
+@pytest.mark.parametrize("name", sorted(audit.MESH_ORACLES))
+def test_mesh_oracle_violation_detected(name):
+    """Each seeded MESH-lift sabotage (undeclared ppermute offset in
+    the shard_map program) is detected by `shard_lift_report` — the
+    real-mesh auditor can actually fire, not just pass clean cells."""
+    if len(jax.devices()) < audit.N_RANKS:
+        pytest.skip(f"needs {audit.N_RANKS} devices")
+    detected, reason = audit.MESH_ORACLES[name]()
+    assert detected, f"mesh oracle {name} NOT detected: {reason}"
+
+
+@requires_shard_map
 def test_audit_shard_lift_conv_clean():
     """The same real-mesh question at CONV geometry (ISSUE 12): the
     LeNetCifar cell's shard_map lift keeps its collectives declared —

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.spmd import build_mesh, spmd
 from eventgrad_tpu.parallel.topology import Ring, Torus
@@ -16,7 +17,7 @@ def _lift(fn, topo, backend):
     return spmd(fn, topo, mesh=build_mesh(topo))
 
 
-BACKENDS = ["vmap", "shard_map"]
+BACKENDS = ["vmap", pytest.param("shard_map", marks=requires_shard_map)]
 
 
 @pytest.mark.parametrize("backend", BACKENDS)

@@ -1,0 +1,183 @@
+"""Bitwise parity of the two SPMD lifts: vmap simulator vs shard_map mesh.
+
+The tentpole contract of the real-mesh backend (ROADMAP open item 1,
+docs/ARCHITECTURE.md "Mesh backends"): lifting the SAME per-rank step
+onto a real device mesh (`spmd(fn, topo, mesh=...)` — one rank per
+device, `ppermute` as an actual collective) instead of the single-chip
+vmap simulator changes WHERE the program runs, never a single bit of
+what it computes. The matrix here proves it on FULL TrainState +
+metrics across the event-exchange variants the headline numbers ship:
+masked|compact wire x f32/int8 lanes x bucketed K in {1,4} x
+staleness 0/1 — every leaf of the state pytree (params, optimizer,
+event thresholds AND stale neighbor buffers, rng, telemetry) compared
+with `==`, not allclose.
+
+The 64-rank scale leg runs in a subprocess (tests/mesh64_worker.py —
+the tier-1 process pins an 8-device CPU host platform, the scale leg
+needs 64) and asserts the wire truth THREE ways at scale: per-edge
+telemetry bytes == steps x `collectives.wire_real_bytes_per_neighbor`
+== the step's sent_bytes_wire_real metric, exactly, on every one of
+the 64 ranks — plus ppermute-offsets == the declared ring offsets in
+the traced mesh program.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from _spmd import requires_shard_map
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.spmd import (
+    build_mesh, resolve_backend, shard_map_available, spmd,
+    stack_for_ranks,
+)
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+from eventgrad_tpu.utils import trees
+
+pytestmark = requires_shard_map
+
+N_RANKS = 4
+PER_RANK = 4
+IN_SHAPE = (8, 8, 1)
+STEPS = 5
+CFG = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2,
+                  max_silence=4)
+MLP_HIDDEN = 8
+
+
+def _batches(seed=3):
+    x, y = synthetic_dataset(N_RANKS * PER_RANK * STEPS, IN_SHAPE, seed=seed)
+    xb, yb = batched_epoch(x, y, N_RANKS, PER_RANK)
+    return [
+        (jnp.asarray(xb[:, s]), jnp.asarray(yb[:, s])) for s in range(STEPS)
+    ]
+
+
+def _run(backend, *, gossip_wire="dense", wire=None, bucketed=None,
+         staleness=0, obs=False):
+    topo = Ring(N_RANKS)
+    model = MLP(hidden=MLP_HIDDEN)
+    tx = optax.sgd(0.05)
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        bucketed=bucketed or 1,
+    )
+    if obs:
+        n_leaves = len(jax.tree.leaves(state.params))
+        state = state.replace(
+            telemetry=stack_for_ranks(
+                obs_device.TelemetryState.init(
+                    n_leaves, topo.n_neighbors,
+                    n_buckets=min(bucketed or 1, n_leaves),
+                ),
+                topo,
+            )
+        )
+    capacity = None
+    if gossip_wire == "compact":
+        # non-binding capacity (the full per-rank element count) so the
+        # per-bucket splits admit exactly what the monolithic gate
+        # admits and the parity claim stays exact; binding budgets are
+        # bucket-local by design and unit-tested in tests/test_bucketed.py
+        capacity = trees.tree_count_params(state.params) // topo.n_ranks
+    step = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+        gossip_wire=gossip_wire, compact_capacity=capacity, wire=wire,
+        bucketed=bucketed, staleness=staleness, obs=obs,
+    )
+    mesh = build_mesh(topo) if backend == "shard_map" else None
+    lifted = jax.jit(spmd(step, topo, mesh=mesh))
+    m = None
+    for b in _batches():
+        state, m = lifted(state, b)
+    return state, m
+
+
+def _assert_bitwise(s_v, s_s, m_v, m_s):
+    lv, ls = jax.tree.leaves(s_v), jax.tree.leaves(s_s)
+    assert len(lv) == len(ls)
+    for a, b in zip(lv, ls):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert set(m_v) == set(m_s)
+    for k in m_v:
+        np.testing.assert_array_equal(
+            np.asarray(m_v[k]), np.asarray(m_s[k]), err_msg=k
+        )
+
+
+@pytest.mark.parametrize("staleness", [0, 1])
+@pytest.mark.parametrize("bucketed", [None, 4])
+@pytest.mark.parametrize("wire", [None, "int8"])
+@pytest.mark.parametrize("gossip_wire", ["dense", "compact"])
+def test_full_state_bitwise_across_lifts(gossip_wire, wire, bucketed,
+                                         staleness):
+    s_v, m_v = _run("vmap", gossip_wire=gossip_wire, wire=wire,
+                    bucketed=bucketed, staleness=staleness)
+    s_s, m_s = _run("shard_map", gossip_wire=gossip_wire, wire=wire,
+                    bucketed=bucketed, staleness=staleness)
+    _assert_bitwise(s_v, s_s, m_v, m_s)
+
+
+def test_telemetry_bitwise_across_lifts():
+    """The on-device obs accumulators (per-edge wire bytes included)
+    are part of the parity surface too."""
+    s_v, m_v = _run("vmap", obs=True, bucketed=4)
+    s_s, m_s = _run("shard_map", obs=True, bucketed=4)
+    _assert_bitwise(s_v, s_s, m_v, m_s)
+
+
+def test_resolve_backend_auto_prefers_mesh():
+    """'auto' takes the mesh on this 8-device fixture and falls back to
+    vmap when the topology outgrows the device count."""
+    assert shard_map_available()
+    mesh = resolve_backend("auto", Ring(4))
+    assert mesh is not None
+    assert resolve_backend("auto", Ring(1024)) is None
+    assert resolve_backend("vmap", Ring(4)) is None
+    with pytest.raises(ValueError):
+        resolve_backend("nonsense", Ring(4))
+
+
+def test_mesh64_scale_smoke():
+    """The 64-rank scale leg: a real 64-device mesh program exchanges
+    on the declared ring offsets only, and the per-neighbor wire bytes
+    match `wire_real_bytes_per_neighbor` EXACTLY three ways (telemetry
+    per edge / analytic formula / step metric) on all 64 ranks."""
+    worker = os.path.join(os.path.dirname(__file__), "mesh64_worker.py")
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, worker], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["n_devices"] == 64 and rec["n_ranks"] == 64
+    assert rec["exchange_offsets"] == rec["declared_offsets"] == [-1, 1]
+    assert rec["undeclared_collectives"] == []
+    assert rec["loss_finite"]
+    per_nb = rec["per_neighbor_bytes_formula"]
+    edge = np.asarray(rec["edge_bytes"])  # [64, n_nb] cumulative
+    assert edge.shape == (64, rec["n_neighbors"])
+    np.testing.assert_array_equal(
+        edge, np.full_like(edge, rec["steps"] * per_nb)
+    )
+    metric = np.asarray(rec["sent_bytes_wire_real"])  # [64] per step
+    np.testing.assert_array_equal(
+        metric, np.full_like(metric, rec["n_neighbors"] * per_nb)
+    )

@@ -135,6 +135,12 @@ def test_committed_ledger_covers_six_rounds_with_mfu_and_roofline():
     for e in led["rounds"]:
         if e["status"] != "ok":
             continue
+        if e.get("config") == "mesh-scale64":
+            # the 64-rank scale leg is a wire-exactness smoke (tiny
+            # MLP, 3 steps) riding as a mesh-backend row; it carries
+            # step_ms but no MFU-bearing op-point
+            assert e["backend"] != "vmap" and e["step_ms"]
+            continue
         # the acceptance instrument: every data round carries MFU and a
         # roofline verdict (cost-model-backfilled on the CPU rounds,
         # record-carried on chip), nominal-spec flagged honestly

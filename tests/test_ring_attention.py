@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _spmd import requires_shard_map
 from eventgrad_tpu.parallel.ring_attention import (
     full_attention,
     ring_attention,
@@ -36,7 +37,10 @@ def _unshard(out):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("backend", ["vmap", "shard_map"])
+@pytest.mark.parametrize(
+    "backend",
+    ["vmap", pytest.param("shard_map", marks=requires_shard_map)],
+)
 def test_ring_attention_matches_full(causal, backend):
     topo = Ring(N)
     (q, k, v), (qs, ks, vs) = _shards(jax.random.PRNGKey(0))

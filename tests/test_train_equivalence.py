@@ -9,6 +9,7 @@ import numpy as np
 import optax
 import pytest
 
+from _spmd import requires_shard_map
 from eventgrad_tpu.data.datasets import synthetic_dataset
 from eventgrad_tpu.data.sharding import batched_epoch
 from eventgrad_tpu.models import MLP
@@ -130,6 +131,7 @@ def test_sparse_topk100_equals_dense_eventgrad():
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+@requires_shard_map
 @pytest.mark.parametrize("algo", ["dpsgd", "eventgrad"])
 def test_shard_map_matches_vmap(algo):
     """The same per-rank program must produce identical trajectories whether

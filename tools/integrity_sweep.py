@@ -226,10 +226,7 @@ def _overhead_leg(seed: int, n_rounds: int, K: int = 16):
             jax.block_until_ready(out.params)
             times[k].append((time.perf_counter() - t0) / K * 1000)
 
-    def _median(v):
-        s = sorted(v)
-        mid = len(s) // 2
-        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+    from eventgrad_tpu.utils.metrics import median as _median
 
     paired = [on / off for on, off in zip(times["on"], times["off"])]
     return {

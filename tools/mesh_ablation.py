@@ -1,0 +1,272 @@
+"""Real-mesh SPMD ablation: the shard_map backend vs the vmap simulator.
+
+ROADMAP open item 1's acceptance capture (ISSUE 14): every headline
+number used to run 8 "ranks" vmapped onto one chip, so the event
+exchange was an XLA-scheduling claim, not real inter-device traffic.
+This tool runs the SAME op-point the arena/bucketed ablations use
+(LeNetCifar, Ring(8), synthetic CIFAR prototypes) on a real 8-device
+mesh (`--xla_force_host_platform_device_count=8` on CPU — one rank per
+device, `ppermute` as an actual collective) and commits
+artifacts/mesh_ablation_<platform>.json (MESH_ABLATION_SCHEMA in
+tools/validate_artifacts.py) with:
+
+  * the REAL-COLLECTIVE EventGraD-vs-D-PSGD step ratio (median paired
+    per-round over scanned steady-state runs — the bucketed-ablation
+    protocol) on the shard_map backend, next to the vmap twin;
+  * the mesh-vs-vmap cost of the SAME eventgrad step (what moving from
+    the single-chip simulator to a real mesh costs at this op-point);
+  * bitwise_state: the shard_map leg's final scanned TrainState ==
+    the vmap leg's, leaf for leaf (the tests/test_mesh_parity.py
+    contract re-proven at production geometry);
+  * the mesh-program audit flags at production geometry:
+    `audit_shard_lift` clean on the LeNetCifar and ResNet18 arena
+    cells (only declared-offset ppermutes + axis_index, zero
+    callbacks) and the seeded mesh oracle CAUGHT
+    (analysis/audit.MESH_ORACLES);
+  * a 64-rank scale leg (tests/mesh64_worker.py in a subprocess — the
+    device count is fixed at client startup): per-neighbor wire bytes
+    proven EXACTLY equal to `collectives.wire_real_bytes_per_neighbor`
+    on all 64 ranks, plus its steady step_ms.
+
+tools/perf_ledger.py ingests the mesh rows (backend="shard_map") into
+the trajectory; the `backend` field in the comparability-group key
+keeps them from ever gating against vmap rows.
+
+Usage: python tools/mesh_ablation.py [n_rounds]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the mesh needs its devices before the first backend use
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from eventgrad_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.honor_cpu_pin()
+
+from eventgrad_tpu.analysis import audit  # noqa: E402
+from eventgrad_tpu.data.datasets import load_or_synthesize  # noqa: E402
+from eventgrad_tpu.data.sharding import batched_epoch  # noqa: E402
+from eventgrad_tpu.models.cnn import LeNetCifar  # noqa: E402
+from eventgrad_tpu.parallel.events import EventConfig  # noqa: E402
+from eventgrad_tpu.parallel.spmd import (  # noqa: E402
+    build_mesh, shard_map_available, spmd,
+)
+from eventgrad_tpu.parallel.topology import Ring  # noqa: E402
+from eventgrad_tpu.train.state import init_train_state  # noqa: E402
+from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
+from eventgrad_tpu.utils.metrics import median as _median  # noqa: E402
+
+K_SCAN = 8
+
+
+def _scale64_leg() -> dict:
+    """Run the 64-rank worker in its own interpreter (the device count
+    is fixed at client startup) and distill its record."""
+    worker = os.path.join(REPO, "tests", "mesh64_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, worker, "--timed"], env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"mesh64 worker failed: {out.stderr[-2000:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    per_nb = rec["per_neighbor_bytes_formula"]
+    edge = np.asarray(rec["edge_bytes"])
+    metric = np.asarray(rec["sent_bytes_wire_real"])
+    wire_exact = bool(
+        (edge == rec["steps"] * per_nb).all()
+        and (metric == rec["n_neighbors"] * per_nb).all()
+    )
+    return {
+        "n_ranks": rec["n_ranks"],
+        "n_devices": rec["n_devices"],
+        "model": "MLP",
+        "wire_bytes_exact": wire_exact,
+        "per_neighbor_bytes": per_nb,
+        "exchange_offsets": rec["exchange_offsets"],
+        "declared_offsets": rec["declared_offsets"],
+        "offsets_ok": rec["exchange_offsets"] == rec["declared_offsets"],
+        "step_ms": rec.get("step_ms"),
+    }
+
+
+def main(n_rounds: int = 12) -> int:
+    if not shard_map_available():
+        print("shard_map unavailable in this jax; nothing to ablate",
+              file=sys.stderr)
+        return 1
+    if len(jax.devices()) < 8:
+        print(f"need 8 devices, have {len(jax.devices())}",
+              file=sys.stderr)
+        return 1
+
+    topo = Ring(8)
+    model = LeNetCifar()
+    lr, mom = 1e-2, 0.9
+    tx = optax.sgd(lr, momentum=mom)
+    per_rank = 8
+    x, y = load_or_synthesize("cifar10", None, "train", n_synth=1024)
+    xb, yb = batched_epoch(x, y, topo.n_ranks, per_rank)
+    xs = jnp.asarray(np.stack(
+        [xb[:, s % xb.shape[1]] for s in range(K_SCAN)], 0))
+    ys = jnp.asarray(np.stack(
+        [yb[:, s % yb.shape[1]] for s in range(K_SCAN)], 0))
+    cfg = EventConfig(
+        adaptive=True, horizon=1.05, warmup_passes=10, max_silence=50
+    )
+    mesh = build_mesh(topo)
+
+    # one scanned program per (algo, backend); interleaved rounds with
+    # median PAIRED per-round ratios — the arena/bucketed protocol
+    variants = {}
+    finals = {}
+    for algo, c in (("dpsgd", None), ("eventgrad", cfg)):
+        for backend in ("vmap", "shard_map"):
+            state = init_train_state(
+                model, x.shape[1:], tx, topo, algo, c, arena=True
+            )
+            lifted = spmd(
+                make_train_step(
+                    model, tx, topo, algo, event_cfg=c, arena=True,
+                ),
+                topo, mesh=mesh if backend == "shard_map" else None,
+            )
+
+            def run(s, xs, ys, _l=lifted):
+                return jax.lax.scan(lambda s, b: _l(s, b), s, (xs, ys))
+
+            run = jax.jit(run)
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(jax.tree.leaves(out.params)[0])
+            compile_s = time.perf_counter() - t0
+            variants[(algo, backend)] = (state, run, compile_s)
+            finals[(algo, backend)] = out
+
+    # bitwise: the scanned eventgrad final state must be IDENTICAL
+    # across the lifts, every leaf of the TrainState
+    bitwise = True
+    for algo in ("dpsgd", "eventgrad"):
+        lv = jax.tree.leaves(finals[(algo, "vmap")])
+        ls = jax.tree.leaves(finals[(algo, "shard_map")])
+        for a, b in zip(lv, ls):
+            if not bool((np.asarray(a) == np.asarray(b)).all()):
+                bitwise = False
+
+    times = {k: [] for k in variants}
+    for _ in range(n_rounds):
+        for k, (state, run, _c) in variants.items():
+            t0 = time.perf_counter()
+            out, _ = run(state, xs, ys)
+            jax.block_until_ready(jax.tree.leaves(out.params)[0])
+            times[k].append((time.perf_counter() - t0) / K_SCAN * 1000)
+
+    results = {}
+    ratios = {}
+    for backend in ("vmap", "shard_map"):
+        leg = {}
+        for algo in ("dpsgd", "eventgrad"):
+            v = times[(algo, backend)]
+            leg[algo] = {
+                "compile_s": round(variants[(algo, backend)][2], 4),
+                "step_ms_min": round(min(v), 4),
+                "step_ms_p50": round(_median(v), 4),
+            }
+        paired = [
+            e / d
+            for e, d in zip(times[("eventgrad", backend)],
+                            times[("dpsgd", backend)])
+        ]
+        leg["step_overhead_ratio"] = round(_median(paired), 4)
+        ratios[backend] = leg["step_overhead_ratio"]
+        results[backend] = leg
+        print(json.dumps({backend: leg}), flush=True)
+    mesh_cost = [
+        s / v
+        for s, v in zip(times[("eventgrad", "shard_map")],
+                        times[("eventgrad", "vmap")])
+    ]
+
+    # mesh-program audit at production geometry + the seeded oracle
+    lenet = audit.audit_shard_lift(
+        audit.config_by_name("lenet_masked_f32_arena")
+    )
+    resnet = audit.audit_shard_lift(
+        audit.config_by_name("resnet18_masked_f32_arena")
+    )
+    oracles = audit.run_mesh_oracles()
+
+    rec = {
+        "bench": "mesh_ablation",
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "op_point": {
+            "model": "LeNetCifar", "topology": "ring:8",
+            "per_rank_batch": per_rank, "scan_steps": K_SCAN,
+            "rounds": n_rounds, "momentum": mom, "arena": True,
+            "data": "synthetic-prototype",
+        },
+        "results": results,
+        # the headline: EventGraD vs D-PSGD with REAL collectives
+        "step_overhead_ratio_mesh": ratios["shard_map"],
+        "step_overhead_ratio_vmap": ratios["vmap"],
+        # what the mesh costs over the simulator for the same step
+        "mesh_vs_vmap_ratio": round(_median(mesh_cost), 4),
+        "bitwise_state": bitwise,
+        "audit": {
+            "lenet_clean": audit.shard_lift_clean(lenet),
+            "resnet18_clean": audit.shard_lift_clean(resnet),
+            "lenet_offsets": lenet["exchange_offsets"],
+            "resnet18_offsets": resnet["exchange_offsets"],
+            "mesh_oracles": oracles,
+            "mesh_oracle_caught": all(o["detected"] for o in oracles),
+        },
+        "scale64": _scale64_leg(),
+        "protocol": (
+            "ratios are median paired per-round (eventgrad/dpsgd "
+            "back-to-back under the same load) over scanned "
+            "steady-state runs; one rank per device on the shard_map "
+            "legs, all ranks on device 0 on the vmap legs"
+        ),
+    }
+    out_path = os.path.join(
+        REPO, "artifacts", f"mesh_ablation_{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out_path}", file=sys.stderr)
+    ok = (
+        bitwise
+        and rec["audit"]["lenet_clean"]
+        and rec["audit"]["resnet18_clean"]
+        and rec["audit"]["mesh_oracle_caught"]
+        and rec["scale64"]["wire_bytes_exact"]
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+    sys.exit(main(n))

@@ -97,10 +97,7 @@ from eventgrad_tpu.train.steps import make_train_step  # noqa: E402
 from eventgrad_tpu.utils.profiling import timed_steps  # noqa: E402
 
 
-def _median(vals):
-    s = sorted(vals)
-    mid = len(s) // 2
-    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+from eventgrad_tpu.utils.metrics import median as _median  # noqa: E402
 
 
 def _micro(fn, *args, iters: int = 30):

@@ -16,9 +16,15 @@ in tools/validate_artifacts.py):
     round's recorded op-point and peaks from `obs.devicespec` (CPU
     rounds use the NOMINAL generic-cpu spec: a cross-round tracking
     number, never a hardware claim — `nominal_spec` marks it);
+  * mesh-backend rows — artifacts/mesh_ablation_*.json
+    (tools/mesh_ablation.py) joins the trajectory as
+    backend="shard_map" entries: real-collective step times at the
+    ablation op-point plus the 64-rank scale leg;
   * regression gates — explicit ratio-vs-previous-round thresholds,
-    evaluated within comparability groups (same platform+model+config;
-    a TPU flagship round is never compared against a CPU tiny smoke).
+    evaluated within comparability groups (same
+    platform+model+config+backend; a TPU flagship round is never
+    compared against a CPU tiny smoke, and a shard_map mesh row never
+    gates against a vmap simulator row).
     A failed gate fails `--check` (exit 1) AND the committed artifact
     (the schema pins `gates_all_ok: true`), so a regression cannot be
     committed silently.
@@ -66,21 +72,33 @@ GATES: Tuple[Tuple[str, str, float], ...] = (
 
 #: per-rank batch by bench tier (bench.py op-points: global 256 on the
 #: full tier, 64 on the CPU tiers, 8 ranks) — the records don't carry
-#: the batch size, the tier pins it
+#: the batch size, the tier pins it; "mesh-cpu" is the shard_map
+#: ablation's op-point (tools/mesh_ablation.py, per-rank 8)
 _PER_RANK_BY_CONFIG = {
     "full": 32, "full-rehearsal": 8, "reduced": 8, "tiny": 8,
+    "mesh-cpu": 8,
 }
 
 
-def comparable_key(rec: Dict[str, Any]) -> Optional[Tuple[str, str, str]]:
+def comparable_key(
+    rec: Dict[str, Any],
+) -> Optional[Tuple[str, str, str, str]]:
     """Comparability group of a bench record/ledger entry: rounds are
-    gated against each other ONLY within (platform, model, config)."""
+    gated against each other ONLY within (platform, model, config,
+    backend). The backend dimension (vmap single-chip simulator vs
+    shard_map device mesh, ISSUE 14) keeps mesh rows from ever gating
+    against vmap rows — a real-collective step time is not a
+    regression of a batched-simulation one; records predating the
+    field were all vmap."""
     plat, model, cfg = (
         rec.get("platform"), rec.get("model"), rec.get("config"),
     )
     if not (plat and model and cfg):
         return None
-    return (str(plat), str(model), str(cfg))
+    return (
+        str(plat), str(model), str(cfg),
+        str(rec.get("backend") or "vmap"),
+    )
 
 
 # --- ingestion -------------------------------------------------------------
@@ -114,6 +132,9 @@ def _bench_entry(path: str) -> Dict[str, Any]:
         "device_kind": rec.get("device_kind"),
         "config": rec.get("config"),
         "model": rec.get("model"),
+        # SPMD lift that produced the numbers; pre-field records were
+        # all the single-chip vmap simulator (ISSUE 14)
+        "backend": rec.get("backend", "vmap"),
         "passes": rec.get("passes"),
         "collapsed": rec.get("collapsed", False),
         "step_ms": rec.get("step_ms"),
@@ -143,6 +164,60 @@ def _multichip_entry(path: str) -> Dict[str, Any]:
         "n_devices": raw.get("n_devices"), "ok": raw.get("ok"),
         "skipped": raw.get("skipped"),
     }
+
+
+def _mesh_entries(root: str, next_round: int) -> List[Dict[str, Any]]:
+    """Mesh-backend rows from artifacts/mesh_ablation_*.json
+    (tools/mesh_ablation.py, ISSUE 14): the real-collective step times
+    join the trajectory as backend="shard_map" entries — their own
+    comparability groups, so the MFU/roofline trajectory finally
+    tracks REAL exchange cost without ever gating against the vmap
+    simulator's rows."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+        os.path.join(root, "artifacts", "mesh_ablation_*.json")
+    )):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        name = os.path.basename(path)
+        op = rec.get("op_point", {})
+        sm = rec.get("results", {}).get("shard_map", {})
+        ev, dp = sm.get("eventgrad", {}), sm.get("dpsgd", {})
+        out.append({
+            "round": next_round, "source": name, "status": "ok",
+            "git_round": None,
+            "provenance": op.get("data", "synthetic-prototype"),
+            "platform": rec.get("platform"),
+            "config": "mesh-cpu",
+            "model": op.get("model"),
+            "backend": "shard_map",
+            "n_ranks": 8,
+            "step_ms": ev.get("step_ms_p50"),
+            "step_ms_dpsgd": dp.get("step_ms_p50"),
+            "step_overhead_ratio": rec.get("step_overhead_ratio_mesh"),
+            "mesh_vs_vmap_ratio": rec.get("mesh_vs_vmap_ratio"),
+            "mfu": None,
+            "mfu_source": None,
+        })
+        scale = rec.get("scale64") or {}
+        if scale.get("step_ms") is not None:
+            out.append({
+                "round": next_round, "source": name + "#scale64",
+                "status": "ok", "git_round": None,
+                "provenance": "synthetic-prototype",
+                "platform": rec.get("platform"),
+                "config": "mesh-scale64",
+                "model": scale.get("model"),
+                "backend": "shard_map",
+                "n_ranks": scale.get("n_ranks"),
+                "step_ms": scale.get("step_ms"),
+                "mfu": None,
+                "mfu_source": None,
+            })
+    return out
 
 
 #: perf-ablation artifacts folded in as trajectory snapshots: each is
@@ -328,6 +403,8 @@ def build_ledger(root: str, with_costmodel: bool = True,
         for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
     ]
     entries.sort(key=lambda e: e["round"])
+    next_round = (entries[-1]["round"] + 1) if entries else 1
+    entries.extend(_mesh_entries(root, next_round))
     if with_costmodel:
         _costmodel_fill(entries, quiet)
     gates = evaluate_gates(entries)
@@ -354,8 +431,12 @@ def build_ledger(root: str, with_costmodel: bool = True,
 
 def format_delta(prev: Dict[str, Any], cur: Dict[str, Any]) -> str:
     """One-line step_ms/MFU trajectory delta (bench.py prints this to
-    stderr at the end of every run)."""
-    bits = [f"perf trajectory vs round {prev['round']} ({prev['source']}):"]
+    stderr at the end of every run) — the backend rides next to the
+    numbers so a shard_map capture is never misread as a vmap one."""
+    bits = [
+        f"perf trajectory vs round {prev['round']} ({prev['source']}, "
+        f"backend={cur.get('backend') or 'vmap'}):"
+    ]
     for name, key in (("step_ms", "step_ms"), ("mfu", "mfu")):
         a, b = prev.get(key), cur.get(key)
         if a and b:

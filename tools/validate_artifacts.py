@@ -260,6 +260,60 @@ BUCKETED_ABLATION_SCHEMA = {
     },
 }
 
+MESH_ABLATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "platform", "n_devices", "op_point", "results",
+        "step_overhead_ratio_mesh", "step_overhead_ratio_vmap",
+        "mesh_vs_vmap_ratio", "bitwise_state", "audit", "scale64",
+    ],
+    "properties": {
+        "bench": {"enum": ["mesh_ablation"]},
+        "platform": {"type": "string"},
+        # the real-mesh backend acceptance gates (ISSUE 14): the
+        # EventGraD-vs-D-PSGD step ratio measured with REAL collectives
+        # (one rank per device, actual ppermutes) stays in family with
+        # the vmap proxy (<= 1.15 on the CPU capture; the r05 TPU
+        # single-chip ratio was 1.09), the mesh lift costs bounded
+        # overhead over the simulator at the same op-point, training is
+        # BITWISE across the lifts, the mesh program audits clean at
+        # production geometry with the seeded mesh oracle CAUGHT, and
+        # the 64-rank scale leg's per-neighbor wire bytes match the
+        # formula exactly
+        "n_devices": {"type": "integer", "minimum": 8},
+        "step_overhead_ratio_mesh": {"type": "number", "minimum": 0,
+                                     "maximum": 1.15},
+        "step_overhead_ratio_vmap": {"type": "number", "minimum": 0},
+        "mesh_vs_vmap_ratio": {"type": "number", "minimum": 0,
+                               "maximum": 1.3},
+        "bitwise_state": {"enum": [True]},
+        "results": {
+            "type": "object",
+            "required": ["vmap", "shard_map"],
+        },
+        "audit": {
+            "type": "object",
+            "required": [
+                "lenet_clean", "resnet18_clean", "mesh_oracle_caught",
+            ],
+            "properties": {
+                "lenet_clean": {"enum": [True]},
+                "resnet18_clean": {"enum": [True]},
+                "mesh_oracle_caught": {"enum": [True]},
+            },
+        },
+        "scale64": {
+            "type": "object",
+            "required": ["n_ranks", "wire_bytes_exact", "offsets_ok"],
+            "properties": {
+                "n_ranks": {"type": "integer", "minimum": 64},
+                "wire_bytes_exact": {"enum": [True]},
+                "offsets_ok": {"enum": [True]},
+            },
+        },
+    },
+}
+
 PIPELINE_BUBBLE_SCHEMA = {
     "type": "object",
     "required": [
@@ -628,6 +682,7 @@ _ARTIFACT_FAMILIES = (
     ("obs_overhead_", OBS_OVERHEAD_SCHEMA),
     ("arena_ablation_", ARENA_ABLATION_SCHEMA),
     ("bucketed_ablation_", BUCKETED_ABLATION_SCHEMA),
+    ("mesh_ablation_", MESH_ABLATION_SCHEMA),
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
