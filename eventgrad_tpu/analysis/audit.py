@@ -182,6 +182,16 @@ CONFIGS: Tuple[AuditConfig, ...] = (
                 integrity=True),
     AuditConfig("event_compact_bf16_arena_stale", gossip_wire="compact",
                 capacity=CAPACITY, wire="bf16", arena=True, staleness=1),
+    # bounded-async gossip (ISSUE 15): the per-edge delivery queues add
+    # NO wire lanes (the exchange is unchanged — only the commit is
+    # deferred), so the same rank-isolation + exact wire-byte truth
+    # must hold with the D-deep clocks in the traced program; the
+    # chaos cell carries a slow= straggler so the lag path itself is
+    # in the audited jaxpr
+    AuditConfig("event_masked_f32_arena_stale2_chaos", arena=True,
+                staleness=2, chaos=True),
+    AuditConfig("event_compact_int8_arena_stale4", gossip_wire="compact",
+                capacity=CAPACITY, wire="int8", arena=True, staleness=4),
     AuditConfig("sp_f32_tree", algo="sp_eventgrad"),
     # bucketed gossip schedule (ISSUE 10): the auditor must see K
     # declared-offset ppermute lane groups per neighbor and the SAME
@@ -272,10 +282,16 @@ def build(cfg: AuditConfig):
     topo = Ring(N_RANKS)
     model, in_shape, in_dtype, _ = _geometry(cfg)
     tx = optax.sgd(0.05)
-    chaos = ChaosSchedule(seed=3, drop_p=0.4) if cfg.chaos else None
+    chaos = None
+    if cfg.chaos:
+        # bounded-async cells add a persistent straggler so the lag
+        # schedule (not just the queue carry) is in the audited jaxpr
+        slow = ((1, 3),) if cfg.staleness >= 2 else ()
+        chaos = ChaosSchedule(seed=3, drop_p=0.4, slow=slow)
     state = init_train_state(
         model, in_shape, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
         bucketed=cfg.bucketed or 1, input_dtype=in_dtype,
+        staleness=cfg.staleness if cfg.algo == "eventgrad" else 0,
     )
     if chaos is not None:
         state = state.replace(
@@ -906,6 +922,68 @@ def oracle_host_callback() -> Tuple[bool, str]:
     return rep["callbacks"] > 0, f"{rep['callbacks']} host callbacks"
 
 
+def _run_steps(cfg: AuditConfig, n_steps: int = 4, sabotage=None):
+    """Final params after `n_steps` eager vmap steps of one cell —
+    the value harness the late-delivery oracle drives. `sabotage`
+    temporarily rebinds train.steps' async_delivery_commit."""
+    from eventgrad_tpu.train import steps as steps_mod
+
+    batch = _batch(cfg)
+    orig = steps_mod.async_delivery_commit
+    try:
+        if sabotage is not None:
+            # steps.py resolves the name at TRACE time (module global),
+            # so building the step under the rebinding suffices
+            steps_mod.async_delivery_commit = sabotage
+        state, step, topo = build(cfg)
+        lifted = spmd(step, topo)
+        for _ in range(n_steps):
+            state, _m = lifted(state, batch)
+    finally:
+        steps_mod.async_delivery_commit = orig
+    return state
+
+
+def oracle_late_delivery_drift() -> Tuple[bool, str]:
+    """The bounded-async commit sabotaged by ONE pass: the visible
+    buffers handed to the mix are the PRE-arrival ones (a classic
+    off-by-one between commit-on-arrival and the mix read). The
+    equivalence contract — staleness=2 under the all-baseline lag
+    schedule is BITWISE staleness=1 (a late delivery is a deferred
+    fire, nothing more) — must catch it: the sabotaged engine's
+    trajectory diverges from the staleness=1 reference."""
+    from eventgrad_tpu.parallel import events as events_mod
+
+    cfg2 = config_by_name("event_masked_f32_arena_stale2_chaos")
+    cfg2 = dataclasses.replace(cfg2, chaos=False)  # pure-baseline lags
+    cfg1 = dataclasses.replace(cfg2, name="stale1_ref", staleness=1)
+
+    def sabotaged(state, cands, effs, delivered, lag_vec, pass_num,
+                  spec, bound):
+        new_state, bufs, stale, late = events_mod.async_delivery_commit(
+            state, cands, effs, delivered, lag_vec, pass_num, spec, bound
+        )
+        return new_state, state.bufs, stale, late  # mix reads PRE-arrival
+
+    ref = _run_steps(cfg1)
+    good = _run_steps(cfg2)
+    bad = _run_steps(cfg2, sabotage=sabotaged)
+
+    def _same(a, b):
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params))
+        )
+
+    clean_holds = _same(ref, good)
+    detected = clean_holds and not _same(ref, bad)
+    return detected, (
+        "clean D=2 == D=1 bitwise; sabotaged commit-on-arrival "
+        "diverges from the deferred-fire reference"
+        if detected else "equivalence harness failed to fire"
+    )
+
+
 def oracle_bucket_undeclared_offset() -> Tuple[bool, str]:
     """One BUCKET's wire lane re-shipped at an undeclared offset (+2)
     in the bucketed schedule — per-bucket exchanges must stay on the
@@ -1033,6 +1111,7 @@ def oracle_attention_cross_rank_gather() -> Tuple[bool, str]:
 
 ORACLES = {
     "rank_coupling_ppermute": oracle_rank_coupling,
+    "late_delivery_drift": oracle_late_delivery_drift,
     "bucket_undeclared_offset": oracle_bucket_undeclared_offset,
     "rank_coupling_roll": oracle_rank_roll,
     "wire_dtype_upcast": oracle_wire_dtype_upcast,

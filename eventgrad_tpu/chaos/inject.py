@@ -122,6 +122,84 @@ def delivery_mask(
     return deliver
 
 
+def lag_vector(
+    sched: Optional[ChaosSchedule],
+    topo: Topology,
+    pass_num: jnp.ndarray,
+    bound: int,
+    srcs: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-edge EFFECTIVE delivery lag (int32 [n_neighbors]) of the
+    messages exchanged on this pass, for the bounded-async engine
+    (train(staleness=D >= 2)).
+
+    Scheduled lag = max(1, lag= windows covering this pass, slow=
+    clauses naming the edge's SOURCE rank); the effective lag clamps it
+    to [1, bound] — the bound is the whole point: a message can never
+    land more than D passes late because the fast rank waits instead of
+    running further ahead (tools/straggler_ablation.py charges that
+    wait to the wall clock; the traced step only ever sees the clamped
+    value). Pure data — no random draws, and deterministic in the edge
+    SOURCES alone (no receiver-rank dependence) — so the host-side
+    `lag_table` twin replays it exactly. `sched=None` is the
+    all-baseline (lag 1) schedule."""
+    n_nb = topo.n_neighbors
+    if srcs is None:
+        _, srcs = rank_and_sources(topo)
+    srcs = jnp.asarray(srcs, jnp.int32)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    lag = jnp.ones((n_nb,), jnp.int32)
+    if sched is not None:
+        for w in sched.lag:
+            in_window = (pass_i >= w.start_pass) & (pass_i < w.end_pass)
+            lag = jnp.where(
+                in_window, jnp.maximum(lag, jnp.int32(w.lag)), lag
+            )
+        for r, f in sched.slow:
+            lag = jnp.where(
+                srcs == r, jnp.maximum(lag, jnp.int32(f)), lag
+            )
+    return jnp.clip(lag, 1, max(1, int(bound)))
+
+
+def lag_table(
+    sched: Optional[ChaosSchedule],
+    topo: Topology,
+    n_passes: int,
+    start_pass: int = 1,
+    bound: Optional[int] = None,
+) -> np.ndarray:
+    """Host-side replay of the lag schedule: int32 [n_passes, n_ranks,
+    n_neighbors]. With `bound` it runs the exact clamp of `lag_vector`
+    (the in-step ground truth); with bound=None it returns the RAW
+    scheduled lag — what the network would do unconstrained, which is
+    what the straggler ablation's wall-clock model charges a lockstep
+    run for."""
+    srcs = np.array(
+        [
+            [topo.neighbor_source(r, nb) for nb in topo.neighbors]
+            for r in range(topo.n_ranks)
+        ],
+        np.int32,
+    ).reshape(topo.n_ranks, topo.n_neighbors)
+    out = np.ones((n_passes, topo.n_ranks, topo.n_neighbors), np.int32)
+    for pi in range(n_passes):
+        p = start_pass + pi
+        for r in range(topo.n_ranks):
+            lag = out[pi, r]
+            if sched is not None:
+                for w in sched.lag:
+                    if w.start_pass <= p < w.end_pass:
+                        lag[:] = np.maximum(lag, w.lag)
+                for sr, f in sched.slow:
+                    lag[srcs[r] == sr] = np.maximum(
+                        lag[srcs[r] == sr], f
+                    )
+            if bound is not None:
+                np.clip(lag, 1, max(1, int(bound)), out=lag)
+    return out
+
+
 def corrupt_mask(
     sched: ChaosSchedule,
     topo: Topology,

@@ -12,14 +12,41 @@ Fault vocabulary (all composable):
   * `flaky`        — windows `[start_pass, end_pass)` during which the
                      drop probability is raised to `max(drop_p, window p)`
                      (a link that flakes hard for a while, then recovers).
-  * `deliver_every`— k-pass delivery thinning: an edge refreshes its
-                     receive buffer at most every k passes (per-edge phase
-                     derived from the seed), i.e. staleness up to k-1
-                     extra passes. This is the deterministic stand-in for
-                     k-pass delayed delivery: a true queueing delay would
-                     need k in-flight payload copies per edge, while
-                     EventGraD's stale-buffer semantics make "late" and
-                     "thinned" equivalent from the mixing step's view.
+  * `deliver_every`— k-pass delivery THINNING (`delay=k`): an edge
+                     refreshes its receive buffer at most every k passes
+                     (per-edge phase derived from the seed), i.e.
+                     staleness up to k-1 extra passes. NOT a true
+                     queueing delay: the payloads of the skipped passes
+                     are gone forever (the receiver next sees the
+                     CURRENT pass's values, never the missed ones).
+                     Kept for drop-like staleness studies; the true
+                     queueing-delay vocabulary is `lag=`/`slow=` below,
+                     which the bounded-async engine (train(staleness=D),
+                     D >= 2) services with real per-edge delivery
+                     queues — each in-flight payload is committed on
+                     arrival, D passes deep. The two compose but model
+                     different faults: `delay=` is a lossy slow link,
+                     `lag=` a lossless late one.
+  * `lag`          — QUEUEING DELAY window: `lag=S-E@d` makes every
+                     message sent during passes [S, E) arrive d passes
+                     after its send (d >= 1; the no-fault baseline is
+                     lag 1 — the one-pass RMA asynchrony staleness=1
+                     already models). Deterministic, no random draws.
+                     Under bounded-async runs (train(staleness=D >= 2))
+                     the payload is queued per edge and committed on
+                     arrival; the effective lag is clamped to the bound
+                     D (the fast rank WAITS rather than run further
+                     ahead — that wait is what tools/straggler_ablation
+                     charges the lockstep for). Under staleness <= 1
+                     the clamp makes it a no-op in-step: the run is
+                     already synchronous, and the scheduled lag shows up
+                     only in the modeled wall-clock.
+  * `slow`         — PERSISTENT STRAGGLER: `slow=R@f` makes every
+                     message SENT by rank R arrive f passes late for
+                     the whole run (f >= 1) — the heterogeneous-fleet
+                     fault one slow host injects into a bulk-
+                     synchronous ring. Composes with `lag=` windows by
+                     max; same bound-clamp semantics.
   * `death`        — permanent peer death at pass T: from T on, the rank
                      neither sends nor receives (every edge touching it is
                      masked). Recovery is `policy.heal_ring`. NOT
@@ -69,10 +96,10 @@ Fault vocabulary (all composable):
 CLI spec grammar (comma-separated clauses, see `parse`):
 
     drop=0.2,seed=7,flaky=100-200@0.8,delay=3,die=3@500,leave=1@3,join=1@5,
-    bitflip=40-60@0.5,nanstep=2@45,preempt=6@2
+    bitflip=40-60@0.5,nanstep=2@45,preempt=6@2,lag=50-90@3,slow=2@4
 
 Multiple `flaky=` / `die=` / `leave=` / `join=` / `bitflip=` /
-`nanstep=` / `preempt=` clauses accumulate.
+`nanstep=` / `preempt=` / `lag=` / `slow=` clauses accumulate.
 """
 
 from __future__ import annotations
@@ -99,6 +126,28 @@ class FlakyWindow:
 
 
 @dataclasses.dataclass(frozen=True)
+class LagWindow:
+    """Messages sent during passes [start, end) arrive `lag` passes after
+    their send (a true queueing delay — the payload is preserved and
+    committed on arrival, unlike `deliver_every`'s thinning)."""
+
+    start_pass: int
+    end_pass: int
+    lag: int
+
+    def __post_init__(self):
+        if self.start_pass < 0 or self.end_pass < self.start_pass:
+            raise ValueError(
+                f"lag window [{self.start_pass}, {self.end_pass}) invalid"
+            )
+        if self.lag < 1:
+            raise ValueError(
+                f"lag {self.lag} invalid: delivery lag is >= 1 pass "
+                "(lag 1 is the no-fault one-pass asynchrony baseline)"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosSchedule:
     """A replayable fault schedule. `death` is ((rank, pass), ...) pairs;
     `membership` holds epoch-keyed join/leave events (membership.py
@@ -119,6 +168,13 @@ class ChaosSchedule:
     #: graceful-preemption notices: ((epoch, step), ...) — host-side
     #: like membership; the loop drains at the enclosing block boundary
     preempt: Tuple[Tuple[int, int], ...] = ()
+    #: queueing-delay windows (LagWindow tuples): messages sent in the
+    #: window arrive `lag` passes late, payload preserved — serviced by
+    #: the bounded-async engine (train(staleness=D >= 2))
+    lag: Tuple[LagWindow, ...] = ()
+    #: persistent stragglers: ((rank, lag), ...) — every message rank R
+    #: SENDS arrives `lag` passes late for the whole run
+    slow: Tuple[Tuple[int, int], ...] = ()
 
     def __post_init__(self):
         if not 0.0 <= self.drop_p <= 1.0:
@@ -153,6 +209,17 @@ class ChaosSchedule:
                     f"preempt ({e}, {s}) invalid: epoch and step are "
                     "1-based"
                 )
+        object.__setattr__(
+            self, "lag",
+            tuple(sorted(self.lag, key=lambda w: (w.start_pass, w.end_pass))),
+        )
+        object.__setattr__(self, "slow", tuple(sorted(self.slow)))
+        for r, f in self.slow:
+            if r < 0 or f < 1:
+                raise ValueError(
+                    f"slow ({r}, {f}) invalid: rank >= 0 and lag >= 1 "
+                    "(lag 1 is the no-fault asynchrony baseline)"
+                )
 
     @property
     def is_noop(self) -> bool:
@@ -169,7 +236,26 @@ class ChaosSchedule:
             and not self.bitflip
             and not self.nanstep
             and not self.preempt
+            and not self.lag
+            and not self.slow
         )
+
+    @property
+    def has_lags(self) -> bool:
+        """True when any clause can deliver a message late (the
+        bounded-async engine then services per-edge delivery queues;
+        lockstep runs see only the modeled wall-clock cost)."""
+        return bool(self.lag or self.slow)
+
+    def max_scheduled_lag(self) -> int:
+        """The largest lag any clause can schedule (1 = the no-fault
+        asynchrony baseline) — the straggler ablation's unclamped f."""
+        m = 1
+        for w in self.lag:
+            m = max(m, w.lag)
+        for _, f in self.slow:
+            m = max(m, f)
+        return m
 
     @property
     def has_bitflips(self) -> bool:
@@ -215,6 +301,12 @@ class ChaosSchedule:
             d["nanstep"] = [list(e) for e in self.nanstep]
         if self.preempt:
             d["preempt"] = [list(e) for e in self.preempt]
+        if self.lag:  # absent = legacy schedules round-trip unchanged
+            d["lag"] = [
+                [w.start_pass, w.end_pass, w.lag] for w in self.lag
+            ]
+        if self.slow:
+            d["slow"] = [list(e) for e in self.slow]
         return d
 
     @classmethod
@@ -248,6 +340,13 @@ class ChaosSchedule:
             preempt=tuple(
                 (int(e), int(s)) for e, s in d.get("preempt", ())
             ),
+            lag=tuple(
+                LagWindow(int(s), int(e), int(f))
+                for s, e, f in d.get("lag", ())
+            ),
+            slow=tuple(
+                (int(r), int(f)) for r, f in d.get("slow", ())
+            ),
         )
 
     # --- CLI spec round trip -------------------------------------------
@@ -266,6 +365,10 @@ class ChaosSchedule:
             parts.append(f"nanstep={r}@{t}")
         for e, s in self.preempt:
             parts.append(f"preempt={e}@{s}")
+        for w in self.lag:
+            parts.append(f"lag={w.start_pass}-{w.end_pass}@{w.lag}")
+        for r, f in self.slow:
+            parts.append(f"slow={r}@{f}")
         if self.membership:
             from eventgrad_tpu.chaos.membership import format_event_clause
 
@@ -277,7 +380,7 @@ class ChaosSchedule:
         """Parse the CLI grammar, e.g. `drop=0.2,seed=7,flaky=10-20@0.8`."""
         kw: Dict[str, Any] = {
             "flaky": [], "death": [], "membership": [], "bitflip": [],
-            "nanstep": [], "preempt": [],
+            "nanstep": [], "preempt": [], "lag": [], "slow": [],
         }
         for clause in spec.split(","):
             clause = clause.strip()
@@ -326,6 +429,18 @@ class ChaosSchedule:
                 elif key == "nanstep":
                     r, _, t = val.partition("@")
                     kw["nanstep"].append((int(r), int(t)))
+                elif key == "lag":
+                    # queueing-delay window `lag=S-E@d` (bare `lag=d`
+                    # delays the whole run)
+                    span, sep_at, f = val.partition("@")
+                    if sep_at:
+                        s, _, e = span.partition("-")
+                        kw["lag"].append(LagWindow(int(s), int(e), int(f)))
+                    else:
+                        kw["lag"].append(LagWindow(0, 2**31 - 1, int(val)))
+                elif key == "slow":
+                    r, _, f = val.partition("@")
+                    kw["slow"].append((int(r), int(f)))
                 elif key == "preempt":
                     # `preempt=E@S`; a bare `preempt=E` means step 1
                     # (the notice arrives as epoch E opens)
@@ -349,6 +464,8 @@ class ChaosSchedule:
         kw["bitflip"] = tuple(kw["bitflip"])
         kw["nanstep"] = tuple(kw["nanstep"])
         kw["preempt"] = tuple(kw["preempt"])
+        kw["lag"] = tuple(kw["lag"])
+        kw["slow"] = tuple(kw["slow"])
         return cls(**kw)
 
 

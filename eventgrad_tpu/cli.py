@@ -193,11 +193,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "1 = exact D-PSGD. Stabilizes aggressive horizons")
     p.add_argument("--topk-percent", type=float, default=10.0)
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
-    p.add_argument("--staleness", type=int, default=0, choices=[0, 1],
+    p.add_argument("--staleness", type=int, default=0,
                    help="1 = mix with the previous step's received buffers "
                         "(deterministic model of the reference's one-sided "
                         "RMA asynchrony; lets XLA overlap the exchange with "
-                        "compute; event algorithms only)")
+                        "compute; event algorithms only). D >= 2 = the "
+                        "bounded-async gossip engine: per-edge delivery "
+                        "queues, a rank runs up to D passes ahead of a "
+                        "late neighbor (chaos lag=/slow= clauses schedule "
+                        "the lag; eventgrad + arena only; see "
+                        "docs/chaos.md 'Bounded-async gossip & "
+                        "stragglers')")
     p.add_argument("--wire", choices=["bf16", "int8"], default=None,
                    help="compress gossip payloads on the wire: bf16 = half "
                         "the reference's f32 MPI wire bytes, int8 = a "
@@ -452,9 +458,19 @@ def main(argv=None) -> int:
         )
     if args.max_silence and args.algo not in ("eventgrad", "sp_eventgrad"):
         raise SystemExit("--max-silence applies to the event algorithms only")
+    if args.staleness < 0:
+        raise SystemExit(
+            "--staleness must be >= 0 (0 = synchronous, 1 = one-pass-"
+            "stale, D >= 2 = the bounded-async gossip engine)"
+        )
     if args.staleness:
         if args.algo not in ("eventgrad", "sp_eventgrad"):
             raise SystemExit("--staleness applies to the event algorithms only")
+        if args.staleness >= 2 and args.algo != "eventgrad":
+            raise SystemExit(
+                "--staleness >= 2 (the bounded-async bound D) is "
+                "eventgrad-only; sp_eventgrad supports staleness 0/1"
+            )
         if args.trace_file:
             raise SystemExit(
                 "--trace-file records the synchronous exchange; not "

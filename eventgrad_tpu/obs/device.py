@@ -47,6 +47,14 @@ class TelemetryState(struct.PyTreeNode):
     # integrity counters so pre-bucket snapshots restore via the
     # known-added migration path.
     bucket_bytes: jnp.ndarray = None   # type: ignore[assignment]  # f32 [n_buckets]
+    # bounded-async counters (train(staleness=D >= 2)): per-edge
+    # staleness sums (the gauge accumulates per pass; mean = /steps),
+    # a log2 histogram of the per-edge-pass staleness, and the
+    # late-commit count. Defaulted like the integrity counters so
+    # pre-bounded-async snapshots restore via the known-added path.
+    edge_staleness: jnp.ndarray = None  # type: ignore[assignment]  # f32 [n_edges]
+    staleness_hist: jnp.ndarray = None  # type: ignore[assignment]  # i32 [SILENCE_BUCKETS]
+    late_commits: jnp.ndarray = None    # type: ignore[assignment]  # i32 []
 
     @classmethod
     def init(
@@ -66,6 +74,9 @@ class TelemetryState(struct.PyTreeNode):
             wire_reject=jnp.zeros((n_edges,), jnp.int32),
             quarantined=jnp.zeros((), jnp.int32),
             bucket_bytes=jnp.zeros((max(1, n_buckets),), jnp.float32),
+            edge_staleness=jnp.zeros((n_edges,), jnp.float32),
+            staleness_hist=jnp.zeros((SILENCE_BUCKETS,), jnp.int32),
+            late_commits=jnp.zeros((), jnp.int32),
         )
 
 
@@ -92,6 +103,8 @@ def accumulate(
     wire_reject: Optional[jnp.ndarray] = None,   # bool/i32 [n_edges]
     quarantined: Optional[jnp.ndarray] = None,   # bool/i32 []
     bucket_bytes: Optional[jnp.ndarray] = None,  # f32 [n_buckets] this pass
+    edge_staleness: Optional[jnp.ndarray] = None,  # i32/f32 [n_edges]
+    late_commits: Optional[jnp.ndarray] = None,    # i32 [] this pass
 ) -> TelemetryState:
     """One pass of counter updates; omitted (None) quantities leave their
     counters untouched (the non-event algorithms pass only edge_bytes).
@@ -122,6 +135,17 @@ def accumulate(
         upd["quarantined"] = tel.quarantined + quarantined.astype(jnp.int32)
     if bucket_bytes is not None:
         upd["bucket_bytes"] = tel.bucket_bytes + bucket_bytes
+    if edge_staleness is not None:
+        upd["edge_staleness"] = (
+            tel.edge_staleness + edge_staleness.astype(jnp.float32)
+        )
+        upd["staleness_hist"] = tel.staleness_hist.at[
+            silence_bucket(edge_staleness)
+        ].add(1)
+    if late_commits is not None:
+        upd["late_commits"] = tel.late_commits + late_commits.astype(
+            jnp.int32
+        )
     return tel.replace(**upd)
 
 
@@ -182,4 +206,15 @@ def window_record(cur, prev=None):
             round(float(v), 2)
             for v in d("bucket_bytes").mean(axis=0) / denom
         ]
+    if cur.edge_staleness is not None:
+        # bounded-async riders (known-added): the per-edge staleness
+        # gauge (rank-mean per pass), its histogram, and late commits
+        rec["edge_staleness_per_step"] = [
+            round(float(v), 4)
+            for v in d("edge_staleness").mean(axis=0) / denom
+        ]
+        rec["staleness_hist"] = [
+            int(v) for v in d("staleness_hist").sum(axis=0)
+        ]
+        rec["late_commit_count"] = int(d("late_commits").sum())
     return rec

@@ -195,6 +195,38 @@ def build_report(history: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
     else:
         report["capacity_utilization"] = None
+
+    # bounded-async staleness surface (train(staleness=D >= 2),
+    # docs/chaos.md "Bounded-async gossip & stragglers"): the per-edge
+    # staleness gauge trajectory, the staleness histogram, and late
+    # commits. D <= 1 runs emit all-zero counters — report None there
+    # so legacy/lockstep reports stay unchanged.
+    stale_rows = [
+        (e, w["edge_staleness_per_step"], int(w.get("late_commit_count", 0)),
+         w.get("staleness_hist"))
+        for e, w in windows if "edge_staleness_per_step" in w
+    ]
+    if any(any(v > 0 for v in row) for _, row, _, _ in stale_rows):
+        hist_tot = None
+        for _, _, _, sh in stale_rows:
+            if sh is not None:
+                hist_tot = (
+                    [a + b for a, b in zip(hist_tot, sh)] if hist_tot
+                    else list(sh)
+                )
+        report["edge_staleness"] = {
+            "epochs": [e for e, _, _, _ in stale_rows],
+            "edges": meta.get("edges"),
+            "rows": [row for _, row, _, _ in stale_rows],
+            "late_commits": [lc for _, _, lc, _ in stale_rows],
+            "staleness_hist_total": hist_tot,
+            "staleness_bound": next(
+                (h["staleness"] for h in reversed(history)
+                 if h.get("staleness")), None
+            ),
+        }
+    else:
+        report["edge_staleness"] = None
     return report
 
 
@@ -229,5 +261,15 @@ def render_text(report: Dict[str, Any]) -> str:
         lines.append(
             f"consensus error: max {cons['max'][-1]:.3g} "
             f"(mean {cons['mean'][-1]:.3g}) at epoch {cons['epochs'][-1]}"
+        )
+    st = report.get("edge_staleness")
+    if st and st["rows"]:
+        last = st["rows"][-1]
+        names = st.get("edges") or [str(i) for i in range(len(last))]
+        worst = max(range(len(last)), key=lambda i: last[i])
+        lines.append(
+            f"bounded-async (D={st.get('staleness_bound')}): stalest "
+            f"edge {names[worst]} at {last[worst]:.2f} passes (last "
+            f"window), {sum(st['late_commits'])} late commits total"
         )
     return "\n".join(lines)

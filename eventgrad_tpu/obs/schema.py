@@ -94,6 +94,27 @@ TELEMETRY_FIELDS = {
         "path — the sum always equals the edge_bytes total (see "
         "docs/ARCHITECTURE.md 'Bucketed gossip schedule')",
     ),
+    "edge_staleness": (
+        "staleness-sum[edge]", "bounded-async runs",
+        "per-edge staleness gauge accumulated per pass (passes between "
+        "the newest committed delivery's send and now; mean = /steps) "
+        "under the bounded-async engine (train(staleness=D >= 2)) — "
+        "bounded by D plus any drop streak; also the Prometheus gauge "
+        "eventgrad_edge_staleness (docs/chaos.md 'Bounded-async gossip "
+        "& stragglers')",
+    ),
+    "staleness_hist": (
+        "edge-passes[bucket]", "bounded-async runs",
+        "log2-bucketed histogram of the per-edge-pass staleness gauge "
+        "(same bucket geometry as silence_hist)",
+    ),
+    "late_commits": (
+        "commits", "bounded-async runs",
+        "deliveries committed >= 2 passes after their send — the "
+        "genuinely-late arrivals the bound admitted (each one bitwise "
+        "a fire deferred to its arrival pass); reconciles with "
+        "EventState.late_commits",
+    ),
 }
 
 #: Host-side `obs` block attached to block-end history records
@@ -149,6 +170,17 @@ RECORD_FIELDS = {
         "per-bucket wire-real bytes per pass (rank mean) — the bucketed "
         "gossip schedule's wire split; a single entry on the "
         "monolithic path",
+    ),
+    "edge_staleness_per_step": (
+        "staleness[edge]", "bounded-async runs",
+        "per-edge mean staleness per pass over the window (rank mean) "
+        "— 1.0 is the no-fault asynchrony baseline, a persistent "
+        "straggler's edges sit at min(f, D)",
+    ),
+    "late_commit_count": (
+        "commits", "bounded-async runs",
+        "late (lag >= 2) delivery commits in this flush window, summed "
+        "over ranks",
     ),
 }
 

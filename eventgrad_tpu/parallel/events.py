@@ -207,11 +207,30 @@ class EventState(struct.PyTreeNode):
     #: budget (capacity_gate) — rolled back to re-contend next pass;
     #: int32 scalar, cumulative like num_events
     num_deferred: jnp.ndarray = None  # type: ignore[assignment]
+    #: bounded-async delivery queues (train(staleness=D) for D >= 2;
+    #: None otherwise — D <= 1 states keep the legacy structure so old
+    #: checkpoints restore unchanged): per neighbor, D slots of
+    #: (candidate flat [n] buffer, effective [L] fire bits, sent-pass
+    #: int32 scalar, late-message count int32 scalar), slot r holding
+    #: the in-flight message that commits r+1 passes from now (the
+    #: late count survives same-arrival-pass merges, where the merged
+    #: sent-pass keeps only the newest). Zero slots are no-op commits
+    #: (eff all False), so the zero init needs no special casing —
+    #: exactly the reference's zero RMA window (event.cpp:177-179).
+    pending: Any = None
+    #: int32 [n_neighbors] per-edge staleness clock: the send pass of
+    #: the newest DELIVERED exchange committed on each edge (0 = none
+    #: yet). `pass_num - edge_clock` is the per-edge staleness gauge
+    #: (obs/schema.py `edge_staleness`), bounded by D + the drop streak.
+    edge_clock: jnp.ndarray = None  # type: ignore[assignment]
+    #: cumulative int32: commits that arrived >= 2 passes after their
+    #: send — the genuinely-late deliveries the bound admitted
+    late_commits: jnp.ndarray = None  # type: ignore[assignment]
 
     @classmethod
     def init(
         cls, params: Any, topo: Topology, cfg: EventConfig,
-        arena: bool = False, buckets: int = 1,
+        arena: bool = False, buckets: int = 1, staleness: int = 0,
     ) -> "EventState":
         """`arena=True` stores the per-neighbor receive buffers as flat
         [n_params] arenas (parallel/arena.py) instead of pytrees — the
@@ -223,9 +242,29 @@ class EventState(struct.PyTreeNode):
         independently, so the state carries the per-bucket layout
         directly). Zero-initialized either way (event.cpp:177-179);
         checkpoints restore into whichever layout the run was built
-        with (a cross-layout restore fails loudly, by design)."""
+        with (a cross-layout restore fails loudly, by design).
+
+        `staleness=D` (D >= 2, arena only) additionally carries the
+        bounded-async per-edge delivery queues: D in-flight slots per
+        neighbor plus the per-edge staleness clocks and the late-commit
+        counter. The queue depth is part of the checkpoint layout like
+        the bucket count — resuming across a different D fails loudly
+        (train/loop.py names the cause)."""
         n = trees.tree_num_leaves(params)
         zeros = jnp.zeros((n,), jnp.float32)
+        depth = int(staleness) if staleness and int(staleness) >= 2 else 0
+        if depth and not arena:
+            raise ValueError(
+                "EventState.init(staleness>=2) carries flat per-edge "
+                "delivery queues and needs arena=True (the bounded-"
+                "async engine is an arena hot path)"
+            )
+        if depth and buckets and int(buckets) > 1:
+            raise ValueError(
+                "bounded-async staleness>=2 does not compose with the "
+                "bucketed buffer layout (per-edge delivery queues are "
+                "whole-wire state)"
+            )
         if arena:
             from eventgrad_tpu.parallel.arena import arena_spec
 
@@ -248,6 +287,21 @@ class EventState(struct.PyTreeNode):
                 buf0 = jnp.zeros((spec.n_total,), spec.dtype)
         else:
             buf0 = trees.tree_zeros_like(params)
+        pending = None
+        edge_clock = None
+        late_commits = None
+        if depth:
+            slot0 = (
+                buf0,  # zero candidate (immutable — sharing is fine)
+                jnp.zeros((n,), bool),  # eff: commits are no-ops
+                jnp.zeros((), jnp.int32),  # sent pass 0 = empty
+                jnp.zeros((), jnp.int32),  # late messages in the slot
+            )
+            pending = tuple(
+                tuple(slot0 for _ in range(depth)) for _ in topo.neighbors
+            )
+            edge_clock = jnp.zeros((topo.n_neighbors,), jnp.int32)
+            late_commits = jnp.zeros((), jnp.int32)
         return cls(
             thres=zeros,
             last_sent_norm=zeros,
@@ -257,6 +311,9 @@ class EventState(struct.PyTreeNode):
             bufs=tuple(buf0 for _ in topo.neighbors),
             num_events=jnp.zeros((), jnp.int32),
             num_deferred=jnp.zeros((), jnp.int32),
+            pending=pending,
+            edge_clock=edge_clock,
+            late_commits=late_commits,
         )
 
 
@@ -384,6 +441,106 @@ def commit(
         + n_neighbors * jnp.sum(fire_vec.astype(jnp.int32)),
         num_deferred=state.num_deferred + deferred,
     )
+
+
+def async_delivery_commit(
+    state: EventState,
+    cands: Tuple[jnp.ndarray, ...],
+    effs: Tuple[jnp.ndarray, ...],
+    delivered: "Any",
+    lag_vec: jnp.ndarray,
+    pass_num: jnp.ndarray,
+    spec,
+    bound: int,
+) -> Tuple[EventState, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray]:
+    """One pass of the bounded-async delivery engine (staleness=D >= 2).
+
+    Semantics: the exchange still physically runs every pass (ppermute
+    is a collective), but the received candidate COMMITS only when its
+    scheduled lag elapses — the deterministic model of a message that
+    left on time and arrived late. Three phases, per edge:
+
+      1. ARRIVALS: the queue's slot 0 (in-flight messages whose lag
+         elapses this pass) commits into the persistent receive buffer
+         with the same `where(eff, cand, stale)` select every other
+         path uses — so a late delivery is BITWISE a fire deferred to
+         its arrival pass with the sender's original payload (the
+         contract tests/test_bounded_async.py pins, the way chaos
+         pinned drop ≡ not-fired). The per-edge staleness clock
+         advances to the committed message's send pass, and commits
+         with lag >= 2 count into `late_commits`.
+      2. SHIFT: every slot's remaining delay decreases by one.
+      3. ENQUEUE: this pass's (candidate, eff) enters at slot lag-1
+         (`lag_vec` is pre-clamped to [1, D] — chaos.inject.lag_vector;
+         the clamp IS the bound: the fast rank waits rather than run
+         further ahead). Two messages landing on the same arrival pass
+         merge later-sent-wins: merged candidate
+         `where(eff_new, cand_new, cand_old)`, merged eff `old | new` —
+         committing the merge is bitwise committing old then new.
+
+    `cands`/`effs` are the flat arena exchange's per-neighbor outputs
+    (deliver/integrity verdicts already folded into `effs`);
+    `delivered` (bool [n_nb] or None = all True) is the physical
+    delivery bit that gates the clock — a chaos-dropped or
+    integrity-rejected exchange is not a delivery, so its silence keeps
+    the gauge growing. Returns (new_state, visible bufs — post-arrival,
+    what this pass mixes with, edge staleness int32 [n_nb], late
+    commits this pass int32 [])."""
+    D = int(bound)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    seg = spec.seg_expand()
+    n_nb = len(cands)
+    if delivered is None:
+        delivered = jnp.ones((n_nb,), bool)
+    sent_new = jnp.where(delivered, pass_i, jnp.int32(0))  # [n_nb]
+    # a delivered message enqueued at lag >= 2 WILL commit late; the
+    # count rides its slot so same-arrival-pass merges (whose sent-pass
+    # keeps only the newest message) still account every late one
+    late_new = (delivered & (lag_vec >= 2)).astype(jnp.int32)  # [n_nb]
+    new_bufs = []
+    new_pending = []
+    clock_out = []
+    late = jnp.zeros((), jnp.int32)
+    for i in range(n_nb):
+        slots = state.pending[i]
+        c0, e0, s0, l0 = slots[0]
+        # 1. arrivals (oldest in-flight message) commit on arrival
+        buf = jnp.where(e0[seg], c0, state.bufs[i])
+        arrived = s0 > 0
+        clock_i = jnp.where(
+            arrived, jnp.maximum(state.edge_clock[i], s0),
+            state.edge_clock[i],
+        )
+        late = late + l0
+        # 2 + 3. shift the queue and merge-insert this pass's message
+        # at its (dynamic) lag slot — D wide selects, D static
+        d = lag_vec[i]
+        eff_exp = effs[i][seg]
+        empty = (
+            jnp.zeros_like(c0), jnp.zeros_like(e0),
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+        )
+        slots_next = []
+        for r in range(D):
+            sc, se, ss, sl = slots[r + 1] if r + 1 < D else empty
+            here = (d - 1) == r
+            slots_next.append((
+                jnp.where(here & eff_exp, cands[i], sc),
+                jnp.where(here, se | effs[i], se),
+                jnp.where(here, jnp.maximum(ss, sent_new[i]), ss),
+                jnp.where(here, sl + late_new[i], sl),
+            ))
+        new_bufs.append(buf)
+        new_pending.append(tuple(slots_next))
+        clock_out.append(clock_i)
+    clock = jnp.stack(clock_out) if n_nb else state.edge_clock
+    new_state = state.replace(
+        bufs=tuple(new_bufs),
+        pending=tuple(new_pending),
+        edge_clock=clock,
+        late_commits=state.late_commits + late,
+    )
+    return new_state, tuple(new_bufs), pass_i - clock, late
 
 
 def capacity_gate(

@@ -77,6 +77,23 @@ def _device_copy(tree: Any) -> Any:
     return jax.tree.map(jnp.copy, tree)
 
 
+def _snapshot_async_depth(raw: Any) -> int:
+    """The bounded-async queue depth D a peeked snapshot was written
+    with (0 = no per-edge delivery queues, i.e. staleness <= 1) —
+    inferred from the leaf paths, so it works on the template-free
+    orbax restore regardless of container kinds."""
+    import re as _re
+
+    from eventgrad_tpu.utils.checkpoint import _path_name
+
+    slots = set()
+    for kp, _ in jax.tree_util.tree_flatten_with_path(raw)[0]:
+        m = _re.match(r"state/event/pending/\d+/(\d+)/", _path_name(kp))
+        if m:
+            slots.add(int(m.group(1)))
+    return max(slots) + 1 if slots else 0
+
+
 def _loss_record(pass_base: int, s_i: int, r: int,
                  loss_all: np.ndarray) -> Dict[str, Any]:
     """Per-(pass, rank) loss record — the shared schema of the send trace's
@@ -332,6 +349,20 @@ def train(
     unmeasured backends demote to the monolithic fused path with a
     warning. History records carry `buckets` and
     `sent_bytes_wire_real_per_bucket`.
+
+    staleness (0 | 1 | D >= 2) picks the exchange's asynchrony model
+    (train/steps.py): 0 mixes this pass's exchange, 1 the previous
+    pass's (the deterministic RMA model), and D >= 2 runs the
+    BOUNDED-ASYNC gossip engine — per-edge delivery queues carried in
+    EventState (depth D), chaos `lag=`/`slow=` clauses delivering
+    messages late with commit-on-arrival, a rank running up to D
+    passes ahead of a late neighbor (docs/chaos.md "Bounded-async
+    gossip & stragglers"). eventgrad + arena only; not combinable with
+    bucketed/fused_update/trace_file. The queue depth is part of the
+    checkpoint layout: resuming a D-clock snapshot into a run with a
+    different D fails loudly, both directions. History records gain
+    `staleness`, `edge_staleness_max`, and `late_commits`;
+    tools/straggler_ablation.py is the proof instrument.
 
     With `checkpoint_dir`, the full gossip TrainState (+ epoch counter) is
     snapshotted every `save_every` epochs (always at the end); `resume=True`
@@ -801,9 +832,50 @@ def train(
                 RuntimeWarning,
             )
             bucketed_k = 1
+    # --- bounded-async resolution (train/steps.py staleness=D >= 2):
+    # the EventState layout grows D-deep per-edge delivery queues, so
+    # the combinability guards must fire BEFORE state init
+    staleness = int(staleness)
+    if staleness >= 2:
+        if algo != "eventgrad":
+            raise ValueError(
+                f"staleness={staleness} (the bounded-async bound D) "
+                "rides the event exchange's per-edge delivery queues "
+                f"(algo='eventgrad'); got algo={algo!r} — sp_eventgrad "
+                "supports staleness 0/1 only"
+            )
+        if not arena_on:
+            raise ValueError(
+                f"staleness={staleness} carries its delivery queues as "
+                "flat arena buffers, but this run resolved arena OFF "
+                "(explicit arena=False, a sharded topology, or "
+                "heterogeneous parameter dtypes) — drop staleness>=2 "
+                "or make the run arena-eligible"
+            )
+        if bucketed_k > 1:
+            raise ValueError(
+                f"staleness={staleness} is not combinable with "
+                "bucketed=K: the per-edge delivery queues are "
+                "whole-wire state, which the bucketed schedule splits "
+                "K ways"
+            )
+        if fused_update:
+            raise ValueError(
+                f"staleness={staleness} is not combinable with "
+                "fused_update: the kernel bakes in a mix-stale bool, "
+                "not a D-deep delivery queue"
+            )
+        if memb_on:
+            raise ValueError(
+                f"staleness={staleness} does not compose with "
+                "membership transitions: a joining rank would inherit "
+                "its bootstrap source's in-flight delivery queues — "
+                "run bounded-async without membership, or staleness<=1"
+            )
     state = init_fn(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
         input_dtype=input_dtype, arena=arena_on, bucketed=bucketed_k,
+        staleness=staleness if algo == "eventgrad" else 0,
     )
     if chaos_sched is not None:
         # per-edge receiver-side health, stacked like every other state
@@ -887,6 +959,33 @@ def train(
                 # snapshot with a complete demoted twin recovers loudly
                 # instead of failing the service
                 memb_raw = checkpoint.peek(found)
+
+            # bounded-async D-clock layout guard, BOTH directions: the
+            # queue depth is part of the checkpoint layout like the
+            # bucket count, and the shrink direction would otherwise
+            # restore SILENTLY (the path graft ignores extra snapshot
+            # leaves), dropping in-flight messages on the floor
+            snap_depth = _snapshot_async_depth(memb_raw)
+            want_depth = staleness if staleness >= 2 else 0
+            if snap_depth != want_depth and algo == "eventgrad":
+                snap_word = (
+                    f"staleness={snap_depth} (bounded-async, "
+                    f"{snap_depth}-deep per-edge delivery queues)"
+                    if snap_depth else "staleness<=1 (no delivery queues)"
+                )
+                raise RuntimeError(
+                    f"checkpoint restore failed with staleness="
+                    f"{staleness}: this snapshot was written by a "
+                    f"{snap_word} run, and the bounded-async queue "
+                    "depth D is part of the EventState layout — "
+                    "resuming across a different D would "
+                    + ("silently drop the snapshot's in-flight "
+                       "messages" if snap_depth else
+                       "fabricate empty in-flight queues")
+                    + "; resume with the snapshot's original "
+                    f"staleness={'%d' % snap_depth if snap_depth >= 2 else '0/1'}"
+                    " setting, then re-snapshot to migrate"
+                )
 
             def _restore(tmpl_state):
                 """(restored, trace_carry-or-None): a snapshot from before
@@ -1386,6 +1485,17 @@ def train(
                         n_ranks_blk,
                     )
                 rec["fired_frac"] = float(m_e["fired_frac"].mean())
+                if "edge_staleness" in m_e:
+                    # bounded-async failure surface (staleness=D >= 2):
+                    # end-of-epoch per-edge staleness peak and the
+                    # cumulative late-delivery commits
+                    rec["staleness"] = staleness
+                    rec["edge_staleness_max"] = int(
+                        np.asarray(m_e["edge_staleness"])[-1].max()
+                    )
+                    rec["late_commits"] = int(
+                        np.asarray(m_e["late_commits"])[-1].sum()
+                    )
             if memb_on:
                 if not history:  # replayability: the membership log
                     # alone reproduces the final state bitwise
@@ -1477,6 +1587,20 @@ def train(
             # Prometheus faces of the elasticity story: the live rank
             # count and the cumulative transition counter
             registry.gauge("active_ranks", n_ranks_blk)
+            if "edge_staleness" in m:
+                # bounded-async: the per-edge staleness gauge
+                # (eventgrad_edge_staleness{edge=...}, max over ranks
+                # at the block's last pass) and cumulative late commits
+                es = np.asarray(m["edge_staleness"])[-1]
+                for k, nb in enumerate(topo.neighbors):
+                    registry.gauge(
+                        "edge_staleness", float(es[..., k].max()),
+                        labels={"edge": nb.name},
+                    )
+                registry.gauge(
+                    "late_commits_total",
+                    float(np.asarray(m["late_commits"])[-1].sum()),
+                )
             if memb_engine is not None:
                 registry.gauge(
                     "membership_transitions_total",

@@ -59,6 +59,7 @@ def init_train_state(
     input_dtype=jnp.float32,
     arena: bool = False,
     bucketed: int = 1,
+    staleness: int = 0,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks.
 
@@ -92,7 +93,7 @@ def init_train_state(
             # flat-arena step's layout; see EventState.init)
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
-                buckets=bucketed,
+                buckets=bucketed, staleness=staleness,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)
@@ -125,6 +126,7 @@ def init_train_state_spmd(
     input_dtype=jnp.float32,
     arena: bool = False,
     bucketed: int = 1,
+    staleness: int = 0,
 ) -> TrainState:
     """Per-rank initialization inside the SPMD context — required when the
     topology has `sharded_axes` (tensor/expert parallelism): sharded layers
@@ -144,7 +146,7 @@ def init_train_state_spmd(
         if algo in ("eventgrad", "sp_eventgrad"):
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
-                buckets=bucketed,
+                buckets=bucketed, staleness=staleness,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)

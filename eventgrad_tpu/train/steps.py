@@ -49,7 +49,7 @@ from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel.events import (
-    EventConfig, capacity_gate, commit, propose,
+    EventConfig, async_delivery_commit, capacity_gate, commit, propose,
 )
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
 from eventgrad_tpu.parallel.topology import Topology
@@ -145,6 +145,23 @@ def make_train_step(
     frees XLA to overlap the ppermute with the next step's compute, since
     nothing in the current step consumes its result.
 
+    staleness=D for D >= 2 (eventgrad + arena only) is the BOUNDED-ASYNC
+    gossip engine: each edge carries a D-slot delivery queue in
+    EventState.pending, a received candidate commits when its scheduled
+    lag (chaos `lag=`/`slow=` clauses, clamped to D —
+    chaos.inject.lag_vector) elapses, and the mix reads whatever has
+    landed — a rank runs up to D passes ahead of a late neighbor
+    instead of stalling the ring. A late delivery is committed on
+    arrival through the same `where(eff, cand, stale)` select as every
+    other path, so late ≡ a fire deferred to its arrival pass, bitwise
+    (events.async_delivery_commit; tests/test_bounded_async.py). With
+    no lag schedule every edge runs at the baseline lag 1, and the
+    trajectory is bitwise staleness=1's. Per-edge staleness clocks and
+    a late-commit counter ride the metrics (`edge_staleness`,
+    `late_commits`) and — with obs — the telemetry. Not combinable
+    with bucketed/fused/trace; see docs/chaos.md "Bounded-async gossip
+    & stragglers".
+
     trace=True (event algorithms only) adds per-parameter send-side trace
     vectors to the metrics — current norm, threshold, fired bit, leaf-major
     order — the reference's `file_write=1` send{r}.txt instrumentation
@@ -233,18 +250,57 @@ def make_train_step(
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
-    if staleness not in (0, 1):
-        raise ValueError(f"staleness must be 0 or 1, got {staleness}")
+    staleness = int(staleness)
+    if staleness < 0:
+        raise ValueError(
+            f"staleness must be >= 0, got {staleness}: 0 = synchronous "
+            "mixing, 1 = one-pass-stale (the deterministic RMA model), "
+            "D >= 2 = the bounded-async gossip engine (a rank runs up "
+            "to D passes ahead of a late neighbor; algo='eventgrad' "
+            "with arena=True)"
+        )
     if staleness and algo not in ("eventgrad", "sp_eventgrad"):
         raise ValueError(
-            "staleness models the one-sided RMA asynchrony of the event "
-            "algorithms; allreduce/dpsgd are synchronous in the reference"
+            f"staleness={staleness} models the one-sided RMA asynchrony "
+            "of the event algorithms (eventgrad, sp_eventgrad; the "
+            "bounded-async D >= 2 engine is eventgrad-only); "
+            "allreduce/dpsgd are synchronous in the reference"
         )
     if staleness and trace:
         raise ValueError(
             "trace records model the synchronous exchange; not available "
             "with staleness > 0"
         )
+    if staleness >= 2:
+        # the bounded-async engine: per-edge delivery queues carried in
+        # EventState.pending (D slots deep), commit-on-arrival semantics
+        if algo != "eventgrad":
+            raise ValueError(
+                f"staleness={staleness} (the bounded-async bound D) "
+                "rides the event exchange's per-edge delivery queues "
+                f"(algo='eventgrad'); got algo={algo!r} — sp_eventgrad "
+                "supports staleness 0/1 only"
+            )
+        if not arena:
+            raise ValueError(
+                f"staleness={staleness} carries its delivery queues as "
+                "flat arena buffers — algo='eventgrad' needs arena=True "
+                "(the loop's auto mode resolves this; see "
+                "train(staleness=...))"
+            )
+        if bucketed and int(bucketed) > 1:
+            raise ValueError(
+                f"staleness={staleness} is not combinable with "
+                "bucketed=K: the per-edge delivery queues are "
+                "whole-wire state, which the bucketed schedule splits "
+                "K ways — use staleness<=1 or bucketed=None"
+            )
+        if fused_sgd is not None:
+            raise ValueError(
+                f"staleness={staleness} is not combinable with the "
+                "fused update tail: the kernel bakes in a mix-stale "
+                "bool, not a D-deep delivery queue"
+            )
     if chaos is not None and algo not in ("dpsgd", "eventgrad"):
         raise ValueError(
             "chaos injection targets the gossip exchange algorithms "
@@ -528,6 +584,10 @@ def make_train_step(
         # the event proposal and the EFFECTIVE (post-gate) fire vector
         obs_prop = None
         obs_fire_vec = None
+        # bounded-async outputs (staleness >= 2 only): per-edge
+        # staleness gauge [n_nb] and this pass's late-commit count
+        edge_stale = None
+        late_now = None
 
         # flat-arena lift (static, trace-time decision): one contiguous
         # [n_params] buffer per rank carries the gossip hot path; the
@@ -538,6 +598,13 @@ def make_train_step(
             spec is not None and spec.homogeneous and spec.n_leaves
             and algo in ("dpsgd", "eventgrad")  # the consuming algos
         )
+        if staleness >= 2 and not use_arena:
+            raise ValueError(
+                f"staleness={staleness} (bounded-async) needs the "
+                "flat-arena hot path, and this model's parameters are "
+                "not arena-eligible (heterogeneous dtypes?) — use "
+                "staleness<=1"
+            )
         arena_bufs = None    # flat neighbor buffers for the flat mix/tail
         arena_pending = None # (cands, effs, lasts) awaiting the fused commit
         arena_fire_vec = None
@@ -972,6 +1039,29 @@ def make_train_step(
                 # receive-commit fuses into the mix+SGD kernel below
                 # (fused_mix_commit): the stale buffers are read once
                 arena_pending = (cands, effs, lasts)
+            elif staleness >= 2:
+                # bounded-async engine: this pass's candidates enter the
+                # per-edge delivery queues at their scheduled lag
+                # (chaos lag=/slow= clauses, clamped to the bound D);
+                # whatever arrives this pass commits, and the mix reads
+                # the post-arrival buffers — a late delivery is bitwise
+                # a fire deferred to its arrival pass
+                with _phase("commit_mix"):
+                    lag_vec_e = chaos_inject.lag_vector(
+                        chaos, topo, pass_num, bound=staleness
+                    )
+                    delivered_bits = deliver
+                    if oks is not None:
+                        delivered_bits = (
+                            oks if delivered_bits is None
+                            else delivered_bits & oks
+                        )
+                    event_state, arena_bufs, edge_stale, late_now = (
+                        async_delivery_commit(
+                            event_state, cands, effs, delivered_bits,
+                            lag_vec_e, pass_num, spec, staleness,
+                        )
+                    )
             else:
                 with _phase("commit_mix"):
                     new_bufs = collectives.commit_bufs_flat(
@@ -1353,6 +1443,8 @@ def make_train_step(
                     bucket_bytes=per_bucket_tel,
                     wire_reject=(~oks if oks is not None else None),
                     quarantined=quar_eff,
+                    edge_staleness=edge_stale,
+                    late_commits=late_now,
                 )
             else:
                 telemetry = obs_device.accumulate(
@@ -1392,6 +1484,12 @@ def make_train_step(
             # per-bucket wire truth of the bucketed schedule — static
             # per step (the sum is sent_bytes_wire_real exactly)
             metrics["sent_bytes_wire_real_per_bucket"] = wire_real_bucket
+        if edge_stale is not None:
+            # bounded-async failure surface: how stale each edge's view
+            # is (passes since the newest committed delivery was sent)
+            # and the cumulative late (lag >= 2) commits
+            metrics["edge_staleness"] = edge_stale  # int32 [n_nb]
+            metrics["late_commits"] = event_state.late_commits
         if chaos is not None:
             metrics["edge_silence"] = health.silence  # int32 [n_nb]
             metrics["chaos_drops"] = health.drops  # cumulative int32

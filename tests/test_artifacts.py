@@ -60,6 +60,11 @@ def test_repo_artifacts_all_valid():
     # geometry with the seeded mesh oracle caught, and the 64-rank
     # scale leg's wire bytes exact (MESH_ABLATION_SCHEMA)
     assert "mesh_ablation_cpu.json" in names
+    # the bounded-async proof (ISSUE 15): under an injected persistent
+    # straggler, D >= 2 strictly beats the lockstep's modeled step
+    # time at a <= 0.5 pt accuracy gap, with every bounded leg
+    # replaying bitwise (STRAGGLER_ABLATION_SCHEMA)
+    assert "straggler_ablation_cpu.json" in names
     assert out["errors"] == []
 
 

@@ -1,0 +1,397 @@
+"""Bounded-async gossip (ISSUE 15): per-edge staleness clocks, delivery
+queues, and the lockstep-shedding contract.
+
+The three-way bitwise contract of `train(staleness=D)` for D >= 2:
+
+  (a) D <= 1 is bitwise-unchanged vs today's step — the legacy code
+      path is untouched, and D=2 under the all-baseline lag schedule
+      reproduces staleness=1 EXACTLY (every message lands one pass
+      late, which is what staleness=1 already models);
+  (b) a LATE delivery is committed on arrival through the same
+      `where(eff, cand, stale)` select as a synchronous one, so late
+      ≡ a fire deferred to its arrival pass with the sender's original
+      payload — pinned here at the `async_delivery_commit` op level
+      the same way chaos pinned drop ≡ not-fired;
+  (c) the whole straggler story replays bitwise from its seed
+      (tools/straggler_ablation.py's committed artifact re-proves it).
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from eventgrad_tpu.chaos import inject as chaos_inject
+from eventgrad_tpu.chaos import monitor as chaos_monitor
+from eventgrad_tpu.chaos.schedule import ChaosSchedule, LagWindow
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.data.sharding import batched_epoch
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel import arena as arena_lib
+from eventgrad_tpu.parallel.events import (
+    EventConfig, EventState, async_delivery_commit,
+)
+from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+from eventgrad_tpu.train.state import init_train_state
+from eventgrad_tpu.train.steps import make_train_step
+
+N_RANKS = 4
+IN_SHAPE = (8, 8, 1)
+CFG = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2,
+                  max_silence=4)
+MODEL = dict(hidden=8)
+
+
+# --- unit level: the delivery-queue state machine ----------------------
+
+
+def _unit_state(D, n=6, L=2, n_nb=1):
+    """A hand-built 1-neighbor EventState with a D-deep queue over a
+    tiny 2-leaf arena ([4] + [2] elements)."""
+    params = {"a": jnp.zeros((4,)), "b": jnp.zeros((2,))}
+    spec = arena_lib.arena_spec(params)
+    topo = Ring(2)
+    st = EventState.init(params, topo, CFG, arena=True, staleness=D)
+    # Ring(2) has 2 neighbors; keep neighbor 0 only for the unit
+    st = st.replace(
+        bufs=st.bufs[:n_nb], pending=st.pending[:n_nb],
+        edge_clock=st.edge_clock[:n_nb],
+    )
+    return st, spec
+
+
+def _commit(st, spec, D, pass_num, cand, eff, lag, delivered=True):
+    return async_delivery_commit(
+        st,
+        (jnp.asarray(cand, jnp.float32),),
+        (jnp.asarray(eff, bool),),
+        jnp.asarray([delivered], bool),
+        jnp.asarray([lag], jnp.int32),
+        jnp.int32(pass_num),
+        spec,
+        D,
+    )
+
+
+def test_commit_on_arrival_is_deferred_fire_bitwise():
+    """A message sent at pass t with lag d leaves the buffer untouched
+    for d-1 passes and commits at pass t+d as EXACTLY
+    `where(eff, sender's pass-t payload, stale)` — a deferred fire."""
+    D = 3
+    st, spec = _unit_state(D)
+    payload = np.arange(6, dtype=np.float32) + 1.0
+    eff = [True, False]  # leaf a fired, leaf b did not
+    # pass 1: enqueue at lag 3 — nothing visible
+    st, bufs, stale, late = _commit(st, spec, D, 1, payload, eff, 3)
+    np.testing.assert_array_equal(np.asarray(bufs[0]), np.zeros(6))
+    # passes 2, 3: quiet exchanges (nothing fired) — the lag-3 message
+    # from pass 1 is still in flight, the buffer stays untouched
+    for p in (2, 3):
+        st, bufs, stale, late = _commit(
+            st, spec, D, p, np.zeros(6), [False, False], 1,
+        )
+        np.testing.assert_array_equal(np.asarray(bufs[0]), np.zeros(6))
+    # pass 4: arrival — the deferred-fire select, bitwise
+    st, bufs, stale, late = _commit(
+        st, spec, D, 4, np.zeros(6), [False, False], 1,
+    )
+    seg = spec.seg_expand()
+    expect = np.where(
+        np.asarray(jnp.asarray([True, False])[seg]), payload, 0.0
+    )
+    np.testing.assert_array_equal(np.asarray(bufs[0]), expect)
+    # leaf b (not fired) stayed stale; the commit counted as late
+    assert int(late) == 1
+    assert int(st.late_commits) == 1
+
+
+def test_clock_advance_and_staleness_gauge():
+    """The per-edge clock tracks the newest DELIVERED send; drops keep
+    the gauge growing, deliveries snap it back to the lag."""
+    D = 2
+    st, spec = _unit_state(D)
+    gauges = []
+    for p in range(1, 6):
+        delivered = p != 3  # pass 3's exchange is dropped
+        st, bufs, stale, _ = _commit(
+            st, spec, D, p, np.zeros(6), [False, False], 1,
+            delivered=delivered,
+        )
+        gauges.append(int(stale[0]))
+    # pass 1: nothing committed yet (clock 0) -> gauge 1; from pass 2
+    # the lag-1 deliveries hold the gauge at 1, except pass 4 where the
+    # dropped pass-3 message leaves the clock at 2 (gauge 4 - 2 = 2)
+    assert gauges == [1, 1, 1, 2, 1]
+
+
+def test_same_pass_merge_later_sent_wins():
+    """Two in-flight messages arriving on the same pass merge
+    later-sent-wins: committing the merge == committing old then new."""
+    D = 2
+    st, spec = _unit_state(D)
+    old = np.full(6, 5.0, np.float32)
+    new = np.full(6, 9.0, np.float32)
+    # pass 1: lag 2 (arrives pass 3), both leaves fired
+    st, bufs, _, _ = _commit(st, spec, D, 1, old, [True, True], 2)
+    # pass 2: lag 1 (arrives pass 3 too), only leaf b fired
+    st, bufs, _, _ = _commit(st, spec, D, 2, new, [False, True], 1)
+    # pass 3: leaf a shows the OLD payload (only the old message fired
+    # it), leaf b the NEW one (later-sent wins)
+    st, bufs, _, late = _commit(
+        st, spec, D, 3, np.zeros(6), [False, False], 1,
+    )
+    got = np.asarray(bufs[0])
+    np.testing.assert_array_equal(got[:4], old[:4])
+    np.testing.assert_array_equal(got[4:], new[4:])
+    # exactly one of the two merged arrivals was late (the lag-2 one)
+    assert int(st.late_commits) == 1
+
+
+def test_lag_vector_bound_enforcement():
+    """Scheduled lag beyond the bound clamps to D — the rank waits
+    instead of running further ahead — and lag_table(bound=) replays
+    the exact in-step values while bound=None exposes the raw f."""
+    topo = Ring(N_RANKS)
+    sched = ChaosSchedule(seed=0, slow=((2, 9),), lag=(LagWindow(5, 8, 3),))
+    for D in (2, 4):
+        tab = chaos_inject.lag_table(sched, topo, 10, bound=D)
+        for p in range(1, 11):
+            for r in range(N_RANKS):
+                vec = np.asarray(jax.jit(
+                    lambda pp, ss: chaos_inject.lag_vector(
+                        sched, topo, pp, bound=D, srcs=ss,
+                    )
+                )(
+                    jnp.int32(p),
+                    jnp.asarray([
+                        topo.neighbor_source(r, nb) for nb in topo.neighbors
+                    ], jnp.int32),
+                ))
+                np.testing.assert_array_equal(vec, tab[p - 1, r])
+        assert tab.max() == D  # f=9 clamped to the bound
+    raw = chaos_inject.lag_table(sched, topo, 10, bound=None)
+    assert raw.max() == 9  # the unclamped network truth
+    assert raw[5, 0].min() >= 3  # the lag window covers every edge
+
+
+# --- step level: parity and the straggler surface ----------------------
+
+
+def _batches(steps=5, seed=3):
+    x, y = synthetic_dataset(N_RANKS * 8 * steps, IN_SHAPE, seed=seed)
+    xb, yb = batched_epoch(x, y, N_RANKS, 8)
+    return [
+        (jnp.asarray(xb[:, s]), jnp.asarray(yb[:, s])) for s in range(steps)
+    ]
+
+
+def _run(staleness, chaos=None, gossip_wire="dense", wire=None, steps=5):
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05)
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        staleness=staleness,
+    )
+    if chaos is not None:
+        state = state.replace(
+            chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
+        )
+    capacity = None
+    if gossip_wire == "compact":
+        from eventgrad_tpu.utils import trees
+        capacity = trees.tree_count_params(state.params) // topo.n_ranks
+    step = make_train_step(
+        model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
+        staleness=staleness, chaos=chaos, gossip_wire=gossip_wire,
+        compact_capacity=capacity, wire=wire,
+    )
+    lifted = jax.jit(spmd(step, topo))
+    m = None
+    for b in _batches(steps):
+        state, m = lifted(state, b)
+    return state, m
+
+
+@pytest.mark.parametrize("wire", [None, "int8"])
+@pytest.mark.parametrize("gossip_wire", ["dense", "compact"])
+def test_baseline_lag_reproduces_staleness1_bitwise(gossip_wire, wire):
+    """D=2 with no lag schedule == staleness=1 bitwise on params,
+    optimizer, event trigger state, receive buffers, and every shared
+    metric: with every message exactly one pass late, the bounded
+    engine IS the one-pass-stale model."""
+    s1, m1 = _run(1, gossip_wire=gossip_wire, wire=wire)
+    s2, m2 = _run(2, gossip_wire=gossip_wire, wire=wire)
+    for field in ("params", "opt_state", "batch_stats"):
+        for a, b in zip(jax.tree.leaves(getattr(s1, field)),
+                        jax.tree.leaves(getattr(s2, field))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for attr in ("thres", "last_sent_norm", "slopes", "num_events",
+                 "num_deferred", "bufs"):
+        for a, b in zip(jax.tree.leaves(getattr(s1.event, attr)),
+                        jax.tree.leaves(getattr(s2.event, attr))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m1:  # every legacy metric unchanged; D=2 only ADDS keys
+        np.testing.assert_array_equal(
+            np.asarray(m1[k]), np.asarray(m2[k]), err_msg=k
+        )
+    assert set(m2) - set(m1) == {"edge_staleness", "late_commits"}
+    # no late deliveries at the baseline lag
+    assert int(np.asarray(m2["late_commits"]).sum()) == 0
+    assert np.asarray(m2["edge_staleness"]).max() <= 1
+
+
+def test_straggler_staleness_clamps_at_bound():
+    """A slow=R@f straggler with f beyond the bound: the affected
+    edges' staleness gauge plateaus at D (the clamp IS the bound), the
+    late-commit counter grows, and training stays finite."""
+    sched = ChaosSchedule(seed=5, slow=((1, 7),))
+    for D in (2, 4):
+        state, m = _run(D, chaos=sched, steps=8)
+        es = np.asarray(m["edge_staleness"])  # [n_ranks, n_nb]
+        assert es.max() == D  # f=7 clamped to the bound
+        assert int(np.asarray(m["late_commits"]).sum()) > 0
+        assert np.isfinite(np.asarray(m["loss"])).all()
+        # only the straggler's two ring neighbors see stale edges
+        stale_rows = sorted(np.argwhere(es == D)[:, 0].tolist())
+        assert set(stale_rows) == {0, 2}  # ranks adjacent to rank 1
+
+
+def test_chaos_drop_composes_with_lag_queue():
+    """Drops AND lags on the same run: a dropped message never commits
+    (its edge's gauge keeps growing past the lag), and the run stays
+    deterministic — the same seed replays bitwise."""
+    sched = ChaosSchedule(seed=9, drop_p=0.3, slow=((2, 3),))
+    s_a, m_a = _run(4, chaos=sched, steps=6)
+    s_b, m_b = _run(4, chaos=sched, steps=6)
+    for a, b in zip(jax.tree.leaves(s_a), jax.tree.leaves(s_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m_a:
+        np.testing.assert_array_equal(
+            np.asarray(m_a[k]), np.asarray(m_b[k]), err_msg=k
+        )
+
+
+def test_integrity_rejects_compose_with_lag_queue():
+    """Integrity verdicts fold into the queue like drops: a rejected
+    payload enqueues not-fired (reject ≡ not delivered — the clock
+    does not advance on it), the defenses and the bounded engine run
+    in one step, and the composed run replays bitwise."""
+    x, y = synthetic_dataset(256, IN_SHAPE, seed=3)
+    kw = dict(
+        algo="eventgrad", epochs=2, batch_size=8, event_cfg=CFG, seed=0,
+        log_every_epoch=False, staleness=2,
+        chaos="slow=1@3,bitflip=5-10@1.0,seed=5", integrity="on",
+    )
+    s_a, h_a = train(MLP(**MODEL), Ring(N_RANKS), x, y, **kw)
+    r = h_a[-1]
+    assert r["wire_rejects"] > 0 and r["late_commits"] > 0
+    assert r["edge_staleness_max"] == 2
+    assert np.isfinite(r["loss"])
+    s_b, _ = train(MLP(**MODEL), Ring(N_RANKS), x, y, **kw)
+    for a, b in zip(jax.tree.leaves(s_a.params), jax.tree.leaves(s_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- guards (satellite: the new validation story) ----------------------
+
+
+def test_bounded_async_guards():
+    topo = Ring(N_RANKS)
+    tx = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="bounded-async"):
+        make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=-1)
+    with pytest.raises(ValueError, match="staleness 0/1 only"):
+        make_train_step(MLP(**MODEL), tx, topo, "sp_eventgrad",
+                        staleness=2)
+    with pytest.raises(ValueError, match="arena=True"):
+        make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2)
+    with pytest.raises(ValueError, match="bucketed"):
+        make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2,
+                        arena=True, bucketed=2)
+    with pytest.raises(ValueError, match="fused"):
+        make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2,
+                        arena=True, fused_sgd=(0.05, 0.0))
+    # the legacy guards keep their meaning
+    with pytest.raises(ValueError, match="event"):
+        make_train_step(MLP(**MODEL), tx, topo, "dpsgd", staleness=1)
+    # loop-level: membership transitions don't compose (a newcomer
+    # would inherit its bootstrap source's in-flight queues)
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=3)
+    with pytest.raises(ValueError, match="membership"):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, algo="eventgrad",
+              epochs=2, batch_size=4, event_cfg=CFG, seed=0,
+              log_every_epoch=False, staleness=2,
+              membership="leave=1@1")
+
+
+def test_resume_across_staleness_depth_fails_loudly(tmp_path):
+    """The queue depth D is checkpoint layout, like the bucket count:
+    resuming across a different D fails LOUDLY in BOTH directions
+    (the shrink direction would otherwise restore silently, dropping
+    in-flight messages)."""
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=3)
+    common = dict(
+        algo="eventgrad", epochs=1, batch_size=4, event_cfg=CFG, seed=0,
+        log_every_epoch=False, save_every=1,
+    )
+    d1 = str(tmp_path / "stale2")
+    train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1,
+          staleness=2, **common)
+    # D=2 snapshot -> D=0 (the silent-shrink direction)
+    with pytest.raises(RuntimeError, match="staleness"):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1,
+              resume=True, **{**common, "epochs": 2})
+    # D=2 snapshot -> D=4 (depth mismatch)
+    with pytest.raises(RuntimeError, match="staleness"):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1,
+              resume=True, staleness=4, **{**common, "epochs": 2})
+    d2 = str(tmp_path / "mono")
+    train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d2, **common)
+    # legacy snapshot -> D=2 (the grow direction)
+    with pytest.raises(RuntimeError, match="staleness"):
+        train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d2,
+              resume=True, staleness=2, **{**common, "epochs": 2})
+    # same-D resume round-trips
+    s2, h2 = train(MLP(**MODEL), Ring(N_RANKS), x, y, checkpoint_dir=d1,
+                   resume=True, staleness=2, **{**common, "epochs": 2})
+    assert [r["epoch"] for r in h2] == [2]
+
+
+# --- the ablation tool's fast leg (tier-1 smoke) -----------------------
+
+
+def test_straggler_ablation_fast_leg_schema_valid(tmp_path):
+    """The proof instrument's --fast leg runs end to end and its output
+    validates against STRAGGLER_ABLATION_SCHEMA — the same gates the
+    committed artifact is held to."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "straggler_ablation",
+        os.path.join(root, "tools", "straggler_ablation.py"),
+    )
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    va_spec = importlib.util.spec_from_file_location(
+        "validate_artifacts",
+        os.path.join(root, "tools", "validate_artifacts.py"),
+    )
+    va = importlib.util.module_from_spec(va_spec)
+    va_spec.loader.exec_module(va)
+
+    out = str(tmp_path / "straggler_fast.json")
+    assert tool.main(["--fast", "--out", out]) == 0
+    with open(out) as f:
+        rec = json.load(f)
+    errs = va.validate(rec, va.STRAGGLER_ABLATION_SCHEMA)
+    assert errs == [], errs
+    assert rec["bounded_async_beats_lockstep"]
+    assert any(leg["staleness"] >= 2 and leg["late_commits"] > 0
+               for leg in rec["legs"])

@@ -61,6 +61,43 @@ def test_schedule_spec_and_dict_round_trip():
         ChaosSchedule.parse("bogus")
 
 
+def test_delay_and_lag_round_trip_side_by_side():
+    """The two delay vocabularies round-trip independently: `delay=k`
+    (deliver_every — k-pass THINNING, skipped payloads lost) and the
+    true queueing-delay clauses `lag=S-E@d` / `slow=R@f` (payload
+    preserved, committed on arrival by the bounded-async engine) —
+    documented side by side in chaos/schedule.py."""
+    from eventgrad_tpu.chaos.schedule import LagWindow
+
+    # thinning alone
+    thin = ChaosSchedule(seed=1, deliver_every=4)
+    assert ChaosSchedule.parse(thin.to_spec()) == thin
+    assert ChaosSchedule.from_dict(thin.to_dict()) == thin
+    assert "delay=4" in thin.to_spec()
+    # queueing delay alone
+    lagged = ChaosSchedule(
+        seed=1, lag=(LagWindow(50, 90, 3),), slow=((2, 6),),
+    )
+    assert ChaosSchedule.parse(lagged.to_spec()) == lagged
+    assert ChaosSchedule.from_dict(lagged.to_dict()) == lagged
+    assert "lag=50-90@3" in lagged.to_spec()
+    assert "slow=2@6" in lagged.to_spec()
+    assert lagged.has_lags and not lagged.is_noop
+    assert lagged.max_scheduled_lag() == 6
+    # both at once (they model different faults and compose)
+    both = ChaosSchedule.parse("seed=1,delay=4,lag=50-90@3,slow=2@6")
+    assert both.deliver_every == 4 and both.has_lags
+    assert ChaosSchedule.parse(both.to_spec()) == both
+    # bare lag=d covers the whole run; legacy dicts (no lag keys)
+    # round-trip unchanged
+    assert ChaosSchedule.parse("lag=2").max_scheduled_lag() == 2
+    assert "lag" not in thin.to_dict() and "slow" not in thin.to_dict()
+    with pytest.raises(ValueError):
+        ChaosSchedule.parse("lag=10-20@0")  # lag >= 1
+    with pytest.raises(ValueError):
+        ChaosSchedule.parse("slow=2@0")
+
+
 def test_schedule_deterministic_under_fixed_seed():
     topo = Ring(4)
     s = ChaosSchedule(seed=7, drop_p=0.3, flaky=(FlakyWindow(5, 9, 1.0),))

@@ -68,14 +68,19 @@ def _batches(seed=3):
 
 
 def _run(backend, *, gossip_wire="dense", wire=None, bucketed=None,
-         staleness=0, obs=False):
+         staleness=0, obs=False, chaos=None):
     topo = Ring(N_RANKS)
     model = MLP(hidden=MLP_HIDDEN)
     tx = optax.sgd(0.05)
     state = init_train_state(
         model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
-        bucketed=bucketed or 1,
+        bucketed=bucketed or 1, staleness=staleness,
     )
+    if chaos is not None:
+        from eventgrad_tpu.chaos import monitor as chaos_monitor
+        state = state.replace(
+            chaos=stack_for_ranks(chaos_monitor.PeerHealth.init(topo), topo)
+        )
     if obs:
         n_leaves = len(jax.tree.leaves(state.params))
         state = state.replace(
@@ -97,7 +102,7 @@ def _run(backend, *, gossip_wire="dense", wire=None, bucketed=None,
     step = make_train_step(
         model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
         gossip_wire=gossip_wire, compact_capacity=capacity, wire=wire,
-        bucketed=bucketed, staleness=staleness, obs=obs,
+        bucketed=bucketed, staleness=staleness, obs=obs, chaos=chaos,
     )
     mesh = build_mesh(topo) if backend == "shard_map" else None
     lifted = jax.jit(spmd(step, topo, mesh=mesh))
@@ -130,6 +135,27 @@ def test_full_state_bitwise_across_lifts(gossip_wire, wire, bucketed,
     s_s, m_s = _run("shard_map", gossip_wire=gossip_wire, wire=wire,
                     bucketed=bucketed, staleness=staleness)
     _assert_bitwise(s_v, s_s, m_v, m_s)
+
+
+@pytest.mark.parametrize("wire", [None, "int8"])
+@pytest.mark.parametrize("gossip_wire", ["dense", "compact"])
+def test_bounded_async_bitwise_across_lifts(gossip_wire, wire):
+    """The bounded-async engine (ISSUE 15, staleness=D >= 2) under an
+    injected straggler is part of the cross-lift parity surface: the
+    per-edge delivery queues, staleness clocks, and late-commit
+    counters are carried state like everything else, compared `==`
+    across the vmap simulator and the shard_map mesh."""
+    from eventgrad_tpu.chaos.schedule import ChaosSchedule
+
+    sched = ChaosSchedule(seed=5, slow=((1, 3),))
+    s_v, m_v = _run("vmap", gossip_wire=gossip_wire, wire=wire,
+                    staleness=2, chaos=sched)
+    s_s, m_s = _run("shard_map", gossip_wire=gossip_wire, wire=wire,
+                    staleness=2, chaos=sched)
+    _assert_bitwise(s_v, s_s, m_v, m_s)
+    # the straggler actually exercised the late path on both lifts
+    assert int(np.asarray(m_v["late_commits"]).sum()) > 0
+    assert int(np.asarray(m_v["edge_staleness"]).max()) == 2
 
 
 def test_telemetry_bitwise_across_lifts():

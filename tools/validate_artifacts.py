@@ -632,6 +632,67 @@ _LEDGER_ROUND = {
     },
 }
 
+STRAGGLER_ABLATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "schema_version", "topo", "algo", "chaos", "straggler",
+        "legs", "lockstep_step_time", "bounded_async_step_time",
+        "speedup_vs_lockstep", "bounded_async_beats_lockstep",
+        "acc_gap_pt", "replay_bitwise", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["straggler_ablation"]},
+        "schema_version": {"type": "integer", "minimum": 1},
+        "topo": {"type": "string"},
+        "algo": {"enum": ["eventgrad"]},
+        # the injected straggler: the rank whose sends arrive late and
+        # by how many passes (the chaos dict carries the full schedule)
+        "chaos": {"type": "object"},
+        "straggler": {
+            "type": "object",
+            "required": ["rank", "lag"],
+            "properties": {
+                "rank": {"type": "integer", "minimum": 0},
+                "lag": {"type": "integer", "minimum": 2},
+            },
+        },
+        # the bounded-async acceptance gates (ISSUE 15): under the
+        # injected persistent straggler, at least one lockstep
+        # (staleness <= 1) and one bounded-async (D >= 2) leg ran;
+        # bounded-async STRICTLY beats the lockstep's modeled step
+        # time, holds accuracy within 0.5 pt, and every bounded leg
+        # replays bitwise from its seed — a committed artifact
+        # violating any of these is a schema violation
+        "legs": {
+            "type": "array",
+            "minItems": 2,
+            "items": {
+                "type": "object",
+                "required": [
+                    "staleness", "lockstep", "modeled_step_time",
+                    "test_accuracy",
+                ],
+                "properties": {
+                    "staleness": {"type": "integer", "minimum": 0},
+                    "lockstep": {"type": "boolean"},
+                    "modeled_step_time": {"type": "number", "minimum": 0},
+                    "test_accuracy": {"type": "number", "minimum": 0},
+                    "replay_bitwise": {"enum": [True]},
+                    "late_commits": {"type": "integer", "minimum": 0},
+                    "edge_staleness_max": {"type": "integer", "minimum": 0},
+                },
+            },
+        },
+        "lockstep_step_time": {"type": "number", "minimum": 0},
+        "bounded_async_step_time": {"type": "number", "minimum": 0},
+        "speedup_vs_lockstep": {"type": "number", "minimum": 1.0},
+        "bounded_async_beats_lockstep": {"enum": [True]},
+        "acc_gap_pt": {"type": "number", "minimum": 0, "maximum": 0.5},
+        "replay_bitwise": {"enum": [True]},
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 PERF_LEDGER_SCHEMA = {
     "type": "object",
     "required": [
@@ -688,6 +749,7 @@ _ARTIFACT_FAMILIES = (
     ("bench_supervised_", _METRIC_LINE),
     ("perf_ledger", PERF_LEDGER_SCHEMA),
     ("soak_", SOAK_SCHEMA),
+    ("straggler_ablation_", STRAGGLER_ABLATION_SCHEMA),
     ("tpu_flagship", FLAGSHIP_SCHEMA),
 )
 
