@@ -319,6 +319,12 @@ def main() -> None:
     # EG_BENCH_BACKEND=shard_map|auto runs the mesh; records carry the
     # backend so the perf ledger never gates mesh rows against vmap rows.
     bench_backend = os.environ.get("EG_BENCH_BACKEND", "vmap")
+    # trigger policy of the event legs (parallel/policy.py registry):
+    # EG_BENCH_POLICY=norm_delta|micro|hybrid pins it, empty/unset keeps
+    # the algo default (norm_delta — the reference trigger the measured
+    # rungs were calibrated against). Records carry rec["policy"], so
+    # the perf ledger never gates one policy's rows against another's.
+    bench_policy = os.environ.get("EG_BENCH_POLICY", "") or None
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
@@ -340,7 +346,8 @@ def main() -> None:
     with obs_reg.span("cifar_eventgrad", cat="leg", tier=tier):
         state, hist = train(
             model, topo, x, y, algo="eventgrad", event_cfg=event_cfg,
-            registry=obs_reg, bucketed=bench_bucketed, **common
+            registry=obs_reg, bucketed=bench_bucketed,
+            trigger_policy=bench_policy, **common
         )
     wall_event = time.perf_counter() - t0
     with obs_reg.span("eval_eventgrad", cat="leg"):
@@ -399,7 +406,7 @@ def main() -> None:
             epochs=mnist_epochs, batch_size=mnist_batch,
             learning_rate=0.05, random_sampler=False, log_every_epoch=False,
             epochs_per_dispatch=k_disp, registry=obs_reg,
-            backend=bench_backend,
+            backend=bench_backend, trigger_policy=bench_policy,
         )
     mnist_saved = hist_m[-1]["msgs_saved_pct"]
 
@@ -654,6 +661,9 @@ def main() -> None:
                 # step_overhead_ratio acceptance metric is arena-on;
                 # EG_BENCH_ARENA=0 gives the legacy-tree comparison)
                 "arena": bench_arena,
+                # the trigger policy the event legs ran (EG_BENCH_POLICY;
+                # resolved from the history so the record reports what RAN)
+                "policy": hist[-1].get("policy", "norm_delta"),
                 # the SPMD lift that produced these numbers (vmap sim vs
                 # shard_map device mesh) — resolved from the history
                 # records, so EG_BENCH_BACKEND=auto reports what RAN

@@ -61,6 +61,7 @@ from eventgrad_tpu.models.transformer import TransformerLM
 from eventgrad_tpu.obs import device as obs_device
 from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel import policy as policy_lib
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import spmd, stack_for_ranks
@@ -159,6 +160,11 @@ class AuditConfig:
     #: run) stay out of the fast tier-1 matrix — tests mark them `slow`;
     #: tools/audit.py always runs them
     heavy: bool = False
+    #: trigger policy (parallel/policy.py registry); None = the algo's
+    #: default. Partitioned policies (micro/hybrid) additionally get
+    #: their partition geometry validated and declared in the report
+    #: (`partitions` / `partitions_ok`), like the fire-bit offsets
+    policy: Optional[str] = None
 
 
 #: the audit matrix: every dimension of the step's configuration space
@@ -193,6 +199,22 @@ CONFIGS: Tuple[AuditConfig, ...] = (
     AuditConfig("event_compact_int8_arena_stale4", gossip_wire="compact",
                 capacity=CAPACITY, wire="int8", arena=True, staleness=4),
     AuditConfig("sp_f32_tree", algo="sp_eventgrad"),
+    # partitioned trigger policies (ISSUE 16): micro's rotating owned-
+    # partition sends and hybrid's gated twin must keep the SAME
+    # rank-isolation, declared-offset, and three-way wire-byte truth —
+    # the wire format is unchanged (the masks ride the force/suppress
+    # seams), and the partition geometry itself is validated and
+    # declared in the report (partitions/partitions_ok), with the
+    # seeded partition_overlap oracle proving the check bites
+    AuditConfig("event_micro_compact_f32_arena", gossip_wire="compact",
+                capacity=-1, arena=True, policy="micro"),
+    AuditConfig("event_micro_masked_int8_tree", wire="int8",
+                policy="micro"),
+    AuditConfig("event_hybrid_masked_f32_arena_obs", arena=True,
+                obs=True, policy="hybrid"),
+    AuditConfig("event_hybrid_compact_int8_arena_b4",
+                gossip_wire="compact", capacity=BUCKETED_CAPACITY,
+                wire="int8", arena=True, bucketed=4, policy="hybrid"),
     # bucketed gossip schedule (ISSUE 10): the auditor must see K
     # declared-offset ppermute lane groups per neighbor and the SAME
     # three-way wire-byte equality, summed over buckets
@@ -314,6 +336,7 @@ def build(cfg: AuditConfig):
         arena=cfg.arena,
         integrity=IntegrityConfig() if cfg.integrity else None,
         bucketed=cfg.bucketed or None,
+        trigger_policy=cfg.policy,
     )
     return state, step, topo
 
@@ -620,6 +643,21 @@ def audit_config(
     if check_donation_alias if check_donation_alias is not None else cfg.donation:
         donation_ok, donation_note = check_donation(lifted, state, batch)
 
+    # partitioned policies: validate and DECLARE the partition geometry
+    # the traced step's ownership masks were built from — the element
+    # offsets are static like the fire-bit offsets, so they publish the
+    # same way; validate_partitions checks the masks themselves
+    # (disjoint / exact cover / element-balanced), which is what the
+    # seeded partition_overlap oracle sabotages
+    partitions = None
+    partitions_ok = None
+    if cfg.policy in ("micro", "hybrid"):
+        params0 = jax.tree.map(lambda x: x[0], state.params)
+        pspec = arena_lib.arena_spec(params0)
+        pr = policy_lib.validate_partitions(pspec, N_RANKS)
+        partitions = list(policy_lib.partition_table(pspec, N_RANKS))
+        partitions_ok = bool(pr["ok"])
+
     return {
         "name": cfg.name,
         "algo": cfg.algo,
@@ -634,6 +672,9 @@ def audit_config(
         "integrity": cfg.integrity,
         "staleness": cfg.staleness,
         "bucketed": int(cfg.bucketed),
+        "policy": cfg.policy,
+        "partitions": partitions,
+        "partitions_ok": partitions_ok,
         "n_params": int(n_params),
         "n_leaves": int(n_leaves),
         "violations": len(violations),
@@ -672,6 +713,7 @@ def clean(report: Dict[str, Any]) -> bool:
         and report["ravel_ok"]
         and report["callbacks"] == 0
         and report["donation_ok"] in (None, True)
+        and report.get("partitions_ok") in (None, True)
     )
 
 
@@ -1109,6 +1151,39 @@ def oracle_attention_cross_rank_gather() -> Tuple[bool, str]:
     )
 
 
+def oracle_partition_overlap() -> Tuple[bool, str]:
+    """A partition geometry that double-claims a leaf (two ranks both
+    'own' it) — the silent corruption a hand-edited partition table
+    would introduce: overlapping sends are last-writer-wins on the
+    receive buffer, so training still runs, just wrong. The sabotaged
+    masks feed BOTH the traced ownership vectors and the audit's
+    validate_partitions check; the micro cell's partitions_ok must go
+    false."""
+    cfg = config_by_name("event_micro_compact_f32_arena")
+    orig = policy_lib.partition_masks
+
+    def overlapping(spec, n_parts):
+        masks = [list(m) for m in orig(spec, n_parts)]
+        if len(masks) >= 2:
+            # partition 0 also claims partition 1's first leaf
+            grab = next(
+                (i for i, on in enumerate(masks[1]) if on), None
+            )
+            if grab is not None:
+                masks[0][grab] = True
+        return tuple(tuple(m) for m in masks)
+
+    try:
+        policy_lib.partition_masks = overlapping
+        rep = audit_config(cfg, run_metric=False)
+    finally:
+        policy_lib.partition_masks = orig
+    return rep["partitions_ok"] is False and not clean(rep), (
+        f"partitions_ok={rep['partitions_ok']} "
+        f"(sizes {[p['size'] for p in (rep['partitions'] or [])]})"
+    )
+
+
 ORACLES = {
     "rank_coupling_ppermute": oracle_rank_coupling,
     "late_delivery_drift": oracle_late_delivery_drift,
@@ -1122,6 +1197,8 @@ ORACLES = {
     "conv_rank_merge": oracle_conv_rank_merge,
     "unregistered_kernel": oracle_unregistered_kernel,
     "attention_cross_rank_gather": oracle_attention_cross_rank_gather,
+    # ISSUE 16: partitioned trigger policies
+    "partition_overlap": oracle_partition_overlap,
 }
 
 

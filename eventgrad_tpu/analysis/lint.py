@@ -38,6 +38,14 @@ The rules (docs/ANALYSIS.md has the rationale for each):
     kernels; this rule catches the drift at the SOURCE before a trace
     ever runs), and every registry entry must still name a live call
     site in its declared module (stale entries flag).
+  * trigger-policy-registered — every trigger-policy name referenced
+    as a string (train's `trigger_policy=`, the CLI's
+    `--trigger-policy` choices, bench's `EG_BENCH_POLICY` default,
+    `AuditConfig(policy=...)`) must resolve to a
+    `parallel/policy.py` POLICIES entry, and every registry entry
+    must appear in the CLI flag's choices (stale/unreachable flag
+    both directions; bench.py is loaded by the rule itself since it
+    sits outside collect_sources' subdirs).
 
 Adding a rule: subclass `Rule`, implement `check(files)`, append to
 `RULES`.  Scope rules by `rel` prefix; prefer AST matching; when a
@@ -617,6 +625,118 @@ class ShardMapExemptHonest(Rule):
         return out
 
 
+class TriggerPolicyRegistered(Rule):
+    """Every trigger-policy name referenced by train/CLI/bench/audit
+    resolves to a parallel/policy.py registry entry, and every registry
+    entry is reachable from the CLI.
+
+    Policy names travel as plain strings (`train(trigger_policy=...)`,
+    `--trigger-policy` choices, the `EG_BENCH_POLICY` env default,
+    AuditConfig(policy=...)); a typo'd or stale name fails only at
+    runtime, deep inside a training run. This rule resolves every such
+    string reference against `policy_lib.POLICIES` at the SOURCE, and —
+    the stale direction — flags registry entries missing from the CLI's
+    `--trigger-policy` choices (a policy the flag can't reach is dead
+    surface). bench.py sits at the repo root, outside collect_sources'
+    subdirs, so the rule loads it itself — the EG_BENCH_POLICY knob
+    cannot drift unchecked."""
+
+    name = "trigger-policy-registered"
+
+    #: repo-root sources outside collect_sources' subdirs that
+    #: reference policy names
+    EXTRA_FILES = ("bench.py",)
+
+    @staticmethod
+    def _const_str(node) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def _sites(self, sf):
+        """(line, name, is_cli_choice) policy-name string references."""
+        sites = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            for kw in node.keywords:
+                if kw.arg == "trigger_policy" or (
+                    kw.arg == "policy" and fn_name == "AuditConfig"
+                ):
+                    s = self._const_str(kw.value)
+                    if s is not None:
+                        sites.append((kw.value.lineno, s, False))
+                elif kw.arg == "choices" and fn_name == "add_argument":
+                    flag = (
+                        self._const_str(node.args[0]) if node.args else None
+                    )
+                    if flag == "--trigger-policy" and isinstance(
+                        kw.value, (ast.List, ast.Tuple)
+                    ):
+                        for el in kw.value.elts:
+                            s = self._const_str(el)
+                            if s is not None:
+                                sites.append((el.lineno, s, True))
+            # the EG_BENCH_POLICY env knob's default value
+            if fn_name == "get" and len(node.args) >= 2:
+                if self._const_str(node.args[0]) == "EG_BENCH_POLICY":
+                    s = self._const_str(node.args[1])
+                    if s:  # "" = inherit the algo default, fine
+                        sites.append((node.args[1].lineno, s, False))
+        return sites
+
+    def check(self, files):
+        from eventgrad_tpu.parallel import policy as policy_lib
+
+        files = list(files)
+        scanned = {sf.rel.replace(os.sep, "/") for sf in files}
+        for extra in self.EXTRA_FILES:
+            path = os.path.join(REPO_ROOT, extra)
+            if extra not in scanned and os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    files.append(
+                        SourceFile(path=path, rel=extra, text=f.read())
+                    )
+        out = []
+        cli_rel = None
+        cli_choices: Dict[str, int] = {}
+        for sf in files:
+            rel = sf.rel.replace(os.sep, "/")
+            # package + bench only: test files seed bad names on purpose
+            if not (_in_package(sf) or rel in self.EXTRA_FILES):
+                continue
+            for line, nm, is_choice in self._sites(sf):
+                if is_choice:
+                    cli_rel = sf.rel
+                    cli_choices.setdefault(nm, line)
+                if nm not in policy_lib.POLICIES:
+                    out.append(self._v(
+                        sf, line,
+                        f"trigger policy '{nm}' is not a registry entry "
+                        "— register it in parallel/policy.py POLICIES "
+                        f"(known: {', '.join(sorted(policy_lib.POLICIES))})",
+                    ))
+        # stale direction: every registry entry must be reachable from
+        # the CLI flag (checked only when the flag is in the file set)
+        if cli_rel is not None:
+            for reg in sorted(policy_lib.POLICIES):
+                if reg not in cli_choices:
+                    out.append(Violation(
+                        self.name, cli_rel, 1,
+                        f"registered trigger policy '{reg}' is missing "
+                        "from --trigger-policy choices — a policy the "
+                        "CLI can't name is dead surface; add it to the "
+                        "flag (or drop the registry entry)",
+                    ))
+        return out
+
+
 RULES: Sequence[Rule] = (
     ExitCodeLiterals(),
     OsExitConfined(),
@@ -627,6 +747,7 @@ RULES: Sequence[Rule] = (
     ShardMapMarkerImport(),
     ShardMapRespell(),
     ShardMapExemptHonest(),
+    TriggerPolicyRegistered(),
 )
 
 

@@ -192,6 +192,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "parameter to fire after N silent passes; 0 = off, "
                    "1 = exact D-PSGD. Stabilizes aggressive horizons")
     p.add_argument("--topk-percent", type=float, default=10.0)
+    p.add_argument("--trigger-policy",
+                   choices=["norm_delta", "topk", "micro", "hybrid"],
+                   default=None,
+                   help="registered TriggerPolicy (parallel/policy.py) "
+                        "driving the event trigger: norm_delta = the "
+                        "EventGraD trigger (eventgrad default), topk = "
+                        "sp_eventgrad's selection (its default), micro = "
+                        "rotating owned-partition sends, index-free "
+                        "(MiCRO, arXiv:2310.00967), hybrid = norm-delta "
+                        "gate x owned partition. Default: the algo's "
+                        "own policy")
     p.add_argument("--augment", action="store_true", help="CIFAR pad4+flip+crop32")
     p.add_argument("--staleness", type=int, default=0,
                    help="1 = mix with the previous step's received buffers "
@@ -437,12 +448,27 @@ def main(argv=None) -> int:
             "--wire applies to gossip exchanges; allreduce gradients "
             "keep full precision"
         )
-    if args.gossip_wire == "compact" and args.algo != "eventgrad":
+    # registry-driven wire validation (parallel/policy.py): resolve the
+    # trigger policy the run will use and consult its WireSpec —
+    # sp_eventgrad's statically-sized top-k wire ACCEPTS compact as a
+    # capacity-free no-op alias (the old algo-name guard wrongly
+    # rejected it); dpsgd/allreduce have no trigger policy at all
+    from eventgrad_tpu.parallel import policy as policy_lib
+
+    cli_pol = None
+    if args.algo in policy_lib.DEFAULT_FOR_ALGO or args.trigger_policy:
+        try:
+            cli_pol = policy_lib.resolve(args.trigger_policy, args.algo)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    if args.gossip_wire == "compact" and (
+        cli_pol is None
+        or "compact" not in cli_pol.wire_spec().gossip_wires
+    ):
         raise SystemExit(
-            "--gossip-wire compact rides the event fire bits of the "
-            f"masked exchange (--algo eventgrad); --algo {args.algo} "
-            "has no compactable payload (sp_eventgrad's top-k wire is "
-            "already physically sparse)"
+            "--gossip-wire compact rides the statically-sized wire of "
+            "an event trigger policy (--algo eventgrad / sp_eventgrad); "
+            f"--algo {args.algo} has no compactable payload"
         )
     if args.compact_frac is not None:
         if args.gossip_wire != "compact":
@@ -450,6 +476,13 @@ def main(argv=None) -> int:
         if not (0.0 < args.compact_frac <= 1.0):
             raise SystemExit(
                 f"--compact-frac must be in (0, 1], got {args.compact_frac}"
+            )
+        if (cli_pol is not None
+                and not cli_pol.wire_spec().compact_needs_capacity):
+            raise SystemExit(
+                "--compact-frac sizes the capacity autotune; the "
+                f"{cli_pol.name!r} policy's compact wire is capacity-"
+                "free (its top-k lanes are already statically sized)"
             )
     if args.max_silence < 0:
         raise SystemExit(
@@ -686,6 +719,7 @@ def main(argv=None) -> int:
                     resume=args.resume, trace_file=args.trace_file,
                     wire=args.wire, staleness=args.staleness,
                     gossip_wire=args.gossip_wire, compact_frac=args.compact_frac,
+                    trigger_policy=args.trigger_policy,
                     fused_update=args.fused, fault_inject=args.fault_inject,
                     chaos=chaos_sched, chaos_policy=chaos_policy,
                     membership=membership, integrity=integrity_cfg,

@@ -1,0 +1,443 @@
+"""Trigger policies: WHO fires and WHAT ships, as a pluggable layer.
+
+The repo's communication decisions used to be hard-wired: the norm-delta
+trigger lived inline in the event branches of train/steps.py and
+sp_eventgrad's top-k selection rode a bespoke side path in
+parallel/sparsify.py. This module factors the decision out of the
+engine as a `TriggerPolicy` — the same propose/commit split the engine
+already has (parallel/events.py), plus a static `WireSpec` that names
+which gossip wires the policy's payload can ride. The step builders
+(train/steps.py), the train loop's wire autotune (train/loop.py), and
+the CLI/bench guards all consult the registry instead of matching on
+algo names, so a new selection rule lands as one registered class.
+
+Registered policies:
+
+  norm_delta  The EventGraD trigger exactly as before (event.cpp
+              :320-390 via events.propose/commit). The base class
+              delegates to the SAME function objects the engine always
+              called and adds no masks, so the built step's jaxpr is
+              identical to the pre-refactor path — bitwise, not just
+              numerically (tests/test_policy.py pins full TrainState +
+              metrics across the masked|compact x dtype x staleness x
+              bucketed matrix).
+
+  topk        sp_eventgrad's magnitude top-k, migrated off its bespoke
+              SparseState gate: the norm-delta proposal still drives
+              the per-leaf fire bits (same trigger state machine), and
+              the payload helpers (`topk_payload`/`scatter_into`) now
+              live here — sparsify.sparse_exchange is a thin wire
+              adapter over them. Its top-k wire is already physically
+              sparse and statically sized, so `--gossip-wire compact`
+              is a no-op alias (accepted, needs no capacity) rather
+              than the error the old CLI guard raised.
+
+  micro       Partitioned index-free sparsification after "MiCRO:
+              Near-Zero Cost Gradient Sparsification" (arXiv:2310.00967,
+              PAPERS.md): the parameter space is cut into static
+              element-balanced leaf-aligned partitions (ArenaSpec
+              .buckets — the same geometry as the bucketed gossip
+              schedule), each rank ships ONLY the partition it owns,
+              and ownership is implicit in the (rank, pass) pair — so
+              the wire carries no index lanes at all. Offsets are
+              static like the compact wire's fire-bit offsets, and the
+              payload rides the existing compact static-capacity format
+              (with per-bucket splits from collectives.split_capacity
+              under bucketed=K) at capacity >= the largest partition.
+              DEVIATION from MiCRO's allreduce setting, by design:
+              ownership ROTATES — rank r owns partition
+              (r + pass_num) mod K. MiCRO's server sees every
+              partition every round; a gossip neighbor only sees what
+              its peers ship, so static ownership would freeze the
+              non-owned (K-1)/K of every receive buffer at its zero
+              init forever. Rotation bounds per-coordinate buffer
+              staleness by K passes instead (docs/compaction.md).
+              Second deviation, measured not assumed: suppression
+              engages only after the trigger's warmup full-fire
+              (`cfg.warmup_passes`) — see Micro's class doc for the
+              collapse it prevents.
+
+  hybrid      norm-delta gate x partitioned payload: a leaf ships only
+              when the EventGraD trigger fires AND it lies in the
+              owned partition of the pass. Suppressed leaves are never
+              committed (the propose/commit rollback), so their
+              thresholds keep decaying and they re-contend when their
+              partition rotates back in — the gate semantics are the
+              trigger's, the wire cost micro's.
+
+Partition masks are plain static tuples (`partition_masks`), validated
+by `validate_partitions` and audited per micro/hybrid matrix cell with
+a seeded `partition_overlap` oracle (analysis/audit.py): a partition
+geometry that double-claims or drops a leaf cannot land silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgrad_tpu.parallel import events
+from eventgrad_tpu.parallel.arena import ArenaSpec
+from eventgrad_tpu.parallel.topology import Topology
+
+
+# ---------------------------------------------------------------------------
+# wire capabilities
+
+
+@dataclasses.dataclass(frozen=True)
+class WireSpec:
+    """Static wire capabilities of a policy — what the guards consult.
+
+    algos: the train-step branches the policy can drive ("eventgrad"
+        rides the masked/compact event exchange, "sp_eventgrad" the
+        physically-sparse top-k wire).
+    gossip_wires: the --gossip-wire modes the payload can ride.
+    indexed: the wire carries int32 index lanes per shipped value
+        (top-k). Partitioned policies are index-free by construction.
+    partitioned: payload restricted to the rotating owned partition.
+    compact_needs_capacity: "compact" needs a static element budget
+        (the autotune/--compact-capacity machinery). False when the
+        wire is already statically sized without one — sp_eventgrad's
+        top-k lanes — so compact is accepted as a no-op alias and the
+        loop skips the dense warmup/rebuild entirely.
+    """
+
+    algos: Tuple[str, ...]
+    gossip_wires: Tuple[str, ...]
+    indexed: bool = False
+    partitioned: bool = False
+    compact_needs_capacity: bool = True
+
+
+# ---------------------------------------------------------------------------
+# partition geometry (micro / hybrid)
+
+
+def partition_masks(
+    spec: ArenaSpec, n_parts: int
+) -> Tuple[Tuple[bool, ...], ...]:
+    """Static per-partition leaf masks, [K][L] bools.
+
+    Partition geometry IS the bucketed gossip geometry —
+    ArenaSpec.buckets(n_parts): contiguous, leaf-aligned, element-
+    balanced cuts, K clamped to the leaf count. Returned as plain
+    tuples so the audit can validate the exact object the traced step
+    consumes (ownership_vec stacks these), and so the seeded
+    `partition_overlap` oracle can sabotage it in one place.
+    """
+    parts = spec.buckets(int(n_parts))
+    return tuple(
+        tuple(b.lo <= leaf < b.hi for leaf in range(spec.n_leaves))
+        for b in parts
+    )
+
+
+def partition_table(spec: ArenaSpec, n_parts: int) -> Tuple[Dict[str, int], ...]:
+    """Declared partition offsets — start/size element ranges per
+    partition, published in the audit report exactly like the compact
+    wire's fire-bit offsets (analysis/audit.py `partitions`)."""
+    return tuple(
+        {"index": b.index, "lo": b.lo, "hi": b.hi,
+         "start": b.start, "size": b.size}
+        for b in spec.buckets(int(n_parts))
+    )
+
+
+def validate_partitions(spec: ArenaSpec, n_parts: int) -> Dict[str, Any]:
+    """Check the partition geometry's three invariants on the mask
+    object itself (not the bucket metadata it was derived from — the
+    oracle sabotages the masks, and this must catch it):
+
+      disjoint     no leaf claimed by two partitions
+      exact_cover  every leaf claimed by exactly one
+      balanced     max partition size <= ceil(n_total/K) + largest
+                   leaf (the best any leaf-aligned cut can guarantee)
+    """
+    masks = partition_masks(spec, n_parts)
+    k = len(masks)
+    claims = [sum(m[leaf] for m in masks) for leaf in range(spec.n_leaves)]
+    disjoint = all(c <= 1 for c in claims)
+    exact_cover = all(c == 1 for c in claims)
+    sizes = [
+        sum(sz for sz, on in zip(spec.sizes, m) if on) for m in masks
+    ]
+    bound = -(-spec.n_total // max(1, k)) + max(spec.sizes)
+    balanced = bool(sizes) and max(sizes) <= bound
+    return {
+        "n_partitions": k,
+        "sizes": sizes,
+        "max_partition_elems": max(sizes) if sizes else 0,
+        "disjoint": bool(disjoint),
+        "exact_cover": bool(exact_cover),
+        "balanced": bool(balanced),
+        "ok": bool(disjoint and exact_cover and balanced),
+    }
+
+
+def max_partition_elems(spec: ArenaSpec, n_parts: int) -> int:
+    """The compact capacity floor of a partitioned policy: the largest
+    partition must ship whole (tools/frontier_sweep.py pins the sweep's
+    shared element budget to this)."""
+    return max(b.size for b in spec.buckets(int(n_parts)))
+
+
+def ownership_vec(
+    spec: ArenaSpec, topo: Topology, pass_num: jnp.ndarray
+) -> jnp.ndarray:
+    """bool [L]: the leaves of the partition THIS rank owns THIS pass.
+
+    Rank identity is the row-major ravel of the per-axis lax.axis_index
+    coordinates (the traced twin of Topology's rank numbering, same
+    construction as chaos.inject.rank_and_sources — inlined here so
+    parallel/ does not import chaos/). Ownership rotates:
+    partition (rank + pass_num) mod K — see the module doc for why
+    static MiCRO ownership is unsound under gossip.
+
+    The masks are a replicated [K, L] constant; the dynamic index is
+    the per-rank scalar `mine`, a gather over the constant's leading
+    axis — no cross-rank data movement (the rankflow auditor sees a
+    plain batched gather on a broadcast operand).
+    """
+    masks = jnp.asarray(partition_masks(spec, topo.n_ranks), bool)
+    r = jnp.int32(0)
+    for axis, size in zip(topo.axes, topo.shape):
+        r = r * jnp.int32(size) + lax.axis_index(axis).astype(jnp.int32)
+    k = masks.shape[0]
+    mine = (r + jnp.asarray(pass_num, jnp.int32)) % jnp.int32(k)
+    return masks[mine]
+
+
+# ---------------------------------------------------------------------------
+# top-k payload helpers (moved from parallel/sparsify.py — the policy owns
+# selection; sparsify.sparse_exchange stays as the wire adapter over these)
+
+
+def topk_payload(params: Any, prev_sent: Any, cfg) -> Tuple[Any, Any]:
+    """Per-leaf (values, indices) of the k largest |p - prev_sent|
+    entries (spevent.cpp:344-363): selection metric is the drift from
+    the sender shadow, values sent are the CURRENT parameter at those
+    indices, k = cfg.k_for(numel) is static under jit. Moved verbatim
+    from parallel/sparsify.py — the topk policy owns selection;
+    sparsify.sparse_exchange is the wire adapter over it.
+    """
+
+    def leaf(p, prev):
+        flat = p.reshape(-1)
+        diff = jnp.abs(flat - prev.reshape(-1))
+        k = cfg.k_for(flat.size)
+        _, idx = lax.top_k(diff, k)
+        return flat[idx], idx.astype(jnp.int32)
+
+    out = jax.tree.map(lambda p, q: leaf(p, q), params, prev_sent)
+    vals = jax.tree.map(
+        lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple)
+    )
+    idxs = jax.tree.map(
+        lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple)
+    )
+    return vals, idxs
+
+
+def scatter_into(full: Any, vals: Any, idxs: Any, gate: Any) -> Any:
+    """Write `vals` at flat positions `idxs` of each leaf of `full`, but
+    only where the per-leaf `gate` bit is set (receiver path
+    spevent.cpp:438-448; sender prev_sent update :406-413 uses
+    gate=fire). Moved verbatim from parallel/sparsify.py."""
+
+    def leaf(f, v, i, g):
+        scattered = f.reshape(-1).at[i].set(v).reshape(f.shape)
+        return jnp.where(g, scattered, f)
+
+    return jax.tree.map(leaf, full, vals, idxs, gate)
+
+
+# ---------------------------------------------------------------------------
+# policies
+
+
+class TriggerPolicy:
+    """Base policy = the EventGraD norm-delta trigger, whole.
+
+    init_state/propose/commit delegate to the SAME events.* function
+    objects the pre-refactor step branches called inline — no wrapper
+    logic, no extra ops — so a policy that overrides nothing builds a
+    trace-identical step. Subclasses specialize by:
+
+      * `masks(spec, topo, pass_num, cfg)` -> (force_fire,
+        suppress_fire), each None or bool [L], merged into the step's
+        existing chaos force/quarantine-suppress seams (suppression is
+        applied AFTER force ORs in — suppression wins, the quarantine
+        precedent). Suppressed proposals are counted into num_deferred
+        by commit, like any wire-budget deferral. `cfg` is the
+        EventConfig: partitioned policies gate their suppression on
+        `pass_num >= cfg.warmup_passes` so the trigger's warmup
+        full-fire still synchronizes the ranks (see Micro).
+      * `wire_spec()` -> WireSpec, the static capabilities the loop
+        and CLI guards consult.
+    """
+
+    name = "base"
+
+    def init_state(self, params, topo, cfg, *, arena=False, buckets=1,
+                   staleness=0):
+        return events.EventState.init(
+            params, topo, cfg, arena=arena, buckets=buckets,
+            staleness=staleness,
+        )
+
+    def propose(self, params, state, pass_num, cfg, force_fire=None):
+        return events.propose(
+            params, state, pass_num, cfg, force_fire=force_fire
+        )
+
+    def commit(self, state, prop, fire_vec, cfg, n_neighbors):
+        return events.commit(state, prop, fire_vec, cfg, n_neighbors)
+
+    def masks(
+        self, spec: Optional[ArenaSpec], topo: Topology, pass_num, cfg
+    ) -> Tuple[Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+        return None, None
+
+    def wire_spec(self) -> WireSpec:
+        raise NotImplementedError
+
+
+class NormDelta(TriggerPolicy):
+    """The current EventGraD trigger, extracted. Bitwise-identical to
+    the legacy inline path by construction (no masks, same delegates)."""
+
+    name = "norm_delta"
+
+    def wire_spec(self) -> WireSpec:
+        return WireSpec(
+            algos=("eventgrad",),
+            gossip_wires=("masked", "compact"),
+        )
+
+
+class TopK(TriggerPolicy):
+    """sp_eventgrad's magnitude top-k selection (docs/ARCHITECTURE.md
+    "Sparsified gossip"), driven by the shared norm-delta trigger state.
+    Its wire is physically sparse and statically sized already, so
+    "compact" is a capacity-free no-op alias of its native wire."""
+
+    name = "topk"
+
+    def wire_spec(self) -> WireSpec:
+        return WireSpec(
+            algos=("sp_eventgrad",),
+            gossip_wires=("masked", "compact"),
+            indexed=True,
+            compact_needs_capacity=False,
+        )
+
+
+class Micro(TriggerPolicy):
+    """MiCRO-style partitioned sends, rotated for gossip (module doc).
+
+    force = owned (the owned partition ships every pass — selection is
+    positional, near-zero cost, no trigger arithmetic on the payload),
+    suppress = ~owned (nothing outside the partition ever ships, so
+    the wire needs no index lanes). The compact capacity floor is the
+    largest partition (`max_partition_elems`).
+
+    Suppression engages only at `pass_num >= cfg.warmup_passes`: the
+    trigger's warmup full-fire must still synchronize the ranks.
+    Measured (LeNetCifar/Ring(8), the frontier op point): suppressing
+    the warmup leaves early training unsynchronized under the violent
+    first SGD steps and the run collapses to a dead uniform-output
+    equilibrium it never leaves — loss pinned at ln(10) for 960 passes
+    at every learning rate tried — while the warmup-synced run reaches
+    99.6% in 10 epochs. Warmup passes full-fire exactly like
+    norm_delta's, so the wire cost of the exception is the warmup the
+    trigger already pays."""
+
+    name = "micro"
+
+    def masks(self, spec, topo, pass_num, cfg):
+        owned = ownership_vec(spec, topo, pass_num)
+        not_warm = (
+            jnp.asarray(pass_num, jnp.int32)
+            >= jnp.int32(cfg.warmup_passes)
+        )
+        return owned, (~owned) & not_warm
+
+    def wire_spec(self) -> WireSpec:
+        return WireSpec(
+            algos=("eventgrad",),
+            gossip_wires=("masked", "compact"),
+            partitioned=True,
+        )
+
+
+class Hybrid(TriggerPolicy):
+    """norm-delta gate x micro payload: fire = trigger AND owned. The
+    gate stays adaptive (thresholds decay while suppressed, deferred
+    leaves re-contend when their partition rotates back in); the wire
+    stays index-free. Suppression engages post-warmup only, same
+    rationale as Micro."""
+
+    name = "hybrid"
+
+    def masks(self, spec, topo, pass_num, cfg):
+        owned = ownership_vec(spec, topo, pass_num)
+        not_warm = (
+            jnp.asarray(pass_num, jnp.int32)
+            >= jnp.int32(cfg.warmup_passes)
+        )
+        return None, (~owned) & not_warm
+
+    def wire_spec(self) -> WireSpec:
+        return WireSpec(
+            algos=("eventgrad",),
+            gossip_wires=("masked", "compact"),
+            partitioned=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+POLICIES: Dict[str, TriggerPolicy] = {
+    p.name: p for p in (NormDelta(), TopK(), Micro(), Hybrid())
+}
+
+#: the policy an algo runs when train(trigger_policy=None) — the exact
+#: pre-refactor behavior of each branch
+DEFAULT_FOR_ALGO: Dict[str, str] = {
+    "eventgrad": "norm_delta",
+    "sp_eventgrad": "topk",
+}
+
+
+def resolve(name: Optional[str], algo: str) -> TriggerPolicy:
+    """The policy instance `algo` runs: the registered `name`, or the
+    algo's default when None. Raises ValueError for unknown names,
+    algos with no event trigger (dpsgd), and policy/algo mismatches —
+    the single guard train/loop.py and cli.py both call."""
+    if name is None:
+        default = DEFAULT_FOR_ALGO.get(algo)
+        if default is None:
+            raise ValueError(
+                f"--algo {algo} has no event trigger; trigger policies "
+                f"apply to {sorted(DEFAULT_FOR_ALGO)}"
+            )
+        return POLICIES[default]
+    pol = POLICIES.get(name)
+    if pol is None:
+        raise ValueError(
+            f"unknown trigger policy {name!r}; registered: "
+            f"{sorted(POLICIES)} (parallel/policy.py)"
+        )
+    if algo not in pol.wire_spec().algos:
+        raise ValueError(
+            f"trigger policy {name!r} drives "
+            f"{'/'.join(pol.wire_spec().algos)}, not --algo {algo}"
+        )
+    return pol

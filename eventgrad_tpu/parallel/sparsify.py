@@ -36,7 +36,9 @@ from flax import struct
 
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.parallel import collectives
-from eventgrad_tpu.parallel.events import EventConfig, EventState, decide_and_update
+# selection/scatter live with the topk TriggerPolicy now
+# (parallel/policy.py); this module is the wire adapter over them
+from eventgrad_tpu.parallel.policy import scatter_into, topk_payload
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,37 +66,6 @@ class SparseState(struct.PyTreeNode):
             prev_sent=copy,
             replicas=tuple(jax.tree.map(lambda x: x, params) for _ in topo.neighbors),
         )
-
-
-def topk_payload(params: Any, prev_sent: Any, cfg: SparseConfig) -> Tuple[Any, Any]:
-    """Per-leaf (values, indices) of the k largest |p - prev_sent| entries.
-
-    Shapes are static: values f32[k_i], indices i32[k_i] per leaf.
-    """
-
-    def leaf(p, prev):
-        flat = p.reshape(-1)
-        diff = jnp.abs(flat - prev.reshape(-1))
-        k = cfg.k_for(flat.size)
-        _, idx = jax.lax.top_k(diff, k)
-        return flat[idx], idx.astype(jnp.int32)
-
-    out = jax.tree.map(lambda p, q: leaf(p, q), params, prev_sent)
-    vals = jax.tree.map(lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
-    idxs = jax.tree.map(lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
-    return vals, idxs
-
-
-def scatter_into(full: Any, vals: Any, idxs: Any, gate: Any) -> Any:
-    """Write `vals` at flat positions `idxs` of each leaf of `full`, but only
-    where the per-leaf `gate` bit is set (receiver path spevent.cpp:438-448;
-    sender prev_sent update :406-413 uses gate=fire)."""
-
-    def leaf(f, v, i, g):
-        scattered = f.reshape(-1).at[i].set(v).reshape(f.shape)
-        return jnp.where(g, scattered, f)
-
-    return jax.tree.map(leaf, full, vals, idxs, gate)
 
 
 def sparse_exchange(

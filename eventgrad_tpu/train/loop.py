@@ -40,6 +40,7 @@ from eventgrad_tpu.data.sharding import epoch_index_plan, epoch_steps
 from eventgrad_tpu.ops import arena_tuning
 from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives, multihost
+from eventgrad_tpu.parallel import policy as policy_lib
 from eventgrad_tpu.parallel.events import EventConfig
 from eventgrad_tpu.parallel.sparsify import SparseConfig
 from eventgrad_tpu.parallel.spmd import resolve_backend, spmd, stack_for_ranks
@@ -293,6 +294,7 @@ def train(
     arena: Optional[bool] = None,
     bucketed: Optional[int] = None,
     pipeline: Optional[bool] = None,
+    trigger_policy: Optional[str] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -471,6 +473,20 @@ def train(
     bytes the collective actually moves — see docs/compaction.md). If the
     observed fire rate leaves nothing to compact (capacity would reach
     the full model), the run stays dense and says so in the record.
+    With a capacity-FREE compact wire (sp_eventgrad: the top-k lanes
+    are already statically sized) "compact" is accepted as a no-op
+    alias of the native wire — no warmup phase, no autotune, no
+    rebuild; records still carry `gossip_wire: "compact"`.
+
+    trigger_policy names a registered TriggerPolicy
+    (parallel/policy.py): "norm_delta" (eventgrad's default — the
+    EventGraD trigger, bitwise the pre-policy path), "topk"
+    (sp_eventgrad's default), "micro" (rotating owned-partition sends,
+    index-free — MiCRO arXiv:2310.00967 adapted to gossip), or
+    "hybrid" (norm-delta gate x owned partition). None runs the algo's
+    default. Event-algo history records carry `rec["policy"]`; the
+    compact-wire guards above consult the policy's WireSpec. See
+    docs/ARCHITECTURE.md "Trigger policies".
 
     device_data=True uploads the full (cast) dataset to the device ONCE and
     ships only the per-epoch permutation index plan ([n_ranks, steps, batch]
@@ -551,10 +567,38 @@ def train(
         raise ValueError(
             f"gossip_wire must be 'dense' or 'compact', got {gossip_wire!r}"
         )
-    if gossip_wire == "compact" and algo != "eventgrad":
+    # trigger-policy resolution (parallel/policy.py): validates the
+    # name/algo pairing up front and supplies the WireSpec every compact
+    # decision below consults — the guard is registry-driven, not an
+    # algo-name match (sp_eventgrad's statically-sized top-k wire takes
+    # compact as a capacity-free no-op alias)
+    pol = None
+    if algo in policy_lib.DEFAULT_FOR_ALGO or trigger_policy is not None:
+        pol = policy_lib.resolve(trigger_policy, algo)
+    if gossip_wire == "compact":
+        if pol is None or "compact" not in pol.wire_spec().gossip_wires:
+            raise ValueError(
+                "gossip_wire='compact' rides the statically-sized wire "
+                "of an event trigger policy (algos: eventgrad, "
+                f"sp_eventgrad); algo={algo!r} with policy "
+                f"{pol.name if pol else 'none'!r} declares no compact "
+                "wire (parallel/policy.py WireSpec)"
+            )
+    # compact needs the capacity autotune machinery only when the
+    # policy's wire says so
+    compact_needs_cap = (
+        gossip_wire == "compact"
+        and pol is not None and pol.wire_spec().compact_needs_capacity
+    )
+    # a capacity-free compact wire (sp_eventgrad's top-k lanes) is
+    # statically sized from step 0: no dense warmup, no autotune, no
+    # runner rebuild — the wire mode is "compact" for the whole run
+    compact_static = gossip_wire == "compact" and not compact_needs_cap
+    if compact_frac is not None and compact_static:
         raise ValueError(
-            "gossip_wire='compact' rides the event fire bits "
-            f"(algo='eventgrad'); got algo={algo!r}"
+            f"compact_frac sizes the capacity autotune; the "
+            f"{pol.name!r} policy's compact wire is capacity-free "
+            "(its top-k lanes are already statically sized)"
         )
     if compact_frac is not None:
         if gossip_wire != "compact":
@@ -1126,6 +1170,7 @@ def train(
             arena=arena_on,
             integrity=integ_now,
             bucketed=bucketed_k,
+            trigger_policy=trigger_policy,
             # NOTE arena_sgd (the all-flat SGD tail) stays off: it costs
             # two extra full-model ravels per step, and the measured CPU
             # ravel price (see ArenaSpec.ravel) makes the unflatten +
@@ -1133,11 +1178,16 @@ def train(
             # can measure
         )
 
-    # a compact-wire run starts DENSE: warmup fires everything (no budget
-    # could hold it), and the autotuner needs observed post-warmup fired
-    # sizes before it can size the buffer; _maybe_activate_compact below
-    # rebuilds the runners exactly once
-    lifted = spmd(_build_step("dense"), topo, mesh=mesh)
+    # a capacity-budgeted compact-wire run starts DENSE: warmup fires
+    # everything (no budget could hold it), and the autotuner needs
+    # observed post-warmup fired sizes before it can size the buffer;
+    # _maybe_activate_compact below rebuilds the runners exactly once.
+    # A capacity-FREE compact wire (compact_static) builds compact
+    # directly — nothing to size, nothing to rebuild.
+    lifted = spmd(
+        _build_step("compact" if compact_static else "dense"),
+        topo, mesh=mesh,
+    )
 
     # --- dispatch-mode resolution (device-resident data + K-epoch blocks)
     # eligibility: the single-process vmap/single-mesh path only — hybrid
@@ -1273,7 +1323,7 @@ def train(
     # static capacity ONCE and rebuilds the runners (one extra compile,
     # zero recompile churn afterwards)
     compact_capacity: Optional[int] = None
-    compact_done = gossip_wire != "compact"
+    compact_done = gossip_wire != "compact" or compact_static
     compact_note: Optional[Dict[str, Any]] = None
     compact_fired_peak = 0.0
     compact_post_steps = 0
@@ -1464,6 +1514,7 @@ def train(
                     rec.update(compact_note)
                     compact_note = None
             if algo in ("eventgrad", "sp_eventgrad"):
+                rec["policy"] = pol.name
                 rec["num_deferred"] = int(m_e["num_deferred"][-1].sum())
                 # msgs-saved vs D-PSGD: events/(n_neighbors * passes *
                 # sz) fired
@@ -1778,7 +1829,11 @@ def train(
             # compact switch is a new program) — tag its records so
             # steady-state step math can exclude them (the tail-remainder
             # block recompiles too, not just block 0)
-            mode_now = "compact" if compact_capacity is not None else "dense"
+            mode_now = (
+                "compact"
+                if (compact_capacity is not None or compact_static)
+                else "dense"
+            )
             # the rank count is part of the compiled shape too: a
             # membership transition recompiles even at an already-seen
             # block size
@@ -1950,7 +2005,8 @@ def train(
                                 spmd(
                                     _build_step(
                                         "compact"
-                                        if compact_capacity is not None
+                                        if (compact_capacity is not None
+                                            or compact_static)
                                         else "dense",
                                         compact_capacity,
                                     ),
@@ -2055,7 +2111,9 @@ def train(
                     run_epoch, run_epoch_idx = _build_runners(
                         spmd(
                             _build_step(
-                                "compact" if compact_capacity is not None
+                                "compact"
+                                if (compact_capacity is not None
+                                    or compact_static)
                                 else "dense",
                                 compact_capacity,
                             ),

@@ -48,8 +48,9 @@ from eventgrad_tpu.ops.arena_update import fused_mix_commit, mix_commit_referenc
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
+from eventgrad_tpu.parallel import policy as policy_lib
 from eventgrad_tpu.parallel.events import (
-    EventConfig, async_delivery_commit, capacity_gate, commit, propose,
+    EventConfig, async_delivery_commit, capacity_gate,
 )
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
 from eventgrad_tpu.parallel.topology import Topology
@@ -103,6 +104,7 @@ def make_train_step(
     arena: bool = False,
     integrity: Optional[Any] = None,
     bucketed: Optional[int] = None,
+    trigger_policy: Optional[str] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -247,6 +249,14 @@ def make_train_step(
     scatter replicas are future work), and not combinable with the fused
     Pallas tail (whose mix weight is baked in, incompatible with
     edge-gated renormalization).
+
+    trigger_policy names a registered TriggerPolicy (parallel/policy.py:
+    norm_delta | topk | micro | hybrid; None = the algo's default, the
+    exact pre-refactor behavior). The policy's propose/commit delegates
+    drive every event branch, and partitioned policies (micro/hybrid)
+    contribute (force, suppress) leaf masks merged into the existing
+    chaos force-fire / quarantine-suppress seams. The compact guard
+    consults the policy's WireSpec instead of matching on algo.
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
@@ -398,20 +408,38 @@ def make_train_step(
         raise ValueError(
             f"gossip_wire must be 'dense' or 'compact', got {gossip_wire!r}"
         )
+    # trigger-policy resolution (parallel/policy.py): the algo's default
+    # when unset — the base delegates are the SAME events.* function
+    # objects the branches below always called, so default builds are
+    # trace-identical to the pre-refactor step. dpsgd/allreduce have no
+    # trigger; an explicit policy there is a configuration error.
+    pol = None
+    if algo in policy_lib.DEFAULT_FOR_ALGO or trigger_policy is not None:
+        pol = policy_lib.resolve(trigger_policy, algo)
+    pol_partitioned = pol is not None and pol.wire_spec().partitioned
     if gossip_wire == "compact":
-        if algo != "eventgrad":
+        wspec = pol.wire_spec() if pol is not None else None
+        if wspec is None or "compact" not in wspec.gossip_wires:
             raise ValueError(
-                "gossip_wire='compact' rides the event fire bits of the "
-                f"masked exchange (algo='eventgrad'); got algo={algo!r} "
-                "(sp_eventgrad's top-k wire is already physically sparse)"
+                "gossip_wire='compact' rides the statically-sized wire "
+                "of an event trigger policy (algos: eventgrad, "
+                f"sp_eventgrad); algo={algo!r} with policy "
+                f"{pol.name if pol else 'none'!r} declares no compact "
+                "wire (parallel/policy.py WireSpec)"
             )
-        if compact_capacity is None or int(compact_capacity) < 1:
-            raise ValueError(
-                "gossip_wire='compact' needs a static compact_capacity "
-                "(elements); pick one with collectives.choose_capacity or "
-                "let train(gossip_wire='compact') autotune it"
-            )
-        compact_capacity = int(compact_capacity)
+        if wspec.compact_needs_capacity:
+            if compact_capacity is None or int(compact_capacity) < 1:
+                raise ValueError(
+                    "gossip_wire='compact' needs a static compact_capacity "
+                    "(elements); pick one with collectives.choose_capacity "
+                    "or let train(gossip_wire='compact') autotune it"
+                )
+            compact_capacity = int(compact_capacity)
+        else:
+            # sp_eventgrad's top-k lanes are already physically sparse and
+            # statically sized — compact is a no-op alias of its native
+            # wire; no element budget, no dense warmup
+            compact_capacity = None
 
     def step(state, batch):
         x, y = batch
@@ -686,8 +714,18 @@ def make_train_step(
                 if (chaos is not None and chaos_policy.sync_after)
                 else None
             )
+            # partitioned trigger policies (micro/hybrid) contribute
+            # (force, suppress) leaf masks through the same seams chaos
+            # sync / quarantine already use; suppression wins (applied
+            # after every force OR), the quarantine precedent
+            pol_force, pol_suppress = pol.masks(spec, topo, pass_num, event_cfg)
+            if pol_force is not None:
+                force_fire = (
+                    pol_force if force_fire is None
+                    else (force_fire | pol_force)
+                )
             with _phase("gate_pack"):
-                prop = propose(
+                prop = pol.propose(
                     params, event_state, pass_num, event_cfg,
                     force_fire=force_fire,
                 )
@@ -696,6 +734,8 @@ def make_train_step(
                     fire_raw = fire_raw & ~jnp.broadcast_to(
                         quar, fire_raw.shape
                     )
+                if pol_suppress is not None:
+                    fire_raw = fire_raw & ~pol_suppress
                 leaves = spec.treedef.flatten_up_to(params)
                 B = len(buckets_eff)
                 caps = None
@@ -722,7 +762,7 @@ def make_train_step(
                         )
                     fire_bs.append(fb)
                 fire_vec = jnp.concatenate(fire_bs)
-                event_state = commit(
+                event_state = pol.commit(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
                 obs_prop, obs_fire_vec = prop, fire_vec
@@ -955,6 +995,21 @@ def make_train_step(
                 if (chaos is not None and chaos_policy.sync_after)
                 else None
             )
+            # partitioned policy masks ride the fused engine's existing
+            # force/suppress seams (suppression is applied after force
+            # ORs in — event_engine.event_propose_pack — so it wins)
+            pol_force, pol_suppress = pol.masks(spec, topo, pass_num, event_cfg)
+            if pol_force is not None:
+                force_fire = (
+                    pol_force if force_fire is None
+                    else (force_fire | pol_force)
+                )
+            suppress = quar
+            if pol_suppress is not None:
+                suppress = (
+                    pol_suppress if suppress is None
+                    else (suppress | pol_suppress)
+                )
             # ONE fused sender pass: trigger -> gate -> pack
             # (ops/event_engine.py), replacing the tree path's flatten /
             # propose / capacity_gate / _compact_pack chain below
@@ -967,11 +1022,11 @@ def make_train_step(
                             else None
                         ),
                         force_fire=force_fire,
-                        # quarantine: send nothing this pass
-                        suppress_fire=quar,
+                        # quarantine / non-owned partition: send nothing
+                        suppress_fire=suppress,
                     )
                 )
-                event_state = commit(
+                event_state = pol.commit(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
             obs_prop, obs_fire_vec = prop, fire_vec
@@ -1086,8 +1141,18 @@ def make_train_step(
                 else None
             )
             p_leaves, p_def = jax.tree.flatten(params)
+            # the tree path has no arena, but partition geometry only
+            # needs the cached leaf layout — same masks as the arena twin
+            pol_force, pol_suppress = pol.masks(
+                arena_lib.arena_spec(params), topo, pass_num, event_cfg
+            )
+            if pol_force is not None:
+                force_fire = (
+                    pol_force if force_fire is None
+                    else (force_fire | pol_force)
+                )
             with _phase("gate_pack"):
-                prop = propose(
+                prop = pol.propose(
                     params, event_state, pass_num, event_cfg,
                     force_fire=force_fire,
                 )
@@ -1098,6 +1163,8 @@ def make_train_step(
                     # poisoned values); suppressed leaves re-contend next
                     # pass like a capacity deferral
                     fire_vec = fire_vec & ~quar
+                if pol_suppress is not None:
+                    fire_vec = fire_vec & ~pol_suppress
                 if gossip_wire == "compact":
                     # wire-budget admission: overdue leaves (max_silence)
                     # and chaos forced syncs claim capacity first; the
@@ -1114,7 +1181,7 @@ def make_train_step(
                         fire_vec, leaf_sizes, compact_capacity,
                         priority=pri,
                     )
-                event_state = commit(
+                event_state = pol.commit(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
             obs_prop, obs_fire_vec = prop, fire_vec
@@ -1187,14 +1254,14 @@ def make_train_step(
             fired_frac = fired_leaves / len(p_leaves)
 
         elif algo == "sp_eventgrad":
-            # the propose/commit split of decide_and_update, inlined so
-            # the proposal feeds the telemetry accumulators. (The arena
-            # lift leaves sp alone: its top-k scatter replicas are
-            # tree-shaped state, and the trigger already reads leaves
-            # leaf-parallel.)
+            # the topk TriggerPolicy's propose/commit delegates — the
+            # same norm-delta trigger state machine, with the proposal
+            # feeding the telemetry accumulators. (The arena lift leaves
+            # sp alone: its top-k scatter replicas are tree-shaped
+            # state, and the trigger already reads leaves leaf-parallel.)
             with _phase("gate_pack"):
-                prop = propose(params, event_state, pass_num, event_cfg)
-                event_state = commit(
+                prop = pol.propose(params, event_state, pass_num, event_cfg)
+                event_state = pol.commit(
                     event_state, prop, prop.fire_vec, event_cfg, n_nb
                 )
             p_leaves, p_def = jax.tree.flatten(params)

@@ -65,7 +65,36 @@ def test_repo_artifacts_all_valid():
     # time at a <= 0.5 pt accuracy gap, with every bounded leg
     # replaying bitwise (STRAGGLER_ABLATION_SCHEMA)
     assert "straggler_ablation_cpu.json" in names
+    # the trigger-policy frontier (ISSUE 16): >= 4 policies x >= 2 wire
+    # dtypes of real train() legs; micro's measured bytes strictly
+    # below topk's at equal capacity, per-policy dtype accuracy gap
+    # <= 0.5 pt, f32 legs replay bitwise (FRONTIER_SCHEMA)
+    assert "frontier_cpu.json" in names
     assert out["errors"] == []
+
+
+def test_frontier_gates_encoded_in_schema():
+    """The frontier gates live IN the schema: an artifact violating a
+    gate is a schema violation, not a judgment call."""
+    with open(os.path.join(_ROOT, "artifacts", "frontier_cpu.json")) as f:
+        rec = json.load(f)
+    assert va.validate(rec, va.FRONTIER_SCHEMA) == []
+    for k, bad in [
+        ("micro_below_topk_bytes", False),
+        ("replay_bitwise", False),
+        ("acc_gap_pt", 0.8),
+        ("n_policies", 3),
+        ("n_wire_dtypes", 1),
+    ]:
+        broken = dict(rec, **{k: bad})
+        assert va.validate(broken, va.FRONTIER_SCHEMA), (
+            f"schema must reject {k}={bad!r}"
+        )
+    # a leg whose replay broke must also be rejected
+    legs = [dict(l) for l in rec["legs"]]
+    f32 = next(l for l in legs if "replay_bitwise" in l)
+    f32["replay_bitwise"] = False
+    assert va.validate(dict(rec, legs=legs), va.FRONTIER_SCHEMA)
 
 
 def test_validator_flags_schema_violations():

@@ -255,3 +255,62 @@ def test_exempt_file_exemption_stays_honest():
     assert _run_rule(lint.OsExitConfined(), [zero])
     one = _pkg_file(rel, "import os\nos._exit(13)\n")
     assert not _run_rule(lint.OsExitConfined(), [one])
+
+
+def test_trigger_policy_rule_clean_and_fires():
+    """trigger-policy-registered: the repo's policy-name references all
+    resolve (clean run), and every detection site fires on a seeded bad
+    name — train kwarg, AuditConfig(policy=), CLI choices, the
+    EG_BENCH_POLICY env default — plus the stale direction (a registry
+    entry the CLI flag cannot name)."""
+    sep = os.sep
+    rule = lint.TriggerPolicyRegistered()
+    offenders = _run_rule(rule)
+    assert not offenders, _fmt(offenders)
+
+    bad_train = _pkg_file(
+        f"eventgrad_tpu{sep}bad_pol.py",
+        'train(algo="eventgrad", trigger_policy="bogus")\n',
+    )
+    bad_audit = _pkg_file(
+        f"eventgrad_tpu{sep}bad_pol2.py",
+        'c = AuditConfig(name="x", policy="stale_one")\n',
+    )
+    bad_cli = _pkg_file(
+        f"eventgrad_tpu{sep}bad_pol3.py",
+        'p.add_argument("--trigger-policy", choices=["norm_delta", "typo_k"])\n',
+    )
+    bad_env = _pkg_file(
+        f"eventgrad_tpu{sep}bad_pol4.py",
+        'import os\npol = os.environ.get("EG_BENCH_POLICY", "nope")\n',
+    )
+    for bad in (bad_train, bad_audit, bad_cli, bad_env):
+        viols = rule.check([bad])
+        assert any("not a registry entry" in v.message for v in viols), (
+            bad.rel, _fmt(viols)
+        )
+    # stale direction: the CLI flag must be able to name EVERY
+    # registered policy — dropping one from choices fires
+    stale_cli = _pkg_file(
+        f"eventgrad_tpu{sep}bad_pol5.py",
+        'p.add_argument("--trigger-policy", '
+        'choices=["norm_delta", "topk", "micro"])\n',
+    )
+    viols = rule.check([stale_cli])
+    assert any("hybrid" in v.message and "missing" in v.message
+               for v in viols), _fmt(viols)
+    # scope honesty: a policy= kwarg on a non-AuditConfig call is not a
+    # policy-name site, the empty env default means "inherit", and test
+    # files may seed bad names freely
+    ok_chaos = _pkg_file(
+        f"eventgrad_tpu{sep}ok_pol.py", 'chaos(policy="kill_random")\n'
+    )
+    ok_env = _pkg_file(
+        f"eventgrad_tpu{sep}ok_pol2.py",
+        'import os\np = os.environ.get("EG_BENCH_POLICY", "")\n',
+    )
+    ok_test = _pkg_file(
+        f"tests{sep}test_whatever.py", 'train(trigger_policy="bogus")\n'
+    )
+    for ok in (ok_chaos, ok_env, ok_test):
+        assert not rule.check([ok]), ok.rel

@@ -1,0 +1,283 @@
+"""TriggerPolicy subsystem (parallel/policy.py, ISSUE 16).
+
+Four pins:
+
+  1. norm_delta == the default eventgrad path BITWISE on full TrainState
+     + metrics across the masked|compact x f32/int8 x staleness x
+     bucketed matrix — the policy seam adds zero ops when no masks are
+     in play. (Equivalence to the PRE-refactor engine is pinned by the
+     untouched eventgrad regression suite — test_events/test_compact/
+     test_bucketed all run through the policy seam now.)
+  2. The micro partition geometry: element-balanced leaf-aligned static
+     partitions, disjoint, exact cover; ownership rotates (r + pass)
+     mod K under the SPMD lift; suppression engages only post-warmup
+     (the measured collapse guard — see Micro's class doc).
+  3. topk IS the sp_eventgrad path: the payload helpers moved (not
+     copied) out of sparsify.py, and sp's compact wire is a capacity-
+     free alias accepted end to end, bitwise-equal to masked.
+  4. The registry/guards: resolve() rejects unknown names, no-trigger
+     algos, and algo/policy mismatches; history records stamp
+     rec["policy"]; the frontier tool's --fast leg runs end to end.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgrad_tpu.data.datasets import synthetic_dataset
+from eventgrad_tpu.models import MLP
+from eventgrad_tpu.parallel import arena as arena_lib
+from eventgrad_tpu.parallel import policy as policy_lib
+from eventgrad_tpu.parallel import sparsify
+from eventgrad_tpu.parallel.events import EventConfig
+from eventgrad_tpu.parallel.topology import Ring
+from eventgrad_tpu.train.loop import train
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL = dict(hidden=16)
+IN_SHAPE = (8, 8, 1)
+N_RANKS = 4
+
+
+def _data(n=256):
+    x, y = synthetic_dataset(n, IN_SHAPE, seed=3)
+    return x, y
+
+
+def _run(algo="eventgrad", policy=None, epochs=2, **kw):
+    x, y = _data()
+    cfg = kw.pop("event_cfg", None) or EventConfig(
+        adaptive=True, horizon=0.95, warmup_passes=3, max_silence=10,
+    )
+    return train(
+        MLP(**MODEL), Ring(N_RANKS), x, y, algo=algo, epochs=epochs,
+        batch_size=8, learning_rate=0.05, event_cfg=cfg, seed=0,
+        trigger_policy=policy, log_every_epoch=True, **kw,
+    )
+
+
+def _state_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _spec():
+    params = MLP(**MODEL).init(
+        jax.random.PRNGKey(0), jnp.zeros((1,) + IN_SHAPE)
+    )["params"]
+    return arena_lib.arena_spec(params)
+
+
+# --- 1. norm_delta == default, bitwise, across the wire matrix --------------
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(wire="int8"),
+    dict(gossip_wire="compact", compact_frac=0.9),
+    dict(gossip_wire="compact", compact_frac=0.9, wire="int8"),
+    dict(staleness=1),
+    dict(bucketed=4),
+    dict(bucketed=4, gossip_wire="compact", compact_frac=1.0),
+], ids=["masked_f32", "masked_int8", "compact_f32", "compact_int8",
+        "staleness1", "bucketed4", "bucketed4_compact"])
+def test_norm_delta_is_the_default_bitwise(kw):
+    # one epoch = 32 passes: past warmup (3), adaptive thresholds live,
+    # max_silence (10) fires — bitwise divergence anywhere would show.
+    s_def, h_def = _run(epochs=1, **kw)
+    s_pol, h_pol = _run(policy="norm_delta", epochs=1, **kw)
+    assert _state_equal(s_def, s_pol)
+    for rd, rp in zip(h_def, h_pol):
+        assert rd["loss"] == rp["loss"]
+        assert rd.get("num_events") == rp.get("num_events")
+        assert rd["policy"] == rp["policy"] == "norm_delta"
+
+
+# --- 2. partition geometry + rotation ---------------------------------------
+
+
+def test_partition_masks_disjoint_exact_cover():
+    spec = _spec()
+    for k in (1, 2, 3, 4):
+        v = policy_lib.validate_partitions(spec, k)
+        assert v["ok"], v
+        assert v["disjoint"] and v["exact_cover"] and v["balanced"]
+        assert sum(v["sizes"]) == spec.n_total
+        assert max(v["sizes"]) == v["max_partition_elems"]
+        assert v["max_partition_elems"] == policy_lib.max_partition_elems(
+            spec, k
+        )
+        masks = policy_lib.partition_masks(spec, k)
+        # leaf-aligned bools, each leaf claimed exactly once
+        counts = [sum(m[i] for m in masks) for i in range(spec.n_leaves)]
+        assert counts == [1] * spec.n_leaves
+
+
+def test_partition_table_offsets_are_static_and_contiguous():
+    spec = _spec()
+    tbl = policy_lib.partition_table(spec, 4)
+    assert [d["index"] for d in tbl] == list(range(len(tbl)))
+    pos = 0
+    for d in tbl:
+        assert d["start"] == pos
+        pos += d["size"]
+    assert pos == spec.n_total
+
+
+def test_ownership_rotates_under_the_lift():
+    """ownership_vec under the vmap axis: every pass the rank rows are
+    a disjoint exact cover, and rank r's partition at pass t+1 is rank
+    r+1's at pass t — the (r + pass) mod K rotation."""
+    spec = _spec()
+    topo = Ring(N_RANKS)
+
+    def owned_at(t):
+        f = lambda _: policy_lib.ownership_vec(spec, topo, t)
+        return np.asarray(
+            jax.vmap(f, axis_name="ring")(jnp.arange(N_RANKS))
+        )
+
+    rows = {t: owned_at(t) for t in range(N_RANKS + 1)}
+    for t, m in rows.items():
+        # [n_ranks, L] bools: each leaf owned by exactly one rank
+        assert m.dtype == bool and m.shape == (N_RANKS, spec.n_leaves)
+        assert (m.sum(axis=0) == 1).all()
+    for t in range(N_RANKS):
+        assert (rows[t + 1] == np.roll(rows[t], -1, axis=0)).all()
+    # period K
+    assert (rows[N_RANKS] == rows[0]).all()
+
+
+def test_suppression_gated_on_warmup():
+    """Suppression engages only at pass >= warmup_passes: the warmup
+    full-fire still synchronizes the ranks (suppressing it collapses
+    training — the measured LeNetCifar/Ring(8) failure in Micro's
+    class doc)."""
+    spec = _spec()
+    topo = Ring(N_RANKS)
+    cfg = EventConfig(warmup_passes=5)
+    for pol in (policy_lib.Micro(), policy_lib.Hybrid()):
+        def at(t):
+            f = lambda _: pol.masks(spec, topo, t, cfg)[1]
+            return np.asarray(
+                jax.vmap(f, axis_name="ring")(jnp.arange(N_RANKS))
+            )
+        assert not at(0).any()   # warm: nothing suppressed
+        assert not at(4).any()
+        assert at(5).any()       # post-warmup: ~owned suppressed
+        assert (~at(5)).sum() >= N_RANKS  # owned never suppressed
+    # micro's force mask is the owned partition, warm or not
+    m = policy_lib.Micro()
+    f = lambda _: m.masks(spec, topo, 0, cfg)[0]
+    force = np.asarray(jax.vmap(f, axis_name="ring")(jnp.arange(N_RANKS)))
+    assert (force.sum(axis=0) == 1).all()
+
+
+def test_micro_trains_and_saves_messages():
+    """Post-warmup, micro fires exactly the owned partition: fired_frac
+    == 1/K once warm, and the history stamps the policy."""
+    s, h = _run(policy="micro", epochs=3)
+    assert all(r["policy"] == "micro" for r in h)
+    # epoch 1 contains the 3 warmup full-fire passes; later epochs are
+    # pure rotation at exactly 1/K of the leaves
+    assert h[-1]["fired_frac"] == pytest.approx(1.0 / N_RANKS)
+    assert h[-1]["msgs_saved_pct"] > 50.0
+
+
+def test_hybrid_fires_at_most_the_owned_partition():
+    s, h = _run(policy="hybrid", epochs=3)
+    assert all(r["policy"] == "hybrid" for r in h)
+    assert h[-1]["fired_frac"] <= 1.0 / N_RANKS + 1e-6
+
+
+# --- 3. topk IS sp_eventgrad ------------------------------------------------
+
+
+def test_topk_helpers_moved_not_copied():
+    assert sparsify.topk_payload is policy_lib.topk_payload
+    assert sparsify.scatter_into is policy_lib.scatter_into
+
+
+def test_sp_compact_is_capacity_free_alias_bitwise():
+    """--gossip-wire compact on sp_eventgrad: accepted (the old guard
+    rejected it), needs no capacity, and is bitwise the masked wire —
+    the top-k lanes were statically sized all along."""
+    s_masked, h_masked = _run(algo="sp_eventgrad", epochs=1)
+    s_compact, h_compact = _run(algo="sp_eventgrad", epochs=1,
+                                gossip_wire="compact")
+    assert _state_equal(s_masked, s_compact)
+    assert h_compact[-1]["gossip_wire"] == "compact"
+    assert all(r["policy"] == "topk" for r in h_compact)
+    # capacity-free: compact_frac would size an autotune that does not
+    # exist for this wire
+    with pytest.raises(ValueError, match="capacity-free"):
+        _run(algo="sp_eventgrad", gossip_wire="compact",
+             compact_frac=0.5)
+
+
+# --- 4. registry / guards ---------------------------------------------------
+
+
+def test_resolve_registry():
+    assert policy_lib.resolve(None, "eventgrad").name == "norm_delta"
+    assert policy_lib.resolve(None, "sp_eventgrad").name == "topk"
+    assert policy_lib.resolve("micro", "eventgrad").name == "micro"
+    with pytest.raises(ValueError, match="unknown trigger policy"):
+        policy_lib.resolve("bogus", "eventgrad")
+    with pytest.raises(ValueError, match="no event trigger"):
+        policy_lib.resolve(None, "dpsgd")
+    with pytest.raises(ValueError, match="drives"):
+        policy_lib.resolve("norm_delta", "dpsgd")
+    with pytest.raises(ValueError, match="drives"):
+        policy_lib.resolve("micro", "sp_eventgrad")
+
+
+def test_wire_specs_declare_capabilities():
+    P = policy_lib.POLICIES
+    assert set(P) == {"norm_delta", "topk", "micro", "hybrid"}
+    assert P["topk"].wire_spec().indexed
+    assert not P["topk"].wire_spec().compact_needs_capacity
+    for name in ("micro", "hybrid"):
+        ws = P[name].wire_spec()
+        assert ws.partitioned and not ws.indexed
+        assert "compact" in ws.gossip_wires
+    assert not P["norm_delta"].wire_spec().partitioned
+
+
+def test_non_event_algo_rejects_policy():
+    with pytest.raises(ValueError, match="drives"):
+        _run(algo="dpsgd", policy="micro")
+
+
+# --- the frontier tool's fast leg (tier-1 smoke) ----------------------------
+
+
+def test_frontier_sweep_fast_leg(tmp_path):
+    """The frontier instrument's --fast leg runs end to end: all four
+    policies train, micro's measured bytes undercut topk's strictly at
+    the shared capacity point, and the f32 legs replay bitwise."""
+    spec = importlib.util.spec_from_file_location(
+        "frontier_sweep", os.path.join(ROOT, "tools", "frontier_sweep.py")
+    )
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    out = str(tmp_path / "frontier_fast.json")
+    assert tool.main(["--fast", "--out", out]) == 0
+    with open(out) as f:
+        rec = json.load(f)
+    assert rec["bench"] == "frontier"
+    assert rec["n_policies"] == 4
+    assert rec["micro_below_topk_bytes"] is True
+    assert rec["replay_bitwise"] is True
+    by_pol = {l["policy"]: l for l in rec["legs"]}
+    assert by_pol["micro"]["bytes_per_step_per_chip"] < (
+        by_pol["topk"]["bytes_per_step_per_chip"]
+    )

@@ -570,6 +570,10 @@ _AUDIT_CELL = {
         "callbacks": {"enum": [0]},
         "wire_bytes_per_neighbor_derived": {"type": "number", "minimum": 0},
         "wire_bytes_per_neighbor_formula": {"type": "number", "minimum": 0},
+        # partitioned trigger policies (micro/hybrid) declare their
+        # static partition offsets like fire-bit offsets; a committed
+        # cell with a broken geometry (overlap/gap) is a violation
+        "partitions_ok": {"enum": [True, None]},
     },
 }
 
@@ -592,17 +596,21 @@ AUDIT_SCHEMA = {
         # truth, EVERY seeded oracle violation (rank coupling, dtype
         # upcast, extra ravel, byte-formula drift, host callback, conv
         # rank-merge, unregistered kernel, attention cross-rank gather)
-        # is flagged, and the AST lint rules pass repo-wide
-        "n_configs": {"type": "integer", "minimum": 18},
-        "n_clean": {"type": "integer", "minimum": 18},
-        "configs": {"type": "array", "minItems": 18, "items": _AUDIT_CELL},
+        # is flagged, and the AST lint rules pass repo-wide. The ISSUE
+        # 16 extension adds the partitioned trigger-policy cells
+        # (micro/hybrid x masked|compact x f32/int8, partition offsets
+        # declared + checked) and the partition_overlap oracle: >= 26
+        # cells, >= 12 oracles
+        "n_configs": {"type": "integer", "minimum": 26},
+        "n_clean": {"type": "integer", "minimum": 26},
+        "configs": {"type": "array", "minItems": 26, "items": _AUDIT_CELL},
         # the distinct audit geometries the matrix covered: all four
         "models": {"type": "array", "minItems": 4},
-        "n_oracles": {"type": "integer", "minimum": 8},
-        "n_detected": {"type": "integer", "minimum": 8},
+        "n_oracles": {"type": "integer", "minimum": 12},
+        "n_detected": {"type": "integer", "minimum": 12},
         "oracles": {
             "type": "array",
-            "minItems": 8,
+            "minItems": 12,
             "items": {
                 "type": "object",
                 "required": ["name", "detected"],
@@ -693,6 +701,64 @@ STRAGGLER_ABLATION_SCHEMA = {
     },
 }
 
+FRONTIER_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "schema_version", "topo", "model", "op_point",
+        "n_params", "capacity", "legs", "n_policies", "n_wire_dtypes",
+        "policy_acc_gaps", "acc_gap_pt", "micro_below_topk_bytes",
+        "replay_bitwise", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["frontier"]},
+        "schema_version": {"type": "integer", "minimum": 1},
+        "topo": {"type": "string"},
+        "model": {"type": "string"},
+        "op_point": {"type": "object"},
+        "n_params": {"type": "integer", "minimum": 1},
+        # the shared capacity point: micro/hybrid's compact budget and
+        # the topk_percent pin both derive from the largest static
+        # partition, so the bytes gate compares wires, not budgets
+        "capacity": {"type": "integer", "minimum": 1},
+        # the frontier acceptance gates (ISSUE 16): >= 4 policies x
+        # >= 2 wire dtypes of real train() legs; micro's measured
+        # bytes/step STRICTLY below topk's at every wire dtype (the
+        # index-free partitioned wire is the whole claim); each
+        # policy's accuracy spread across wire dtypes <= 0.5 pt (dtype
+        # is a bytes knob, not an accuracy knob); every f32 leg
+        # replays bitwise from its seed — a committed artifact
+        # violating any of these is a schema violation
+        "legs": {
+            "type": "array",
+            "minItems": 8,
+            "items": {
+                "type": "object",
+                "required": [
+                    "policy", "wire", "algo",
+                    "bytes_per_step_per_chip", "test_accuracy",
+                ],
+                "properties": {
+                    "policy": {"type": "string"},
+                    "wire": {"enum": ["f32", "bf16", "int8"]},
+                    "algo": {"enum": ["eventgrad", "sp_eventgrad"]},
+                    "bytes_per_step_per_chip": {
+                        "type": "number", "minimum": 0,
+                    },
+                    "test_accuracy": {"type": "number", "minimum": 0},
+                    "replay_bitwise": {"enum": [True]},
+                },
+            },
+        },
+        "n_policies": {"type": "integer", "minimum": 4},
+        "n_wire_dtypes": {"type": "integer", "minimum": 2},
+        "policy_acc_gaps": {"type": "object"},
+        "acc_gap_pt": {"type": "number", "minimum": 0, "maximum": 0.5},
+        "micro_below_topk_bytes": {"enum": [True]},
+        "replay_bitwise": {"enum": [True]},
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 PERF_LEDGER_SCHEMA = {
     "type": "object",
     "required": [
@@ -747,6 +813,7 @@ _ARTIFACT_FAMILIES = (
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
+    ("frontier_", FRONTIER_SCHEMA),
     ("perf_ledger", PERF_LEDGER_SCHEMA),
     ("soak_", SOAK_SCHEMA),
     ("straggler_ablation_", STRAGGLER_ABLATION_SCHEMA),
