@@ -508,11 +508,17 @@ def bench_bucketed(k_buckets=(2, 4, 8)):
     fused_mix_commit per bucket instead of one for the whole arena —
     the many-launch regime the fused family measured as a loss on
     trees. This leg proves the per-bucket decomposition BIT-EQUAL to
-    the monolithic call on the LeNetCifar geometry, times both, and on
-    TPU merges `bucketed_tail_speedup` (worst K) into
-    eventgrad_tpu/ops/arena_tuning.json — the entry
-    ops/arena_tuning.bucketed_tail_ok() gates on. No entry -> the step
-    falls back to the monolithic fused path instead of guessing."""
+    the monolithic call on the LeNetCifar geometry, times both, and
+    merges the measured ratios into eventgrad_tpu/ops/arena_tuning.json
+    — the entries ops/arena_tuning.bucketed_tail_ok() gates on. Two
+    entry shapes land there: a per-platform per-K dict
+    (`bucketed_tail_speedup_by_platform`, written on EVERY platform —
+    on CPU both sides time the jnp reference twins, which is exactly
+    the dispatch decision CPU runs face, so the CPU entry is real
+    dispatch evidence and stops the silent demotion there) and the
+    legacy worst-K scalar (`bucketed_tail_speedup`, TPU only). No
+    entry for the active platform -> the step falls back to the
+    monolithic fused path instead of guessing."""
     import os
 
     from eventgrad_tpu.models import LeNetCifar
@@ -594,28 +600,29 @@ def bench_bucketed(k_buckets=(2, 4, 8)):
             "interpret_twin": not on_tpu,
         })
 
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "eventgrad_tpu", "ops", "arena_tuning.json")
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        table = {"platform": jax.devices()[0].device_kind}
+    # per-platform per-K entries, written on EVERY platform: the gate
+    # (ops/arena_tuning.bucketed_tail_ok) decides per configured K, so
+    # a measured-losing K demotes while a measured-winning K runs
+    by_plat = table.setdefault("bucketed_tail_speedup_by_platform", {})
+    by_plat[jax.default_backend()] = {str(K): v for K, v in speed.items()}
     if on_tpu:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "eventgrad_tpu", "ops", "arena_tuning.json")
-        try:
-            with open(path) as f:
-                table = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            table = {"platform": jax.devices()[0].device_kind}
-        # worst K of the sweep: the gate must hold for ANY configured K
+        # legacy worst-K scalar: the fallback older tables gate on
         table["bucketed_tail_speedup"] = min(speed.values())
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(table, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
-        _emit({"tuned": path,
-               "bucketed_tail_speedup": table["bucketed_tail_speedup"]})
-    else:
-        _emit({"tuned": None,
-               "note": "non-TPU platform: arena_tuning.json not written "
-                       "(the bucketed fused tail stays demoted to the "
-                       "monolithic path until a chip measures it)"})
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    _emit({"tuned": path,
+           "platform": jax.default_backend(),
+           "bucketed_tail_speedup_by_k": by_plat[jax.default_backend()]})
 
 
 def tune_flash(seqs=(512, 1024, 2048, 4096), blocks=(128, 256, 512)):

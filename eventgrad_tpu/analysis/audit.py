@@ -165,6 +165,13 @@ class AuditConfig:
     #: their partition geometry validated and declared in the report
     #: (`partitions` / `partitions_ok`), like the fire-bit offsets
     policy: Optional[str] = None
+    #: carrier-resident gossip state (ISSUE 17): EventState.bufs stay
+    #: in the wire dtype with the dequant fused into the commit/mix
+    #: reads. The WIRE format is unchanged (the exchange already
+    #: shipped the carrier), so the same rank-isolation, declared-
+    #: offset, and three-way wire-byte truth must hold over the
+    #: carrier program's jaxpr
+    carrier: bool = False
 
 
 #: the audit matrix: every dimension of the step's configuration space
@@ -199,6 +206,17 @@ CONFIGS: Tuple[AuditConfig, ...] = (
     AuditConfig("event_compact_int8_arena_stale4", gossip_wire="compact",
                 capacity=CAPACITY, wire="int8", arena=True, staleness=4),
     AuditConfig("sp_f32_tree", algo="sp_eventgrad"),
+    # carrier-resident gossip state (ISSUE 17): the receive buffers live
+    # in the wire dtype and the dequant runs inside the commit/mix
+    # reads — the exchange lanes themselves are UNCHANGED, so the
+    # auditor must see the exact same declared offsets and three-way
+    # wire-byte equality over the carrier program, across both carrier
+    # dtypes and both gossip wires (the seeded stale_scale_reuse oracle
+    # proves the value-level harness bites)
+    AuditConfig("event_masked_int8_arena_carrier", wire="int8",
+                arena=True, carrier=True),
+    AuditConfig("event_compact_bf16_arena_carrier", gossip_wire="compact",
+                capacity=CAPACITY, wire="bf16", arena=True, carrier=True),
     # partitioned trigger policies (ISSUE 16): micro's rotating owned-
     # partition sends and hybrid's gated twin must keep the SAME
     # rank-isolation, declared-offset, and three-way wire-byte truth —
@@ -314,6 +332,9 @@ def build(cfg: AuditConfig):
         model, in_shape, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
         bucketed=cfg.bucketed or 1, input_dtype=in_dtype,
         staleness=cfg.staleness if cfg.algo == "eventgrad" else 0,
+        resident_wire=(
+            cfg.wire if cfg.carrier and cfg.algo == "eventgrad" else None
+        ),
     )
     if chaos is not None:
         state = state.replace(
@@ -337,6 +358,7 @@ def build(cfg: AuditConfig):
         integrity=IntegrityConfig() if cfg.integrity else None,
         bucketed=cfg.bucketed or None,
         trigger_policy=cfg.policy,
+        carrier_resident=cfg.carrier,
     )
     return state, step, topo
 
@@ -1184,6 +1206,61 @@ def oracle_partition_overlap() -> Tuple[bool, str]:
     )
 
 
+def oracle_stale_scale_reuse() -> Tuple[bool, str]:
+    """The carrier-resident commit sabotaged to REUSE the resident
+    scales: a fired leaf's int8 carrier rows are overwritten with the
+    candidate's payload but keep the PREVIOUS quantization scale — the
+    classic value/scale tearing a hand-rolled carrier commit would
+    introduce (the buffers still look plausible; the dequantized mix
+    just reads wrongly-scaled neighbors). The carrier contract —
+    resident wire-dtype buffers dequantized at the mix read are
+    BITWISE the f32-resident twin — must catch it: the clean carrier
+    cell stays equal to the f32 reference while the torn commit's
+    trajectory diverges."""
+    cfgc = config_by_name("event_masked_int8_arena_carrier")
+    cfgf = dataclasses.replace(cfgc, name="carrier_f32_ref", carrier=False)
+
+    def torn(cand_scales, effs, last_scales):
+        return last_scales  # values commit, scales don't
+
+    def run(cfg, sabotage=None):
+        orig = collectives.commit_carrier_scales
+        try:
+            if sabotage is not None:
+                # steps.py resolves the name at TRACE time (module
+                # global), so building the step under the rebinding
+                # suffices
+                collectives.commit_carrier_scales = sabotage
+            state, step, topo = build(cfg)
+            lifted = spmd(step, topo)
+            batch = _batch(cfg)
+            for _ in range(4):
+                state, _m = lifted(state, batch)
+        finally:
+            collectives.commit_carrier_scales = orig
+        return state
+
+    ref = run(cfgf)
+    good = run(cfgc)
+    bad = run(cfgc, sabotage=torn)
+
+    def _same(a, b):
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(
+                jax.tree.leaves(a.params), jax.tree.leaves(b.params)
+            )
+        )
+
+    clean_holds = _same(ref, good)
+    detected = clean_holds and not _same(ref, bad)
+    return detected, (
+        "clean carrier cell == f32-resident twin bitwise; the "
+        "scale-reuse commit diverges"
+        if detected else "carrier equivalence harness failed to fire"
+    )
+
+
 ORACLES = {
     "rank_coupling_ppermute": oracle_rank_coupling,
     "late_delivery_drift": oracle_late_delivery_drift,
@@ -1199,6 +1276,8 @@ ORACLES = {
     "attention_cross_rank_gather": oracle_attention_cross_rank_gather,
     # ISSUE 16: partitioned trigger policies
     "partition_overlap": oracle_partition_overlap,
+    # ISSUE 17: carrier-resident gossip state
+    "stale_scale_reuse": oracle_stale_scale_reuse,
 }
 
 

@@ -99,6 +99,13 @@ for _sig in (
         "row blocks",
     ),
     KernelSig(
+        "_carrier_commit_kernel", "eventgrad_tpu/ops/arena_update.py",
+        reviewed="carrier-resident commit+mix+SGD tail: same 1-D row "
+        "grid and index map i -> (i, 0) as _commit_kernel; the in-"
+        "kernel dequant (carrier select * committed scale) is strictly "
+        "elementwise within a row block",
+    ),
+    KernelSig(
         "_mask_kernel", "eventgrad_tpu/ops/event_engine.py",
         reviewed="masked-wire build: 1-D row grid, per-row select",
     ),

@@ -38,6 +38,15 @@ The rules (docs/ANALYSIS.md has the rationale for each):
     kernels; this rule catches the drift at the SOURCE before a trace
     ever runs), and every registry entry must still name a live call
     site in its declared module (stale entries flag).
+  * carrier-dtype-declared — the resident dtype of the EventState
+    receive buffers is declared ONCE, by the arena carrier-layout
+    helper (`parallel/arena.py alloc_event_bufs`, which allocates the
+    carrier arenas and their dequant scales together); an ad-hoc
+    `.astype(...)` inside a `bufs=`/`buf_scales=` allocation or commit
+    site would silently fork the checkpoint layout the carrier-resident
+    restore guard keys on.  Honesty runs the other way too: the
+    EventState owner must still route its arena allocation through the
+    helper, or the rule covers nothing.
   * trigger-policy-registered — every trigger-policy name referenced
     as a string (train's `trigger_policy=`, the CLI's
     `--trigger-policy` choices, bench's `EG_BENCH_POLICY` default,
@@ -737,6 +746,79 @@ class TriggerPolicyRegistered(Rule):
         return out
 
 
+class CarrierDtypeDeclared(Rule):
+    """The resident dtype of EventState's receive buffers is declared
+    ONCE, by the arena carrier-layout helper (`parallel/arena.py
+    alloc_event_bufs` — carrier arenas and their int8 dequant scales
+    allocated together, so the layout can never half-change).  An
+    ad-hoc `.astype(...)` inside a `bufs=`/`buf_scales=` keyword — an
+    EventState construction, a `.replace(...)` commit — re-dtypes the
+    buffers outside that declaration: the carrier-resident restore
+    guard (train/loop.py) keys on the declared layout, so a forked
+    dtype trains on silently-cast state until the next checkpoint
+    round-trip.  The stale direction flags too: the EventState owner
+    module must still route its arena allocation through the helper,
+    or this rule covers nothing."""
+
+    name = "carrier-dtype-declared"
+    OWNER = os.path.join("eventgrad_tpu", "parallel", "events.py")
+    HELPER = "alloc_event_bufs"
+    BUF_KWARGS = frozenset({"bufs", "buf_scales"})
+
+    def check(self, files):
+        out = []
+        owner_seen = False
+        owner_routes = False
+        for sf in files:
+            if not _in_package(sf):
+                continue
+            if sf.rel == self.OWNER:
+                owner_seen = True
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if sf.rel == self.OWNER and (
+                    (isinstance(fn, ast.Name) and fn.id == self.HELPER)
+                    or (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == self.HELPER
+                    )
+                ):
+                    owner_routes = True
+                for kw in node.keywords:
+                    if kw.arg not in self.BUF_KWARGS:
+                        continue
+                    for sub in ast.walk(kw.value):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "astype"
+                        ):
+                            out.append(self._v(
+                                sf, sub.lineno,
+                                f"ad-hoc astype inside an EventState "
+                                f"{kw.arg}= site — the resident dtype "
+                                "of the receive buffers is declared "
+                                "once by parallel/arena.py "
+                                "alloc_event_bufs (carrier layout + "
+                                "dequant scales together); re-dtyping "
+                                "at an allocation/commit site forks "
+                                "the checkpoint layout the carrier-"
+                                "resident restore guard keys on",
+                            ))
+        if owner_seen and not owner_routes:
+            out.append(Violation(
+                self.name, self.OWNER, 1,
+                "EventState's owner no longer routes its arena buffer "
+                "allocation through alloc_event_bufs — the carrier-"
+                "layout helper is the one place the resident dtype (and "
+                "its scales) is declared; allocate through it "
+                "(parallel/arena.py), not ad hoc",
+            ))
+        return out
+
+
 RULES: Sequence[Rule] = (
     ExitCodeLiterals(),
     OsExitConfined(),
@@ -748,6 +830,7 @@ RULES: Sequence[Rule] = (
     ShardMapRespell(),
     ShardMapExemptHonest(),
     TriggerPolicyRegistered(),
+    CarrierDtypeDeclared(),
 )
 
 

@@ -250,6 +250,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "dpsgd/eventgrad on plain data-parallel "
                         "topologies; off = legacy tree path (the A/B "
                         "knob of tools/overhead_ablation.py)")
+    p.add_argument("--carrier-resident", action="store_true",
+                   help="keep the event exchange's receive buffers "
+                        "resident in the wire carrier dtype "
+                        "(train/steps.py carrier_resident): under "
+                        "--wire bf16|int8 EventState.bufs stores the "
+                        "1-2 byte carrier (+ per-leaf scales for int8) "
+                        "and the dequant fuses into the commit/mix "
+                        "reads — bitwise-identical training at a "
+                        "fraction of the buffer HBM traffic "
+                        "(tools/overhead_ablation.py resident). Needs "
+                        "eventgrad + the arena + --wire, and is not "
+                        "combinable with --staleness >= 2")
     p.add_argument("--bucketed", type=int, default=0, metavar="K",
                    help="bucketed gossip schedule (train/steps.py): "
                         "segment the flat arena into K leaf-aligned "
@@ -726,6 +738,7 @@ def main(argv=None) -> int:
                     obs=args.obs, registry=registry,
                     arena={"auto": None, "on": True, "off": False}[args.arena],
                     bucketed=args.bucketed or None,
+                    carrier_resident=args.carrier_resident or None,
                     pipeline={
                         "auto": None, "on": True, "off": False
                     }[args.pipeline],

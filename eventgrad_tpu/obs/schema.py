@@ -380,6 +380,15 @@ PERF_FIELDS = {
         "compile_lower, compile_compile, first_dispatch in the span "
         "registry, cat=\"compile\")",
     ),
+    "resident_dtype": (
+        "f32|bf16|int8", "event algos",
+        "resident dtype of the EventState receive buffers — 'f32' "
+        "unless the run is carrier-resident (train "
+        "carrier_resident=True keeps the buffers in the wire carrier "
+        "dtype); part of every history record and of the perf "
+        "ledger's residency rows, so byte comparisons are keyed on "
+        "the layout that actually ran",
+    ),
 }
 
 

@@ -56,19 +56,42 @@ def mix_commit_ok() -> bool:
     return ratio is None or float(ratio) >= 1.0
 
 
-def bucketed_tail_ok() -> bool:
+def bucketed_tail_ok(k=None) -> bool:
     """Run the fused commit+mix+SGD tail PER BUCKET under the bucketed
     gossip schedule (train/steps.py bucketed= + fused_sgd)?
 
     The per-bucket form launches K kernels instead of one — the
     many-launch regime the fused family measured as a LOSS on trees
     (ops/fused_tuning.py), so it must earn its place with a measured
-    `bucketed_tail_speedup` entry (written by `bench_kernels.py
-    bucketed` on the active device). No table / no entry -> False: an
-    unmeasured shape falls back to the MONOLITHIC fused path instead of
-    guessing (train/loop.py demotes bucketed to K=1 with a warning
-    there). EG_FORCE_ARENA_PALLAS=1 overrides for manual experiments."""
+    entry (written by `python bench_kernels.py bucketed` on the active
+    device). The table carries TWO entry shapes:
+
+      * `bucketed_tail_speedup_by_platform` — per-platform per-K
+        ratios, written on EVERY platform (CPU included: there the
+        bench times the jnp reference twins, which is exactly the
+        dispatch decision CPU runs face). With `k` given, that K's own
+        ratio decides; an unmeasured K falls back to the platform's
+        WORST measured K (the conservative verdict).
+      * `bucketed_tail_speedup` — the legacy worst-K scalar the TPU
+        merge writes; consulted only when the active platform has no
+        per-K entry.
+
+    No table / no entry for this platform -> False: an unmeasured
+    shape falls back to the MONOLITHIC fused path instead of guessing
+    (train/loop.py demotes bucketed to K=1 with a warning there).
+    EG_FORCE_ARENA_PALLAS=1 overrides for manual experiments."""
     if os.environ.get("EG_FORCE_ARENA_PALLAS") == "1":
         return True
+    import jax
+
+    by_k = (
+        _table().get("bucketed_tail_speedup_by_platform") or {}
+    ).get(jax.default_backend())
+    if by_k:
+        if k is not None and str(int(k)) in by_k:
+            ratio = by_k[str(int(k))]
+        else:
+            ratio = min(float(v) for v in by_k.values())
+        return ratio is not None and float(ratio) >= 1.0
     ratio = _table().get("bucketed_tail_speedup")
     return ratio is not None and float(ratio) >= 1.0

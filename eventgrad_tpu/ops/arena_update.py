@@ -186,3 +186,167 @@ def mix_commit_reference(
     mixed = acc * mix_weight
     trace = momentum * t + g
     return mixed - lr * trace, trace, bufs
+
+
+# ---------------------------------------------------------------------------
+# carrier-resident fused tail: the commit+mix+SGD pass READS THE WIRE
+# CARRIER (bf16/int8 candidates and stale buffers; 1-2 B/elem instead
+# of 4) and dequantizes in-register — the select runs on the carrier,
+# the committed buffer is written back in the carrier dtype, and the
+# mix multiplies the selected carrier by the already-COMMITTED
+# per-position scale (`mix_scales`). Bitwise the f32 kernel: within a
+# leaf the keep bit is constant, so
+#     where(keep, cand_q, last_q) * s_committed
+#   == where(keep, cand_q * s_cand, last_q * s_last)
+# elementwise (s_committed is s_cand where the leaf fired, s_last where
+# it kept), and each `q * s` is the exact same f32 multiply the
+# dequantize-at-receive path ran (`collectives._contract_safe`).
+
+def _carrier_commit_kernel(*refs, lr, momentum, w, nb, mix_stale,
+                           has_scales):
+    # INVARIANT: strictly elementwise, like _commit_kernel.
+    p_ref, g_ref, t_ref = refs[:3]
+    cands = refs[3 : 3 + nb]
+    keeps = refs[3 + nb : 3 + 2 * nb]
+    lasts = refs[3 + 2 * nb : 3 + 3 * nb]
+    off = 3 + 3 * nb
+    sm = refs[off : off + nb] if has_scales else ()
+    out0 = off + (nb if has_scales else 0)
+    po_ref, to_ref = refs[out0 : out0 + 2]
+    bufs_out = refs[out0 + 2 :]
+
+    acc = p_ref[:]
+    for i in range(nb):
+        new_q = jnp.where(keeps[i][:] > 0, cands[i][:], lasts[i][:])
+        bufs_out[i][:] = new_q
+        val = (lasts[i][:] if mix_stale else new_q).astype(jnp.float32)
+        if has_scales:
+            val = val * sm[i][:]
+        acc = acc + val
+    mixed = acc * w
+    trace = momentum * t_ref[:] + g_ref[:]
+    po_ref[:] = mixed - lr * trace
+    to_ref[:] = trace
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "lr", "momentum", "w", "nb", "mix_stale", "interpret",
+    ),
+)
+def _fused_commit_carrier_flat(
+    p, g, t, cands, keeps, lasts, mix_scales, *, lr, momentum, w, nb,
+    mix_stale, interpret,
+):
+    has_scales = mix_scales is not None
+    cdt = lasts[0].dtype
+    n = p.size
+    ragged = n % _LANES != 0
+
+    def prep(x, dt):
+        x = x.reshape(-1).astype(dt)
+        if ragged:
+            x = jnp.pad(x, (0, -(-n // _LANES) * _LANES - n))
+        return x.reshape(-1, _LANES)
+
+    args = [prep(p, jnp.float32), prep(g, jnp.float32),
+            prep(t, jnp.float32)]
+    args += [prep(c, cdt) for c in cands]
+    args += [prep(k, jnp.float32) for k in keeps]
+    args += [prep(l, cdt) for l in lasts]
+    if has_scales:
+        args += [prep(s, jnp.float32) for s in mix_scales]
+    rows = args[0].shape[0]
+    grid = (pl.cdiv(rows, _BLOCK_ROWS),)
+    spec = pl.BlockSpec(
+        (_BLOCK_ROWS, _LANES),
+        lambda i: (i, 0),
+        **({"memory_space": _VMEM}
+           if (_VMEM is not None and not interpret) else {}),
+    )
+    extra = {}
+    if not interpret and pltpu is not None:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
+    f32 = jax.ShapeDtypeStruct(args[0].shape, jnp.float32)
+    carr = jax.ShapeDtypeStruct(args[0].shape, cdt)
+    outs = pl.pallas_call(
+        functools.partial(
+            _carrier_commit_kernel, lr=lr, momentum=momentum, w=w,
+            nb=nb, mix_stale=mix_stale, has_scales=has_scales,
+        ),
+        out_shape=(f32, f32) + tuple([carr] * nb),
+        grid=grid,
+        in_specs=[spec] * len(args),
+        out_specs=(spec, spec) + tuple([spec] * nb),
+        interpret=interpret,
+        **extra,
+    )(*args)
+    out_dtypes = [p.dtype, t.dtype] + [cdt] * nb
+    unpad = lambda x, dt: x.reshape(-1)[:n].astype(dt)
+    return tuple(unpad(o, dt) for o, dt in zip(outs, out_dtypes))
+
+
+def fused_mix_commit_carrier(
+    p: jnp.ndarray,
+    cands: Tuple[jnp.ndarray, ...],
+    keeps: Tuple[jnp.ndarray, ...],
+    lasts: Tuple[jnp.ndarray, ...],
+    g: jnp.ndarray,
+    t: jnp.ndarray,
+    lr: float,
+    momentum: float,
+    mix_weight: float,
+    mix_scales: Optional[Tuple[jnp.ndarray, ...]] = None,
+    mix_stale: bool = False,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """Fused commit+mix+SGD whose buffer reads stay in the CARRIER.
+
+    `cands`/`lasts` are per-neighbor bf16/int8 carriers, `mix_scales`
+    the per-position f32 dequant scales of the values the mix consumes
+    (the COMMITTED scales for mix_stale=False, the stale buffers' for
+    mix_stale=True; None for bf16, whose dequant is the bare upcast).
+    Returns (p_new, trace_new, committed_carrier_bufs) — the buffers
+    come back in the carrier dtype."""
+    nb = len(cands)
+    assert len(keeps) == nb and len(lasts) == nb
+    keeps = tuple(k.astype(jnp.float32) for k in keeps)
+    outs = _fused_commit_carrier_flat(
+        p, g, t, tuple(cands), keeps, tuple(lasts),
+        None if mix_scales is None else tuple(mix_scales),
+        lr=float(lr), momentum=float(momentum), w=float(mix_weight),
+        nb=nb, mix_stale=bool(mix_stale), interpret=interpret,
+    )
+    return outs[0], outs[1], tuple(outs[2:])
+
+
+def mix_commit_carrier_reference(
+    p: jnp.ndarray,
+    cands: Tuple[jnp.ndarray, ...],
+    keeps: Tuple[jnp.ndarray, ...],
+    lasts: Tuple[jnp.ndarray, ...],
+    g: jnp.ndarray,
+    t: jnp.ndarray,
+    lr: float,
+    momentum: float,
+    mix_weight: float,
+    mix_scales: Optional[Tuple[jnp.ndarray, ...]] = None,
+    mix_stale: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """jnp twin of the carrier kernel (also the non-TPU path)."""
+    bufs = tuple(
+        jnp.where(k.astype(jnp.float32) > 0, c, l)
+        for c, k, l in zip(cands, keeps, lasts)
+    )
+    acc = p
+    for i in range(len(bufs)):
+        val = (lasts[i] if mix_stale else bufs[i]).astype(jnp.float32)
+        if mix_scales is not None:
+            val = val * mix_scales[i]
+        acc = acc + val
+    mixed = acc * mix_weight
+    trace = momentum * t + g
+    return mixed - lr * trace, trace, bufs

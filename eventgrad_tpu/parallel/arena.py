@@ -249,3 +249,67 @@ def arena_spec(tree: Any) -> ArenaSpec:
 def cache_info():
     """Hit/miss stats of the spec cache (regression-tested)."""
     return _spec_cached.cache_info()
+
+
+# ---------------------------------------------------------------------------
+# carrier-resident buffer layout: the EventState receive buffers can be
+# stored in the WIRE dtype (bf16/int8 carrier + per-leaf f32 dequant
+# scales) instead of dequantized f32 — the dequant multiply moves into
+# the commit/mix reads, which is bitwise-free because the f32 buffers
+# only ever held exactly `dequant(carrier)` (docs/ARCHITECTURE.md
+# "Carrier-resident receive buffers").
+
+#: wire codes that have a resident carrier cheaper than f32
+_CARRIER_DTYPES = {"bf16": jnp.bfloat16, "int8": jnp.int8}
+
+
+def carrier_dtype(wire) -> "Any":
+    """Resident dtype for a wire code — the carrier the bytes crossed
+    the wire in (bf16 -> bfloat16, int8 -> int8) — or None when the
+    buffers stay at the arena dtype (dense/f32 wires have no cheaper
+    carrier, so carrier residency is a no-op for them)."""
+    if wire is None:
+        return None
+    return _CARRIER_DTYPES.get(str(wire))
+
+
+def carrier_needs_scales(wire) -> bool:
+    """int8 carriers dequantize through per-leaf f32 scales; bf16
+    dequant is a pure (exact) upcast and needs none."""
+    return str(wire) == "int8"
+
+
+def alloc_event_bufs(
+    spec: ArenaSpec, n_neighbors: int, *, wire=None, buckets: int = 1,
+):
+    """THE arena EventState.bufs allocation site (lint rule
+    `carrier-dtype-declared`: every buffer allocation must route through
+    here — no ad-hoc `astype`/`zeros` on receive buffers, so the
+    resident dtype is always declared against the wire code).
+
+    Returns `(bufs, buf_scales)`: per-neighbor zero receive buffers in
+    the RESIDENT dtype — the arena dtype classically, the wire carrier
+    under carrier-resident gossip — plus per-leaf f32 dequant scale
+    slots (int8 carrier only; one scalar per leaf per neighbor, because
+    leaves commit wholesale so every element of a leaf shares the scale
+    it crossed the wire with). `buckets=K` gives both the per-bucket
+    tuple layout of the bucketed gossip schedule. A zero carrier
+    dequantizes to exactly +0.0 under every scale, so the zero init is
+    bitwise the classic f32 zero init (event.cpp:177-179)."""
+    cdt = carrier_dtype(wire)
+    dt = spec.dtype if cdt is None else cdt
+    k = int(buckets) if buckets else 1
+    if k > 1:
+        buf0 = tuple(jnp.zeros((b.size,), dt) for b in spec.buckets(k))
+    else:
+        buf0 = jnp.zeros((spec.n_total,), dt)
+    bufs = tuple(buf0 for _ in range(int(n_neighbors)))
+    if cdt is None or not carrier_needs_scales(wire):
+        return bufs, None
+    if k > 1:
+        s0 = tuple(
+            jnp.ones((len(b.sizes),), jnp.float32) for b in spec.buckets(k)
+        )
+    else:
+        s0 = jnp.ones((spec.n_leaves,), jnp.float32)
+    return bufs, tuple(s0 for _ in range(int(n_neighbors)))

@@ -893,6 +893,7 @@ def masked_neighbor_vals_flat(
     checksum: bool = False,
     finite: bool = False,
     corrupt=None,
+    carrier: bool = False,
 ):
     """Event-triggered masked exchange on the arena.
 
@@ -914,8 +915,26 @@ def masked_neighbor_vals_flat(
     `checksum` / `finite` / `corrupt` have the tree masked path's
     integrity semantics (a failed check clears the edge's eff bits; the
     verdicts come back as a fourth return value `oks`, bool
-    [n_neighbors] stacked)."""
+    [n_neighbors] stacked).
+
+    `carrier=True` (bf16/int8 wires only, no integrity riders) returns
+    the candidates STILL IN THE WIRE DTYPE plus a fourth value: the
+    per-neighbor received [L] dequant scale vectors (int8; None for
+    bf16) — the carrier-resident buffer contract, where the dequant
+    multiply happens at the commit/mix reads instead of here."""
     integrity = checksum or finite or corrupt is not None
+    if carrier:
+        if integrity:
+            raise ValueError(
+                "carrier-resident exchange does not compose with the "
+                "integrity riders (their verdicts read dequantized "
+                "values) — use carrier=False"
+            )
+        if wire not in ("bf16", "int8"):
+            raise ValueError(
+                f"carrier-resident exchange needs a bf16/int8 wire; "
+                f"got {wire!r}"
+            )
     leaves = spec.treedef.flatten_up_to(payload)
     dt = spec.dtype
     if wire == "int8":
@@ -951,6 +970,10 @@ def masked_neighbor_vals_flat(
             got_q, got_s, got_vec = got[0], got[1], got[2]
             if corrupt is not None:
                 got_q = corrupt(i, got_q)
+            if carrier:
+                # keep the int8 carrier + its [L] scales: the dequant
+                # multiply moves into the commit/mix reads
+                return got_q, got_vec, None, got_s
             cand = got_q.astype(dt) * got_s[seg].astype(dt)
             ok = (
                 _verify_wire(
@@ -959,7 +982,7 @@ def masked_neighbor_vals_flat(
                 )
                 if integrity else None
             )
-            return cand, got_vec, ok
+            return cand, got_vec, ok, None
     else:
         if wire_builder is not None:
             masked = wire_builder(
@@ -983,6 +1006,10 @@ def masked_neighbor_vals_flat(
             got_flat, got_vec = got[0], got[1]
             if corrupt is not None:
                 got_flat = corrupt(i, got_flat)
+            if carrier:
+                # bf16 carrier: the resident buffer IS the wire buffer;
+                # dequant is the (exact) upcast at the reads
+                return got_flat, got_vec, None, None
             cand = got_flat.astype(dt)
             ok = (
                 _verify_wire(
@@ -991,11 +1018,11 @@ def masked_neighbor_vals_flat(
                 )
                 if integrity else None
             )
-            return cand, got_vec, ok
+            return cand, got_vec, ok, None
 
-    cands, effs, raws, oks = [], [], [], []
+    cands, effs, raws, oks, scls = [], [], [], [], []
     for i, nb in enumerate(topo.neighbors):
-        got_flat, got_vec, ok = receive(nb, i)
+        got_flat, got_vec, ok, got_s = receive(nb, i)
         eff = got_vec
         if ok is not None:
             eff = eff & ok
@@ -1005,6 +1032,11 @@ def masked_neighbor_vals_flat(
         effs.append(eff)
         raws.append(got_vec)
         oks.append(ok)
+        scls.append(got_s)
+    if carrier:
+        return tuple(cands), tuple(effs), tuple(raws), (
+            tuple(scls) if wire == "int8" else None
+        )
     if integrity:
         return tuple(cands), tuple(effs), tuple(raws), jnp.stack(oks)
     return tuple(cands), tuple(effs), tuple(raws)
@@ -1023,6 +1055,7 @@ def compact_neighbor_vals_flat(
     checksum: bool = False,
     finite: bool = False,
     corrupt=None,
+    carrier: bool = False,
 ):
     """Budgeted compacted exchange on the arena.
 
@@ -1036,8 +1069,25 @@ def compact_neighbor_vals_flat(
     time. Returns the same (candidates, eff bits, raw bits) triple as
     the masked flat path, plus the per-edge `oks` verdicts when any of
     `checksum` / `finite` / `corrupt` (tree compact path semantics) is
-    set."""
+    set.
+
+    `carrier=True` has the masked flat path's carrier-resident
+    contract: candidates come back in the wire dtype (the [n]-wide
+    gather runs on the carrier — 1-2 B/elem instead of 4) plus the
+    per-neighbor received [L] scale vectors (int8; None for bf16)."""
     integrity = checksum or finite or corrupt is not None
+    if carrier:
+        if integrity:
+            raise ValueError(
+                "carrier-resident exchange does not compose with the "
+                "integrity riders (their verdicts read dequantized "
+                "values) — use carrier=False"
+            )
+        if wire not in ("bf16", "int8"):
+            raise ValueError(
+                f"carrier-resident exchange needs a bf16/int8 wire; "
+                f"got {wire!r}"
+            )
     capacity = int(capacity)
     if capacity < spec.floor:
         raise ValueError(
@@ -1076,7 +1126,7 @@ def compact_neighbor_vals_flat(
     pos_in_leaf = (
         jnp.arange(spec.n_total, dtype=jnp.int32) - spec.starts_arr()[seg]
     )
-    cands, effs, raws, oks = [], [], [], []
+    cands, effs, raws, oks, scls = [], [], [], [], []
     for i, nb in enumerate(topo.neighbors):
         got_packed, got_scales, got_vec, got_c = ship(nb)
         if corrupt is not None:
@@ -1094,9 +1144,15 @@ def compact_neighbor_vals_flat(
         got_offsets = jnp.cumsum(got_fired) - got_fired
         src = got_offsets[seg] + pos_in_leaf
         data = got_packed[jnp.clip(src, 0, capacity - 1)]
-        val = data.astype(dt)
-        if got_scales is not None:
-            val = val * got_scales[seg].astype(dt)
+        if carrier:
+            # keep the wire carrier; non-fired positions hold clipped
+            # garbage exactly like the dequantized path — the commit's
+            # where(eff, ...) discards them (and their scales) alike
+            val = data
+        else:
+            val = data.astype(dt)
+            if got_scales is not None:
+                val = val * got_scales[seg].astype(dt)
         eff = got_vec
         if ok is not None:
             eff = eff & ok
@@ -1106,6 +1162,11 @@ def compact_neighbor_vals_flat(
         effs.append(eff)
         raws.append(got_vec)
         oks.append(ok)
+        scls.append(got_scales)
+    if carrier:
+        return tuple(cands), tuple(effs), tuple(raws), (
+            tuple(scls) if wire == "int8" else None
+        )
     if integrity:
         return tuple(cands), tuple(effs), tuple(raws), jnp.stack(oks)
     return tuple(cands), tuple(effs), tuple(raws)
@@ -1128,6 +1189,7 @@ def masked_neighbor_vals_bucket(
     wire=None,
     deliver: "Optional[Any]" = None,
     scale_vec: "Optional[jnp.ndarray]" = None,
+    carrier: bool = False,
 ):
     """One bucket of the event-triggered masked exchange.
 
@@ -1136,7 +1198,15 @@ def masked_neighbor_vals_bucket(
     the per-leaf int8 scales (required iff wire == 'int8'; per-leaf
     scales are bucket-invariant, so the slice quantizes bitwise what the
     monolithic wire does). Returns the flat family's (candidates,
-    effective bits, raw bits) triple, every array bucket-sized."""
+    effective bits, raw bits) triple, every array bucket-sized;
+    `carrier=True` has the flat family's carrier-resident contract
+    (wire-dtype candidates + a fourth value: per-neighbor received
+    [L_b] scale vectors for int8, None for bf16)."""
+    if carrier and wire not in ("bf16", "int8"):
+        raise ValueError(
+            f"carrier-resident exchange needs a bf16/int8 wire; got "
+            f"{wire!r}"
+        )
     seg = bucket.seg_expand()
     if wire == "int8":
         q = _wire_concat(
@@ -1158,7 +1228,12 @@ def masked_neighbor_vals_bucket(
             got_q, got_s, got_vec = recv_from(
                 (q, scale_vec, fire_vec), topo, nb
             )
-            return got_q.astype(dtype) * got_s[seg].astype(dtype), got_vec
+            if carrier:
+                return got_q, got_vec, got_s
+            return (
+                got_q.astype(dtype) * got_s[seg].astype(dtype),
+                got_vec, None,
+            )
     else:
         masked = _wire_concat(
             [
@@ -1171,15 +1246,23 @@ def masked_neighbor_vals_bucket(
 
         def receive(nb):
             got_flat, got_vec = recv_from((wire_buf, fire_vec), topo, nb)
-            return got_flat.astype(dtype), got_vec
+            return (
+                got_flat if carrier else got_flat.astype(dtype),
+                got_vec, None,
+            )
 
-    cands, effs, raws = [], [], []
+    cands, effs, raws, scls = [], [], [], []
     for i, nb in enumerate(topo.neighbors):
-        got_flat, got_vec = receive(nb)
+        got_flat, got_vec, got_s = receive(nb)
         eff = got_vec if deliver is None else got_vec & deliver[i]
         cands.append(got_flat)
         effs.append(eff)
         raws.append(got_vec)
+        scls.append(got_s)
+    if carrier:
+        return tuple(cands), tuple(effs), tuple(raws), (
+            tuple(scls) if wire == "int8" else None
+        )
     return tuple(cands), tuple(effs), tuple(raws)
 
 
@@ -1194,6 +1277,7 @@ def compact_neighbor_vals_bucket(
     wire=None,
     deliver: "Optional[Any]" = None,
     scale_vec: "Optional[jnp.ndarray]" = None,
+    carrier: bool = False,
 ):
     """One bucket of the budgeted compacted exchange.
 
@@ -1203,7 +1287,13 @@ def compact_neighbor_vals_bucket(
     capacity-gated bits. Offsets stay the implicit lane — both sides
     recompute them from the bucket's fire bits. Deferral re-contention
     is bucket-local by construction: a deferred leaf competes only for
-    its own bucket's budget next pass (docs/compaction.md)."""
+    its own bucket's budget next pass (docs/compaction.md).
+    `carrier=True` has the flat family's carrier-resident contract."""
+    if carrier and wire not in ("bf16", "int8"):
+        raise ValueError(
+            f"carrier-resident exchange needs a bf16/int8 wire; got "
+            f"{wire!r}"
+        )
     capacity = int(capacity)
     if capacity < bucket.floor:
         raise ValueError(
@@ -1229,20 +1319,28 @@ def compact_neighbor_vals_bucket(
     pos_in_leaf = (
         jnp.arange(bucket.size, dtype=jnp.int32) - bucket.starts_arr()[seg]
     )
-    cands, effs, raws = [], [], []
+    cands, effs, raws, scls = [], [], [], []
     for i, nb in enumerate(topo.neighbors):
         got_packed, got_scales, got_vec = ship(nb)
         got_fired = jnp.where(got_vec, sizes_arr, 0)
         got_offsets = jnp.cumsum(got_fired) - got_fired
         src = got_offsets[seg] + pos_in_leaf
         data = got_packed[jnp.clip(src, 0, capacity - 1)]
-        val = data.astype(dtype)
-        if got_scales is not None:
-            val = val * got_scales[seg].astype(dtype)
+        if carrier:
+            val = data
+        else:
+            val = data.astype(dtype)
+            if got_scales is not None:
+                val = val * got_scales[seg].astype(dtype)
         eff = got_vec if deliver is None else got_vec & deliver[i]
         cands.append(val)
         effs.append(eff)
         raws.append(got_vec)
+        scls.append(got_scales)
+    if carrier:
+        return tuple(cands), tuple(effs), tuple(raws), (
+            tuple(scls) if wire == "int8" else None
+        )
     return tuple(cands), tuple(effs), tuple(raws)
 
 
@@ -1293,3 +1391,110 @@ def mix_flat_into_tree(
             acc = jnp.add(acc, piece)
         out.append(acc * w)
     return jax.tree.unflatten(spec.treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# carrier-resident buffer consumers: the receive buffers stay in the
+# wire dtype (+ per-leaf int8 scales, parallel/arena.py
+# alloc_event_bufs) and the dequant multiply happens HERE, inside the
+# commit/mix reads. Bitwise-free: the f32 buffers only ever held
+# exactly `dequant(carrier)` (the receiver sees post-wire values and
+# dequant is deterministic), leaves commit wholesale so one scale per
+# leaf is exact, and the `_contract_safe` scale truncation makes
+# `q * s` a single exact f32 multiply — the same multiply the
+# dequantize-at-receive path ran.
+
+def commit_carrier_scales(
+    cand_scales: Tuple[jnp.ndarray, ...],
+    effs: Tuple[jnp.ndarray, ...],
+    last_scales: Tuple[jnp.ndarray, ...],
+) -> Tuple[jnp.ndarray, ...]:
+    """Per-neighbor [L] scale commit riding the carrier buffer commit:
+    a fired leaf adopts the scale its candidate crossed the wire with,
+    a stale leaf keeps the scale of its resident carrier — the scalar
+    twin of `commit_bufs_flat`'s wide select (within leaf k every
+    element shares eff[k], so selecting the scale per leaf selects it
+    for exactly the elements the carrier select kept)."""
+    return tuple(
+        jnp.where(e, sc, sl)
+        for sc, e, sl in zip(cand_scales, effs, last_scales)
+    )
+
+
+def mix_carrier_flat_into_tree(
+    params: Any,
+    bufs: Tuple[jnp.ndarray, ...],
+    scales: "Optional[Tuple[jnp.ndarray, ...]]",
+    spec: "arena.ArenaSpec",
+    topo: Topology,
+    gate: "Optional[Any]" = None,
+) -> Any:
+    """`mix_flat_into_tree` over CARRIER buffers: each per-view slice
+    dequantizes on the fly — upcast the carrier piece, multiply by the
+    leaf's scalar scale (int8; bf16 is the bare upcast) — then the
+    identical ordered adds. Elementwise the dequantized values equal
+    what the f32-resident buffer stored, so the mix is bitwise the
+    f32-resident mix (the bucketed schedule's per-bucket mix closures
+    in train/steps.py apply the same per-view dequant inline)."""
+    if gate is None:
+        w = topo.mix_weight
+    else:
+        n_alive = jnp.sum(gate.astype(jnp.float32))
+        w = 1.0 / (1.0 + n_alive)
+    leaves = spec.treedef.flatten_up_to(params)
+    out = []
+    for k, (p, s, z) in enumerate(zip(leaves, spec.starts, spec.sizes)):
+        dt = p.dtype
+        acc = p
+        for i, b in enumerate(bufs):
+            piece = (
+                lax.dynamic_slice_in_dim(b, s, z, 0)
+                .astype(dt)
+                .reshape(p.shape)
+            )
+            if scales is not None:
+                piece = piece * scales[i][k].astype(dt)
+            if gate is not None:
+                piece = jnp.where(gate[i], piece, jnp.zeros_like(piece))
+            acc = jnp.add(acc, piece)
+        out.append(acc * w)
+    return jax.tree.unflatten(spec.treedef, out)
+
+
+def dequant_carrier_bufs(
+    bufs: Tuple[Any, ...],
+    scales: "Optional[Tuple[Any, ...]]",
+    spec: "arena.ArenaSpec",
+    buckets: int = 1,
+) -> Tuple[Any, ...]:
+    """The f32 view of carrier-resident receive buffers — exactly what
+    the f32-resident layout would have stored (the parity/test shim;
+    the hot path never materializes this). Handles both the monolithic
+    [n_total] layout and the per-bucket tuple layout."""
+    dt = spec.dtype
+    k = int(buckets) if buckets else 1
+
+    def one(buf, svec, seg):
+        val = buf.astype(dt)
+        if svec is not None:
+            val = val * svec[seg].astype(dt)
+        return val
+
+    if k > 1:
+        bks = spec.buckets(k)
+        return tuple(
+            tuple(
+                one(
+                    nb_bufs[bi],
+                    None if scales is None else scales[i][bi],
+                    bks[bi].seg_expand(),
+                )
+                for bi in range(k)
+            )
+            for i, nb_bufs in enumerate(bufs)
+        )
+    seg = spec.seg_expand()
+    return tuple(
+        one(nb_buf, None if scales is None else scales[i], seg)
+        for i, nb_buf in enumerate(bufs)
+    )

@@ -226,11 +226,18 @@ class EventState(struct.PyTreeNode):
     #: cumulative int32: commits that arrived >= 2 passes after their
     #: send — the genuinely-late deliveries the bound admitted
     late_commits: jnp.ndarray = None  # type: ignore[assignment]
+    #: carrier-resident gossip (train(carrier_resident=...)): per-leaf
+    #: f32 dequant scales for int8-resident receive buffers — one [L]
+    #: vector per neighbor ([L_b] per bucket under the bucketed
+    #: layout); None for f32/bf16 residency, so legacy states keep the
+    #: exact pytree structure and old checkpoints restore unchanged.
+    buf_scales: Any = None
 
     @classmethod
     def init(
         cls, params: Any, topo: Topology, cfg: EventConfig,
         arena: bool = False, buckets: int = 1, staleness: int = 0,
+        resident_wire=None,
     ) -> "EventState":
         """`arena=True` stores the per-neighbor receive buffers as flat
         [n_params] arenas (parallel/arena.py) instead of pytrees — the
@@ -249,7 +256,15 @@ class EventState(struct.PyTreeNode):
         neighbor plus the per-edge staleness clocks and the late-commit
         counter. The queue depth is part of the checkpoint layout like
         the bucket count — resuming across a different D fails loudly
-        (train/loop.py names the cause)."""
+        (train/loop.py names the cause).
+
+        `resident_wire` ('bf16' | 'int8', arena only) stores the
+        receive buffers CARRIER-RESIDENT: in the wire dtype, plus the
+        per-leaf f32 dequant scales (`buf_scales`, int8 only) — the
+        dequant then happens inside the commit/mix reads
+        (parallel/arena.py alloc_event_bufs). The resident dtype is
+        part of the checkpoint layout; cross-layout restores fail
+        loudly, both directions."""
         n = trees.tree_num_leaves(params)
         zeros = jnp.zeros((n,), jnp.float32)
         depth = int(staleness) if staleness and int(staleness) >= 2 else 0
@@ -265,10 +280,17 @@ class EventState(struct.PyTreeNode):
                 "bucketed buffer layout (per-edge delivery queues are "
                 "whole-wire state)"
             )
+        if depth and resident_wire is not None:
+            raise ValueError(
+                "carrier-resident buffers do not compose with the "
+                "bounded-async delivery queues (staleness>=2): the "
+                "in-flight slots are f32 candidate state"
+            )
+        buf_scales = None
         if arena:
-            from eventgrad_tpu.parallel.arena import arena_spec
+            from eventgrad_tpu.parallel import arena as arena_mod
 
-            spec = arena_spec(params)
+            spec = arena_mod.arena_spec(params)
             if not spec.homogeneous:
                 # the flat buffers pack ONE dtype; a mismatched layout
                 # here would meet the step's tree-path demotion and die
@@ -278,15 +300,19 @@ class EventState(struct.PyTreeNode):
                     f"parameter dtype; got {sorted(set(spec.dtypes))} — "
                     "use arena=False for heterogeneous models"
                 )
-            if buckets and int(buckets) > 1:
-                buf0 = tuple(
-                    jnp.zeros((b.size,), spec.dtype)
-                    for b in spec.buckets(int(buckets))
-                )
-            else:
-                buf0 = jnp.zeros((spec.n_total,), spec.dtype)
+            bufs, buf_scales = arena_mod.alloc_event_bufs(
+                spec, topo.n_neighbors, wire=resident_wire,
+                buckets=int(buckets) if buckets else 1,
+            )
+            buf0 = bufs[0]
         else:
+            if resident_wire is not None:
+                raise ValueError(
+                    "EventState.init(resident_wire=...) rides the flat "
+                    "arena buffer layout; got arena=False"
+                )
             buf0 = trees.tree_zeros_like(params)
+            bufs = tuple(buf0 for _ in topo.neighbors)
         pending = None
         edge_clock = None
         late_commits = None
@@ -308,12 +334,13 @@ class EventState(struct.PyTreeNode):
             last_sent_iter=zeros,
             slopes=jnp.zeros((n, cfg.history), jnp.float32),
             # the same (immutable) zero leaves may back every neighbor
-            bufs=tuple(buf0 for _ in topo.neighbors),
+            bufs=bufs,
             num_events=jnp.zeros((), jnp.int32),
             num_deferred=jnp.zeros((), jnp.int32),
             pending=pending,
             edge_clock=edge_clock,
             late_commits=late_commits,
+            buf_scales=buf_scales,
         )
 
 

@@ -95,6 +95,24 @@ def _snapshot_async_depth(raw: Any) -> int:
     return max(slots) + 1 if slots else 0
 
 
+def _snapshot_resident_wire(raw: Any) -> Optional[str]:
+    """The carrier dtype a peeked snapshot's EventState receive buffers
+    were written in ('bf16' | 'int8'; None = f32-resident / no event
+    buffers) — read from the bufs leaf dtypes on the template-free
+    orbax restore, because a cross-resident restore would otherwise be
+    structurally legal: the buffer SHAPES match, and the path graft
+    silently casts same-shape leaves (utils/checkpoint.py)."""
+    import re as _re
+
+    from eventgrad_tpu.utils.checkpoint import _path_name
+
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]:
+        if _re.match(r"state/event/bufs/", _path_name(kp)):
+            dt = str(getattr(leaf, "dtype", ""))
+            return {"int8": "int8", "bfloat16": "bf16"}.get(dt)
+    return None
+
+
 def _loss_record(pass_base: int, s_i: int, r: int,
                  loss_all: np.ndarray) -> Dict[str, Any]:
     """Per-(pass, rank) loss record — the shared schema of the send trace's
@@ -295,6 +313,7 @@ def train(
     bucketed: Optional[int] = None,
     pipeline: Optional[bool] = None,
     trigger_policy: Optional[str] = None,
+    carrier_resident: Optional[bool] = None,
 ) -> Tuple[Any, List[Dict[str, Any]]]:
     """Run the full training job; returns (final_state, per-epoch history).
 
@@ -351,6 +370,21 @@ def train(
     unmeasured backends demote to the monolithic fused path with a
     warning. History records carry `buckets` and
     `sent_bytes_wire_real_per_bucket`.
+
+    carrier_resident (None = off) keeps the EventState receive buffers
+    CARRIER-RESIDENT: stored in the wire dtype (wire='bf16'/'int8')
+    with per-leaf int8 dequant scales in EventState.buf_scales, the
+    dequant fused into the commit/mix reads (train/steps.py) — bitwise
+    the f32-resident run (the f32 buffers only ever held exactly
+    dequant(carrier)) at 1-2 B/elem of buffer traffic instead of 4.
+    eventgrad + arena + bf16/int8 wire + staleness <= 1 only; not
+    combinable with the integrity engine or chaos bitflip=/nanstep=
+    faults; sp_eventgrad accepts True as a documented no-op. The
+    resident dtype is CHECKPOINT layout: resuming a carrier snapshot
+    into an f32-resident run (or vice versa, or across wire dtypes)
+    fails loudly, both directions. History records carry
+    `rec["resident_dtype"]`; tools/overhead_ablation.py resident is
+    the A/B proof instrument.
 
     staleness (0 | 1 | D >= 2) picks the exchange's asynchrony model
     (train/steps.py): 0 mixes this pass's exchange, 1 the previous
@@ -866,13 +900,15 @@ def train(
                 "(the corruption transform targets one wire buffer per "
                 "edge, which the bucketed schedule splits K ways)"
             )
-        if fused_update and not arena_tuning.bucketed_tail_ok():
+        if fused_update and not arena_tuning.bucketed_tail_ok(bucketed_k):
             import warnings
             warnings.warn(
-                "bucketed fused tail has no measured "
-                "bucketed_tail_speedup entry in ops/arena_tuning.json "
-                "on this backend — falling back to the MONOLITHIC "
-                "fused path (run bench_kernels.py bucketed to measure)",
+                f"bucketed fused tail has no measured winning "
+                f"bucketed_tail_speedup entry for K={bucketed_k} in "
+                "ops/arena_tuning.json on this backend — falling back "
+                "to the MONOLITHIC fused path; run `python "
+                "bench_kernels.py bucketed` on this device to write "
+                "the entry",
                 RuntimeWarning,
             )
             bucketed_k = 1
@@ -916,10 +952,51 @@ def train(
                 "its bootstrap source's in-flight delivery queues — "
                 "run bounded-async without membership, or staleness<=1"
             )
+    # --- carrier-resident resolution (train/steps.py): the EventState
+    # receive buffers then live in the WIRE dtype (+ per-leaf int8
+    # scales in EventState.buf_scales), so the layout must resolve
+    # BEFORE state init. Structural eligibility is checked here (the
+    # state builder needs the answer); the step factory re-validates
+    # the full combinability set (integrity/chaos) with the same
+    # messages. Default OFF: the resident dtype is checkpoint layout,
+    # flipping it is an explicit opt-in.
+    resident_wire = None
+    if carrier_resident:
+        _wire_now = wire or ("bf16" if wire_bf16 else None)
+        if algo == "sp_eventgrad":
+            pass  # documented no-op (steps.py carrier resolution)
+        elif algo != "eventgrad":
+            raise ValueError(
+                "carrier_resident=True re-dtypes the event exchange's "
+                f"receive buffers (algo='eventgrad'); got algo={algo!r}"
+            )
+        elif not arena_on:
+            raise ValueError(
+                "carrier_resident=True rides the flat arena buffer "
+                "layout, but this run resolved arena OFF (explicit "
+                "arena=False, a sharded topology, or heterogeneous "
+                "parameter dtypes) — drop carrier_resident or make the "
+                "run arena-eligible"
+            )
+        elif _wire_now not in ("bf16", "int8"):
+            raise ValueError(
+                "carrier_resident=True keeps the buffers in the wire "
+                f"carrier dtype, but wire={_wire_now!r} has none — use "
+                "wire='bf16'/'int8' (f32 wires are already resident)"
+            )
+        elif staleness >= 2:
+            raise ValueError(
+                f"carrier_resident=True is not combinable with "
+                f"staleness={staleness}: the bounded-async delivery "
+                "queues carry f32 candidate slots"
+            )
+        else:
+            resident_wire = _wire_now
     state = init_fn(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
         input_dtype=input_dtype, arena=arena_on, bucketed=bucketed_k,
         staleness=staleness if algo == "eventgrad" else 0,
+        resident_wire=resident_wire,
     )
     if chaos_sched is not None:
         # per-edge receiver-side health, stacked like every other state
@@ -1029,6 +1106,32 @@ def train(
                     + "; resume with the snapshot's original "
                     f"staleness={'%d' % snap_depth if snap_depth >= 2 else '0/1'}"
                     " setting, then re-snapshot to migrate"
+                )
+
+            # carrier-resident layout guard, BOTH directions: the
+            # resident dtype is part of the checkpoint layout, and a
+            # cross-resident restore is structurally LEGAL in at least
+            # one direction (bf16-carrier and f32-resident buffers have
+            # identical pytree structure and shapes) — the path graft
+            # would silently cast the buffers, corrupting the bitwise
+            # trajectory instead of failing
+            snap_res = _snapshot_resident_wire(memb_raw)
+            if snap_res != resident_wire and algo == "eventgrad":
+                _res_word = lambda w: (
+                    f"carrier-resident wire={w!r}" if w
+                    else "f32-resident"
+                )
+                raise RuntimeError(
+                    "checkpoint restore failed with carrier_resident="
+                    f"{'on (wire=%r)' % resident_wire if resident_wire else 'off'}: "
+                    f"this snapshot was written by a "
+                    f"{_res_word(snap_res)} run, and the resident dtype "
+                    "of the EventState receive buffers is part of the "
+                    "checkpoint layout — a cross-resident restore would "
+                    "silently cast the buffers (and orphan or fabricate "
+                    "the int8 dequant scales); resume with the "
+                    "snapshot's original carrier_resident/wire setting, "
+                    "then re-snapshot to migrate"
                 )
 
             def _restore(tmpl_state):
@@ -1171,6 +1274,7 @@ def train(
             integrity=integ_now,
             bucketed=bucketed_k,
             trigger_policy=trigger_policy,
+            carrier_resident=carrier_resident,
             # NOTE arena_sgd (the all-flat SGD tail) stays off: it costs
             # two extra full-model ravels per step, and the measured CPU
             # ravel price (see ArenaSpec.ravel) makes the unflatten +
@@ -1490,6 +1594,10 @@ def train(
                 ),
                 "n_params": n_params,
                 "arena": bool(arena_on),
+                # resident dtype of the EventState receive buffers —
+                # 'f32' unless carrier-resident (the perf ledger keys
+                # byte comparisons on it; docs/OBSERVABILITY.md)
+                "resident_dtype": resident_wire or "f32",
                 # which SPMD lift ran this block (vmap sim vs shard_map
                 # device mesh) — the perf ledger's comparability-group
                 # key, so mesh rows never gate against vmap rows

@@ -60,6 +60,7 @@ def init_train_state(
     arena: bool = False,
     bucketed: int = 1,
     staleness: int = 0,
+    resident_wire=None,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks.
 
@@ -67,6 +68,12 @@ def init_train_state(
     buffers in the K-bucket layout of the bucketed gossip schedule
     (parallel/arena.py ArenaSpec.buckets) — the layout the bucketed
     train step consumes; see EventState.init.
+
+    `resident_wire` ('bf16' | 'int8'; arena event runs only) carries
+    the receive buffers CARRIER-RESIDENT — stored in the wire dtype
+    with per-leaf int8 dequant scales in EventState.buf_scales — the
+    layout the carrier_resident train step consumes; see
+    EventState.init.
 
     On accelerator backends the whole build — flax init (hundreds of
     small ops for a ResNet), optimizer/event/sparse state, stacking, PRNG
@@ -94,6 +101,7 @@ def init_train_state(
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
                 buckets=bucketed, staleness=staleness,
+                resident_wire=resident_wire,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)
@@ -127,6 +135,7 @@ def init_train_state_spmd(
     arena: bool = False,
     bucketed: int = 1,
     staleness: int = 0,
+    resident_wire=None,
 ) -> TrainState:
     """Per-rank initialization inside the SPMD context — required when the
     topology has `sharded_axes` (tensor/expert parallelism): sharded layers
@@ -147,6 +156,7 @@ def init_train_state_spmd(
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
                 buckets=bucketed, staleness=staleness,
+                resident_wire=resident_wire,
             )
         if algo == "sp_eventgrad":
             sparse = SparseState.init(params, topo)

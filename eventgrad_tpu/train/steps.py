@@ -44,7 +44,12 @@ from eventgrad_tpu.chaos.policy import RecoveryPolicy, alive_mask
 from eventgrad_tpu.chaos.schedule import ChaosSchedule
 from eventgrad_tpu.data.augment import pad_flip_crop
 from eventgrad_tpu.ops import arena_tuning, event_engine
-from eventgrad_tpu.ops.arena_update import fused_mix_commit, mix_commit_reference
+from eventgrad_tpu.ops.arena_update import (
+    fused_mix_commit,
+    fused_mix_commit_carrier,
+    mix_commit_carrier_reference,
+    mix_commit_reference,
+)
 from eventgrad_tpu.ops.fused_update import fused_mix_sgd
 from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
@@ -105,6 +110,7 @@ def make_train_step(
     integrity: Optional[Any] = None,
     bucketed: Optional[int] = None,
     trigger_policy: Optional[str] = None,
+    carrier_resident: Optional[bool] = None,
 ) -> Callable:
     """Build the per-rank step. `batch` is (images [B,H,W,C], labels [B]).
 
@@ -257,6 +263,20 @@ def make_train_step(
     contribute (force, suppress) leaf masks merged into the existing
     chaos force-fire / quarantine-suppress seams. The compact guard
     consults the policy's WireSpec instead of matching on algo.
+
+    carrier_resident=True (eventgrad + arena + bf16/int8 wire;
+    staleness <= 1; no integrity/bitflip riders) keeps EventState.bufs
+    CARRIER-RESIDENT: the buffers store the wire dtype the bytes
+    arrived in, plus per-leaf f32 dequant scales in
+    EventState.buf_scales (int8 only), and the dequant multiply runs
+    inside the commit/mix reads — 1-2 bytes/element of buffer traffic
+    instead of 4, bitwise-identical training (the f32 buffers only
+    ever held exactly dequant(carrier); tests/test_arena.py
+    carrier cells). The state MUST come from
+    EventState.init(..., resident_wire=wire); the resident dtype is
+    checkpoint layout (cross-layout restores fail loudly in both
+    directions, train/loop.py). sp_eventgrad accepts the flag as a
+    documented no-op (its replicas are tree state). Default OFF.
     """
     if algo not in ALGOS:
         raise ValueError(f"unknown algo {algo!r}; expected one of {ALGOS}")
@@ -379,13 +399,15 @@ def make_train_step(
                     "bucketed + fused_sgd rides the arena fused tail "
                     f"(algo='eventgrad'); got algo={algo!r}"
                 )
-            if not arena_tuning.bucketed_tail_ok():
+            if not arena_tuning.bucketed_tail_ok(bucketed):
                 raise ValueError(
-                    "bucketed + fused_sgd needs a measured "
-                    "bucketed_tail_speedup entry in ops/arena_tuning."
-                    "json (run bench_kernels.py bucketed on this "
-                    "device) — unmeasured shapes keep the monolithic "
-                    "fused path (train/loop.py demotes with a warning)"
+                    "bucketed + fused_sgd needs a measured winning "
+                    "bucketed_tail_speedup entry for this K in "
+                    "ops/arena_tuning.json (run `python "
+                    "bench_kernels.py bucketed` on this device to "
+                    "write one) — unmeasured/losing shapes keep the "
+                    "monolithic fused path (train/loop.py demotes "
+                    "with a warning)"
                 )
     chaos_policy = chaos_policy or RecoveryPolicy()
     if chaos is not None:
@@ -440,6 +462,58 @@ def make_train_step(
             # statically sized — compact is a no-op alias of its native
             # wire; no element budget, no dense warmup
             compact_capacity = None
+    # --- carrier-resident resolution: EventState.bufs stay in the WIRE
+    # dtype (+ per-leaf int8 scales in EventState.buf_scales) and the
+    # dequant runs inside the commit/mix reads — bitwise the f32-resident
+    # step (the f32 buffers only ever held exactly dequant(carrier)), at
+    # 1-2 B/elem of buffer traffic instead of 4. The state must then come
+    # from EventState.init(..., resident_wire=wire) — the loop handles
+    # this (train(carrier_resident=...)). Default OFF: the resident dtype
+    # is checkpoint layout, so flipping it is an explicit opt-in.
+    carrier_wire = None
+    if carrier_resident:
+        if algo == "sp_eventgrad":
+            # sp's top-k replicas are tree state (nothing arena-resident
+            # to re-dtype) — accepted as a documented no-op so sweeps can
+            # hold the flag fixed across algos
+            pass
+        else:
+            if algo != "eventgrad":
+                raise ValueError(
+                    "carrier_resident=True re-dtypes the event exchange's "
+                    f"receive buffers (algo='eventgrad'); got algo={algo!r}"
+                )
+            if not arena:
+                raise ValueError(
+                    "carrier_resident=True rides the flat arena buffer "
+                    "layout — needs arena=True (the loop's auto mode "
+                    "resolves this; see train(carrier_resident=...))"
+                )
+            if wire not in ("bf16", "int8"):
+                raise ValueError(
+                    "carrier_resident=True keeps the buffers in the wire "
+                    f"carrier dtype, but wire={wire!r} has none — use "
+                    "wire='bf16'/'int8' (f32 wires are already resident)"
+                )
+            if staleness >= 2:
+                raise ValueError(
+                    f"carrier_resident=True is not combinable with "
+                    f"staleness={staleness}: the bounded-async delivery "
+                    "queues carry f32 candidate slots"
+                )
+            if integ_checksum or integ_quar:
+                raise ValueError(
+                    "carrier_resident=True is not combinable with the "
+                    "in-step integrity defenses (their verdicts read "
+                    "dequantized wire values)"
+                )
+            if chaos is not None and (chaos.has_bitflips or chaos.has_nansteps):
+                raise ValueError(
+                    "carrier_resident=True is not combinable with chaos "
+                    "bitflip=/nanstep= faults (the corruption transform "
+                    "targets the dequantized wire buffer)"
+                )
+            carrier_wire = wire
 
     def step(state, batch):
         x, y = batch
@@ -636,6 +710,11 @@ def make_train_step(
         arena_bufs = None    # flat neighbor buffers for the flat mix/tail
         arena_pending = None # (cands, effs, lasts) awaiting the fused commit
         arena_fire_vec = None
+        # carrier-resident: per-neighbor [L] dequant scales riding the
+        # buffers above (int8 carrier only; None for f32/bf16 residency)
+        use_carrier = carrier_wire is not None
+        arena_buf_scales = None     # scales of the buffers the mix reads
+        arena_pending_scales = None # (cand_scales, last_scales) for the tail
         # bucketed gossip schedule (static, trace-time): the leaf-aligned
         # segmentation the per-bucket pipeline below runs over
         buckets_eff = None
@@ -774,8 +853,12 @@ def make_train_step(
                     if wire == "int8" else None
                 )
             lasts = event_state.bufs  # per-neighbor tuples of buckets
-            shipped = [None] * B      # (cands, effs, raws) per bucket
+            # per-neighbor tuples of per-bucket [L_b] dequant scales
+            # (carrier-resident int8 only; None otherwise)
+            last_scales = event_state.buf_scales
+            shipped = [None] * B      # (cands, effs, raws[, scales]) per bucket
             new_bufs_b = [None] * B   # per bucket: per-neighbor tuple
+            new_scales_b = [None] * B # per bucket: per-neighbor [L_b] scales
             mixed_leaves = [None] * spec.n_leaves
 
             def _bflat(xs):
@@ -804,6 +887,7 @@ def make_train_step(
                                 packed, leaf_id, fire_bs[bi], topo, b,
                                 caps[bi], spec.dtype, wire,
                                 deliver=deliver, scale_vec=sv,
+                                carrier=use_carrier,
                             )
                         )
                 else:
@@ -812,17 +896,23 @@ def make_train_step(
                             collectives.masked_neighbor_vals_bucket(
                                 lv, fire_bs[bi], topo, b, spec.dtype,
                                 wire, deliver=deliver, scale_vec=sv,
+                                carrier=use_carrier,
                             )
                         )
 
             def _commit_bufs(bi):
                 with _phase(f"commit_mix.b{bi}"):
                     b = buckets_eff[bi]
-                    cands, effs, _raws = shipped[bi]
+                    cands, effs, _raws = shipped[bi][:3]
                     last_b = tuple(lasts[i][bi] for i in range(n_nb))
                     new_bufs_b[bi] = collectives.commit_bufs_flat(
                         cands, effs, last_b, b
                     )
+                    if use_carrier and shipped[bi][3] is not None:
+                        new_scales_b[bi] = collectives.commit_carrier_scales(
+                            shipped[bi][3], effs,
+                            tuple(last_scales[i][bi] for i in range(n_nb)),
+                        )
 
             def _mix(bi, w, gate):
                 # per-leaf slices of the bucket buffers feeding the
@@ -830,20 +920,35 @@ def make_train_step(
                 # mix_flat_into_tree, same neighbor add order, bitwise
                 # (int8 dequant products are exactly representable —
                 # collectives._contract_safe — so FMA fusion into these
-                # adds cannot change a bit on either SPMD lift)
+                # adds cannot change a bit on either SPMD lift); under
+                # carrier residency each per-view slice dequantizes on
+                # the fly with the leaf's scalar committed/stale scale
                 with _phase(f"commit_mix.b{bi}"):
                     b = buckets_eff[bi]
                     use_b = (
                         tuple(lasts[i][bi] for i in range(n_nb))
                         if staleness else new_bufs_b[bi]
                     )
+                    use_s = None
+                    if use_carrier and last_scales is not None:
+                        use_s = (
+                            tuple(last_scales[i][bi] for i in range(n_nb))
+                            if staleness else new_scales_b[bi]
+                        )
                     for j, k in enumerate(range(b.lo, b.hi)):
                         p = leaves[k]
                         acc = p
                         for i, buf in enumerate(use_b):
                             piece = lax.dynamic_slice_in_dim(
                                 buf, b.starts_rel[j], b.sizes[j], 0
-                            ).reshape(p.shape)
+                            )
+                            if use_carrier:
+                                piece = piece.astype(p.dtype)
+                                if use_s is not None:
+                                    piece = piece * use_s[i][j].astype(
+                                        p.dtype
+                                    )
+                            piece = piece.reshape(p.shape)
                             if gate is not None:
                                 piece = jnp.where(
                                     gate[i], piece, jnp.zeros_like(piece)
@@ -864,18 +969,26 @@ def make_train_step(
                 )
                 p_new = [None] * spec.n_leaves
                 t_new = [None] * spec.n_leaves
+                kernel_ok = arena_tuning.mix_commit_ok()
                 tail_fn = (
                     functools.partial(
                         fused_mix_commit, interpret=fused_interpret
                     )
-                    if arena_tuning.mix_commit_ok()
+                    if kernel_ok
                     else mix_commit_reference
+                )
+                carrier_tail_fn = (
+                    functools.partial(
+                        fused_mix_commit_carrier, interpret=fused_interpret
+                    )
+                    if kernel_ok
+                    else mix_commit_carrier_reference
                 )
 
                 def _fused_tail(bi):
                     with _phase(f"commit_mix.b{bi}"):
                         b = buckets_eff[bi]
-                        cands, effs, _raws = shipped[bi]
+                        cands, effs, _raws = shipped[bi][:3]
                         seg_b = b.seg_expand()
                         keeps = tuple(e[seg_b] for e in effs)
                         last_b = tuple(lasts[i][bi] for i in range(n_nb))
@@ -885,11 +998,38 @@ def make_train_step(
                             _bflat(t_leaves[b.lo:b.hi]) if mom_f
                             else jnp.zeros_like(flat_b)
                         )
-                        p_b, t_b2, nb_b = tail_fn(
-                            flat_b, cands, keeps, last_b, g_b, t_b,
-                            float(lr_f), float(mom_f), topo.mix_weight,
-                            mix_stale=bool(staleness),
-                        )
+                        if use_carrier:
+                            # the bucket's carrier fused tail: scales
+                            # commit outside the kernel ([L_b] select),
+                            # the buffer reads stay in the wire dtype
+                            mix_scales = None
+                            if shipped[bi][3] is not None:
+                                last_s = tuple(
+                                    last_scales[i][bi] for i in range(n_nb)
+                                )
+                                new_scales_b[bi] = (
+                                    collectives.commit_carrier_scales(
+                                        shipped[bi][3], effs, last_s
+                                    )
+                                )
+                                src = (
+                                    last_s if staleness
+                                    else new_scales_b[bi]
+                                )
+                                mix_scales = tuple(s[seg_b] for s in src)
+                            p_b, t_b2, nb_b = carrier_tail_fn(
+                                flat_b, cands, keeps, last_b, g_b, t_b,
+                                float(lr_f), float(mom_f),
+                                topo.mix_weight, mix_scales=mix_scales,
+                                mix_stale=bool(staleness),
+                            )
+                        else:
+                            p_b, t_b2, nb_b = tail_fn(
+                                flat_b, cands, keeps, last_b, g_b, t_b,
+                                float(lr_f), float(mom_f),
+                                topo.mix_weight,
+                                mix_stale=bool(staleness),
+                            )
                         new_bufs_b[bi] = nb_b
                         for j, k in enumerate(range(b.lo, b.hi)):
                             sl = slice(
@@ -967,6 +1107,11 @@ def make_train_step(
                 tuple(new_bufs_b[bi][i] for bi in range(B))
                 for i in range(n_nb)
             ))
+            if use_carrier and last_scales is not None:
+                event_state = event_state.replace(buf_scales=tuple(
+                    tuple(new_scales_b[bi][i] for bi in range(B))
+                    for i in range(n_nb)
+                ))
             if not bucketed_tail_done:
                 bucketed_mixed = jax.tree.unflatten(
                     spec.treedef, mixed_leaves
@@ -1037,7 +1182,7 @@ def make_train_step(
                         params, fire_vec, packed, leaf_id, topo,
                         compact_capacity, spec, wire, deliver=deliver,
                         checksum=integ_checksum, finite=integ_quar,
-                        corrupt=corrupt_fn,
+                        corrupt=corrupt_fn, carrier=use_carrier,
                     )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -1060,7 +1205,7 @@ def make_train_step(
                         params, fire_vec, topo, spec, wire,
                         deliver=deliver, wire_builder=wb,
                         checksum=integ_checksum, finite=integ_quar,
-                        corrupt=corrupt_fn,
+                        corrupt=corrupt_fn, carrier=use_carrier,
                     )
                 wire_real = jnp.float32(n_nb) * (
                     collectives.wire_real_bytes_per_neighbor(
@@ -1068,7 +1213,12 @@ def make_train_step(
                         fire_bits=True,
                     )
                 )
-            if integ_wire:
+            cand_scales = None
+            if use_carrier:
+                # carrier contract: candidates stay in the wire dtype,
+                # plus the received per-leaf dequant scales (int8 only)
+                cands, effs, raws, cand_scales = res
+            elif integ_wire:
                 cands, effs, raws, oks = res
             else:
                 cands, effs, raws = res
@@ -1090,10 +1240,13 @@ def make_train_step(
                         sync_req=chaos_monitor.sync_requests(need, topo)
                     )
             lasts = event_state.bufs
+            last_scales = event_state.buf_scales
             if use_fused:
                 # receive-commit fuses into the mix+SGD kernel below
                 # (fused_mix_commit): the stale buffers are read once
                 arena_pending = (cands, effs, lasts)
+                if use_carrier:
+                    arena_pending_scales = (cand_scales, last_scales)
             elif staleness >= 2:
                 # bounded-async engine: this pass's candidates enter the
                 # per-edge delivery queues at their scheduled lag
@@ -1119,13 +1272,24 @@ def make_train_step(
                     )
             else:
                 with _phase("commit_mix"):
+                    # dtype-agnostic wide select: carriers commit through
+                    # the same where() as f32 buffers; a fired leaf also
+                    # adopts its candidate's dequant scale
                     new_bufs = collectives.commit_bufs_flat(
                         cands, effs, lasts, spec
                     )
+                    new_scales = last_scales
+                    if cand_scales is not None:
+                        new_scales = collectives.commit_carrier_scales(
+                            cand_scales, effs, last_scales
+                        )
                 # staleness=1: mix with what had arrived as of the
                 # PREVIOUS step; this step's exchange lands for the next
                 arena_bufs = lasts if staleness else new_bufs
-                event_state = event_state.replace(bufs=new_bufs)
+                arena_buf_scales = last_scales if staleness else new_scales
+                event_state = event_state.replace(
+                    bufs=new_bufs, buf_scales=new_scales
+                )
             fired_elems, fired_leaves = _fired_accounting(
                 fire_vec, spec.sizes
             )
@@ -1343,18 +1507,51 @@ def make_train_step(
                     cands, effs, lasts = arena_pending
                     seg = spec.seg_expand()  # [n] keeps for the kernel only
                     keeps = tuple(e[seg] for e in effs)
-                    tail_fn = (
-                        functools.partial(
-                            fused_mix_commit, interpret=fused_interpret
+                    if use_carrier:
+                        # carrier fused tail: the kernel's buffer reads
+                        # stay in the wire dtype; the scales commit
+                        # outside (an [L]-sized select, not an HBM pass)
+                        # and ride in per-position for the mix dequant
+                        cand_scales, last_scales = arena_pending_scales
+                        new_scales = last_scales
+                        mix_scales = None
+                        if cand_scales is not None:
+                            new_scales = collectives.commit_carrier_scales(
+                                cand_scales, effs, last_scales
+                            )
+                            src = last_scales if staleness else new_scales
+                            mix_scales = tuple(s[seg] for s in src)
+                        tail_fn = (
+                            functools.partial(
+                                fused_mix_commit_carrier,
+                                interpret=fused_interpret,
+                            )
+                            if arena_tuning.mix_commit_ok()
+                            else mix_commit_carrier_reference
                         )
-                        if arena_tuning.mix_commit_ok() else mix_commit_reference
-                    )
-                    p_flat, new_t_flat, new_bufs = tail_fn(
-                        flat, cands, keeps, lasts, g_flat, t_flat,
-                        float(lr_f), float(mom_f), topo.mix_weight,
-                        mix_stale=bool(staleness),
-                    )
-                    event_state = event_state.replace(bufs=new_bufs)
+                        p_flat, new_t_flat, new_bufs = tail_fn(
+                            flat, cands, keeps, lasts, g_flat, t_flat,
+                            float(lr_f), float(mom_f), topo.mix_weight,
+                            mix_scales=mix_scales,
+                            mix_stale=bool(staleness),
+                        )
+                        event_state = event_state.replace(
+                            bufs=new_bufs, buf_scales=new_scales
+                        )
+                    else:
+                        tail_fn = (
+                            functools.partial(
+                                fused_mix_commit, interpret=fused_interpret
+                            )
+                            if arena_tuning.mix_commit_ok()
+                            else mix_commit_reference
+                        )
+                        p_flat, new_t_flat, new_bufs = tail_fn(
+                            flat, cands, keeps, lasts, g_flat, t_flat,
+                            float(lr_f), float(mom_f), topo.mix_weight,
+                            mix_stale=bool(staleness),
+                        )
+                        event_state = event_state.replace(bufs=new_bufs)
                 else:
                     buf_sum = jnp.zeros_like(flat)
                     for b in arena_bufs:
@@ -1406,7 +1603,16 @@ def make_train_step(
                         gate = deliver if alive is None else deliver & alive
                     elif alive is not None:
                         gate = alive
-                if arena_bufs:
+                if arena_bufs and use_carrier:
+                    # per-view dequant: slice the carrier, upcast,
+                    # multiply by the leaf's scalar scale — bitwise the
+                    # f32-resident mix (the f32 buffer held exactly
+                    # dequant(carrier))
+                    mixed = collectives.mix_carrier_flat_into_tree(
+                        params, arena_bufs, arena_buf_scales, spec, topo,
+                        gate=gate,
+                    )
+                elif arena_bufs:
                     mixed = collectives.mix_flat_into_tree(
                         params, arena_bufs, spec, topo, gate=gate
                     )

@@ -71,11 +71,23 @@ def step_layout_kwargs(state) -> dict:
     if not bufs:
         return {}
     first = bufs[0]
+    out = None
     if isinstance(first, tuple):  # per-neighbor tuple of per-bucket bufs
-        return {"arena": True, "bucketed": len(first)}
-    if getattr(first, "ndim", None) is not None:  # flat [.., n] array
-        return {"arena": True}
-    return {}  # per-neighbor pytrees: the tree layout
+        out = {"arena": True, "bucketed": len(first)}
+        first = first[0]
+    elif getattr(first, "ndim", None) is not None:  # flat [.., n] array
+        out = {"arena": True}
+    if out is None:
+        return {}  # per-neighbor pytrees: the tree layout
+    # carrier-resident arena: the buffers live in the WIRE dtype (f32-
+    # resident states always carry f32 buffers, whatever the wire), so
+    # the dtype alone names the layout — a carrier step must be traced
+    # with the matching wire or the commit select's dtypes disagree
+    dt = str(getattr(first, "dtype", ""))
+    wire = {"int8": "int8", "bfloat16": "bf16"}.get(dt)
+    if wire is not None:
+        out.update(carrier_resident=True, wire=wire)
+    return out
 
 
 def train_step_flops(model, tx, topo, algo, event_cfg, x, y,

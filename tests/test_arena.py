@@ -231,6 +231,150 @@ def test_arena_fused_tail_matches_tree_fused():
 
 
 # ---------------------------------------------------------------------------
+# carrier-resident gossip state (ISSUE 17): ON-vs-OFF bitwise parity
+
+
+def _build_resident(carrier, *, wire, bucketed=1, gossip_wire="dense",
+                    capacity=None, staleness=0, fused=None, momentum=0.0,
+                    algo="eventgrad", backend="vmap"):
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05, momentum=momentum if momentum else None)
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, algo, CFG, seed=0, arena=True,
+        bucketed=bucketed,
+        resident_wire=(wire if carrier and algo == "eventgrad" else None),
+    )
+    step = make_train_step(
+        model, tx, topo, algo, event_cfg=CFG, wire=wire,
+        gossip_wire=gossip_wire, compact_capacity=capacity,
+        staleness=staleness, fused_sgd=fused, arena=True,
+        bucketed=bucketed, carrier_resident=carrier,
+    )
+    mesh = build_mesh(topo) if backend == "shard_map" else None
+    return state, jax.jit(spmd(step, topo, mesh=mesh))
+
+
+def _carrier_bufs_f32_view(state, buckets=1):
+    """Dequant a carrier-resident state's receive buffers back to f32
+    through the production helper (vmapped over the stacked rank axis).
+    f32-resident states pass through untouched."""
+    ev = state.event
+    leaves = jax.tree.leaves(ev.bufs)
+    if not leaves or leaves[0].dtype == jnp.float32:
+        return ev.bufs
+    spec = arena.arena_spec(jax.tree.map(lambda l: l[0], state.params))
+    if ev.buf_scales is not None:
+        return jax.vmap(lambda b, s: collectives.dequant_carrier_bufs(
+            b, s, spec, buckets=buckets
+        ))(ev.bufs, ev.buf_scales)
+    return jax.vmap(lambda b: collectives.dequant_carrier_bufs(
+        b, None, spec, buckets=buckets
+    ))(ev.bufs)
+
+
+def _assert_resident_bitwise(s_f, s_c, m_f, m_c, buckets=1):
+    for field in ("params", "opt_state", "batch_stats"):
+        for x, y in zip(jax.tree.leaves(getattr(s_f, field)),
+                        jax.tree.leaves(getattr(s_c, field))):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=field
+            )
+    for f in ("thres", "last_sent_norm", "last_sent_iter", "slopes",
+              "num_events", "num_deferred"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_f.event, f)),
+            np.asarray(getattr(s_c.event, f)), err_msg=f,
+        )
+    for x, y in zip(
+        jax.tree.leaves(_carrier_bufs_f32_view(s_f, buckets)),
+        jax.tree.leaves(_carrier_bufs_f32_view(s_c, buckets)),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg="bufs"
+        )
+    for k in m_f:
+        np.testing.assert_array_equal(
+            np.asarray(m_f[k]), np.asarray(m_c[k]), err_msg=f"metric {k}"
+        )
+
+
+#: carrier ON-vs-OFF matrix: gossip wires x carrier dtypes x staleness x
+#: momentum x fused tails x bucketed K (representative crossings; the
+#: bucketed compact capacity sits above that layout's per-bucket floor)
+RESIDENT_CASES = {
+    "masked_int8": dict(wire="int8"),
+    "masked_bf16": dict(wire="bf16"),
+    "masked_int8_stale": dict(wire="int8", staleness=1),
+    "masked_int8_mom": dict(wire="int8", momentum=0.9),
+    "compact_int8": dict(wire="int8", gossip_wire="compact",
+                         capacity=CAPACITY),
+    "compact_bf16_stale": dict(wire="bf16", gossip_wire="compact",
+                               capacity=CAPACITY, staleness=1),
+    "masked_int8_fused": dict(wire="int8", fused=(0.05, 0.0)),
+    "masked_bf16_fused_mom": dict(wire="bf16", momentum=0.9,
+                                  fused=(0.05, 0.9)),
+    "bucketed4_int8": dict(wire="int8", bucketed=4),
+    "bucketed4_compact_int8": dict(wire="int8", bucketed=4,
+                                   gossip_wire="compact", capacity=1300),
+    "bucketed4_bf16_stale": dict(wire="bf16", bucketed=4, staleness=1),
+    "sp_int8_noop": dict(wire="int8", algo="sp_eventgrad"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(RESIDENT_CASES))
+def test_carrier_resident_bitwise_matches_f32_resident(name):
+    """train(carrier_resident=True) — EventState.bufs stored in the wire
+    dtype with dequant fused into the commit/mix reads — is BITWISE the
+    f32-resident step: full TrainState (buffers compared in their f32
+    view) and step metrics, after several steps of real fire patterns.
+    sp_eventgrad accepts the flag as a documented no-op."""
+    kw = dict(RESIDENT_CASES[name])
+    batches = _batches(5)
+    s_f, lift_f = _build_resident(False, **kw)
+    s_c, lift_c = _build_resident(True, **kw)
+    s_f, m_f = _run(s_f, lift_f, batches)
+    s_c, m_c = _run(s_c, lift_c, batches)
+    if kw.get("algo", "eventgrad") == "eventgrad":
+        wdt = {"int8": jnp.int8, "bf16": jnp.bfloat16}[kw["wire"]]
+        assert all(
+            b.dtype == wdt for b in jax.tree.leaves(s_c.event.bufs)
+        ), "carrier leg must actually store wire-dtype buffers"
+    _assert_resident_bitwise(s_f, s_c, m_f[-1], m_c[-1],
+                             buckets=kw.get("bucketed", 1))
+
+
+def test_carrier_resident_guards():
+    """Explicit carrier_resident=True fails loudly off the supported
+    envelope (the silent degradations it replaces were the hazard)."""
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05)
+    with pytest.raises(ValueError, match="algo='eventgrad'"):
+        make_train_step(model, tx, topo, "dpsgd", wire="int8",
+                        carrier_resident=True)
+    with pytest.raises(ValueError, match="arena=True"):
+        make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
+                        wire="int8", carrier_resident=True)
+    with pytest.raises(ValueError, match="wire="):
+        make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
+                        arena=True, carrier_resident=True)
+    with pytest.raises(ValueError, match="staleness=2"):
+        make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
+                        arena=True, wire="int8", staleness=2,
+                        carrier_resident=True)
+    # a carrier state cannot be built for the bounded-async layout either
+    with pytest.raises(ValueError, match="staleness"):
+        init_train_state(model, IN_SHAPE, tx, topo, "eventgrad", CFG,
+                         seed=0, arena=True, staleness=2,
+                         resident_wire="int8")
+    # carrier buffers only exist on the flat arena layout
+    with pytest.raises(ValueError, match="arena"):
+        init_train_state(model, IN_SHAPE, tx, topo, "eventgrad", CFG,
+                         seed=0, resident_wire="int8")
+
+
+# ---------------------------------------------------------------------------
 # fused-op units
 
 

@@ -70,7 +70,33 @@ def test_repo_artifacts_all_valid():
     # below topk's at equal capacity, per-policy dtype accuracy gap
     # <= 0.5 pt, f32 legs replay bitwise (FRONTIER_SCHEMA)
     assert "frontier_cpu.json" in names
+    # the carrier-residency proof (ISSUE 17): buffer-consumer analytic
+    # bytes drop >= 25% with the whole-step drop strictly positive,
+    # scanned paired step ratio <= 1.02, bitwise state
+    # (RESIDENT_ABLATION_SCHEMA)
+    assert "resident_ablation_cpu.json" in names
     assert out["errors"] == []
+
+
+def test_resident_gates_encoded_in_schema():
+    """The carrier-residency gates live IN the schema: an artifact
+    violating a gate is a schema violation, not a judgment call."""
+    with open(os.path.join(
+        _ROOT, "artifacts", "resident_ablation_cpu.json"
+    )) as f:
+        rec = json.load(f)
+    assert va.validate(rec, va.RESIDENT_ABLATION_SCHEMA) == []
+    for k, bad in [
+        ("bitwise_state", False),
+        ("step_ratio", 1.2),
+        ("consumer_bytes_drop_pct", 20.0),
+        ("analytic_bytes_drop_pct", -1.0),
+        ("analytic_bytes_drop_pct", 0.0),
+    ]:
+        broken = dict(rec, **{k: bad})
+        assert va.validate(broken, va.RESIDENT_ABLATION_SCHEMA), (
+            f"schema must reject {k}={bad!r}"
+        )
 
 
 def test_frontier_gates_encoded_in_schema():

@@ -400,7 +400,7 @@ def test_bucketed_validation():
     # to the monolithic fused path with a warning instead)
     from eventgrad_tpu.ops import arena_tuning
 
-    if not arena_tuning.bucketed_tail_ok():
+    if not arena_tuning.bucketed_tail_ok(2):
         with pytest.raises(ValueError, match="bucketed_tail_speedup"):
             make_train_step(
                 model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
@@ -414,7 +414,9 @@ def test_bucketed_fused_tail_parity(monkeypatch):
     tail bitwise — the decomposition is positionwise."""
     from eventgrad_tpu.ops import arena_tuning
 
-    monkeypatch.setattr(arena_tuning, "bucketed_tail_ok", lambda: True)
+    monkeypatch.setattr(
+        arena_tuning, "bucketed_tail_ok", lambda *a, **kw: True
+    )
     batches = _batches(4)
     kw = dict(momentum=0.9)
     topo = Ring(N_RANKS)

@@ -221,10 +221,27 @@ def test_step_layout_detection_and_nonzero_flops():
         model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
         bucketed=2,
     )
+    carrier_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        resident_wire="int8",
+    )
+    bf16_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        resident_wire="bf16",
+    )
     assert step_layout_kwargs(tree_state) == {}
     assert step_layout_kwargs(arena_state) == {"arena": True}
     assert step_layout_kwargs(bucketed_state) == {
         "arena": True, "bucketed": 2,
+    }
+    # carrier-resident states advertise their layout too, so the cost
+    # model traces the program that actually ran (int8/bf16 buffer
+    # reads, not a silently-retraced f32 twin)
+    assert step_layout_kwargs(carrier_state) == {
+        "arena": True, "carrier_resident": True, "wire": "int8",
+    }
+    assert step_layout_kwargs(bf16_state) == {
+        "arena": True, "carrier_resident": True, "wire": "bf16",
     }
     # the regression this fixes: train() auto-enables the arena, and the
     # tree-step trace against that state used to be swallowed into a
@@ -232,6 +249,45 @@ def test_step_layout_detection_and_nonzero_flops():
     assert train_step_flops(
         model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, arena_state
     ) > 0
+
+
+def test_carrier_resident_bytes_below_f32_twin():
+    """The cost model counts buffer reads at the STORED dtype: an int8
+    carrier-resident config's analytic bytes/step sit strictly below
+    its f32-resident twin's (same model, wire, trigger — only the
+    residency differs), and roofline_frac moves with the bytes."""
+    model = MLP(hidden=16)
+    topo = Ring(N_RANKS)
+    tx = optax.sgd(0.05)
+    x, y = synthetic_dataset(64, IN_SHAPE, seed=0)
+    f32_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True
+    )
+    car_state = init_train_state(
+        model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
+        resident_wire="int8",
+    )
+    cm_f = costmodel.analyze_step(
+        model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, f32_state,
+        wire="int8",
+    )
+    # carrier_resident=True rides in from step_layout_kwargs(car_state)
+    cm_c = costmodel.analyze_step(
+        model, tx, topo, "eventgrad", CFG, x, y, PER_RANK, car_state,
+        wire="int8",
+    )
+    assert cm_c["hbm_bytes_total"] < cm_f["hbm_bytes_total"]
+    # at the same step time, fewer bytes -> higher intensity -> higher
+    # memory-bound ceiling -> roofline_frac strictly drops
+    step_s = 0.01
+    rl_f = costmodel.roofline(
+        cm_f["flops_total"], cm_f["hbm_bytes_total"], step_s, GENERIC_CPU
+    )
+    rl_c = costmodel.roofline(
+        cm_c["flops_total"], cm_c["hbm_bytes_total"], step_s, GENERIC_CPU
+    )
+    assert rl_f["roofline_bound"] == "memory"
+    assert rl_c["roofline_frac"] < rl_f["roofline_frac"]
 
 
 # --- roofline / device specs ------------------------------------------------

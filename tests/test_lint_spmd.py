@@ -257,6 +257,56 @@ def test_exempt_file_exemption_stays_honest():
     assert not _run_rule(lint.OsExitConfined(), [one])
 
 
+def test_carrier_dtype_rule_clean_and_fires():
+    """carrier-dtype-declared: the repo's EventState buffer sites all
+    route through the arena carrier-layout helper (clean run), a seeded
+    ad-hoc `.astype` inside a bufs=/buf_scales= site fires, and the
+    honesty direction fires when the EventState owner stops calling
+    alloc_event_bufs."""
+    sep = os.sep
+    rule = lint.CarrierDtypeDeclared()
+    offenders = _run_rule(rule)
+    assert not offenders, _fmt(offenders)
+
+    bad_bufs = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}bad_carrier.py",
+        "def f(state, vals):\n"
+        "    return state.replace(bufs=tuple(\n"
+        "        v.astype('bfloat16') for v in vals))\n",
+    )
+    viols = rule.check([bad_bufs])
+    assert any("ad-hoc astype" in v.message for v in viols), _fmt(viols)
+    bad_scales = _pkg_file(
+        f"eventgrad_tpu{sep}parallel{sep}bad_carrier2.py",
+        "def g(state, s):\n"
+        "    return EventState(buf_scales=s.astype('float32'))\n",
+    )
+    viols = rule.check([bad_scales])
+    assert any("buf_scales" in v.message for v in viols), _fmt(viols)
+    # the honesty direction: an owner that stopped routing through the
+    # helper covers nothing and flags
+    stale_owner = _pkg_file(
+        f"eventgrad_tpu{sep}parallel{sep}events.py", "X = 1\n"
+    )
+    viols = rule.check([stale_owner])
+    assert any("alloc_event_bufs" in v.message for v in viols), _fmt(viols)
+    # passing existing carrier buffers through unchanged stays clean,
+    # and astype on NON-buffer kwargs is out of scope
+    ok_pass = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}ok_carrier.py",
+        "def h(state, new_bufs, x):\n"
+        "    state = state.replace(bufs=new_bufs)\n"
+        "    return state.replace(thres=x.astype('float32'))\n",
+    )
+    assert not rule.check([ok_pass]), _fmt(rule.check([ok_pass]))
+    # test files may seed violations freely (package scope only)
+    ok_test = _pkg_file(
+        f"tests{sep}test_whatever2.py",
+        "s = s.replace(bufs=b.astype('int8'))\n",
+    )
+    assert not rule.check([ok_test]), _fmt(rule.check([ok_test]))
+
+
 def test_trigger_policy_rule_clean_and_fires():
     """trigger-policy-registered: the repo's policy-name references all
     resolve (clean run), and every detection site fires on a seeded bad

@@ -68,13 +68,14 @@ def _batches(seed=3):
 
 
 def _run(backend, *, gossip_wire="dense", wire=None, bucketed=None,
-         staleness=0, obs=False, chaos=None):
+         staleness=0, obs=False, chaos=None, carrier=False):
     topo = Ring(N_RANKS)
     model = MLP(hidden=MLP_HIDDEN)
     tx = optax.sgd(0.05)
     state = init_train_state(
         model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
         bucketed=bucketed or 1, staleness=staleness,
+        resident_wire=(wire if carrier else None),
     )
     if chaos is not None:
         from eventgrad_tpu.chaos import monitor as chaos_monitor
@@ -103,6 +104,7 @@ def _run(backend, *, gossip_wire="dense", wire=None, bucketed=None,
         model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
         gossip_wire=gossip_wire, compact_capacity=capacity, wire=wire,
         bucketed=bucketed, staleness=staleness, obs=obs, chaos=chaos,
+        carrier_resident=carrier,
     )
     mesh = build_mesh(topo) if backend == "shard_map" else None
     lifted = jax.jit(spmd(step, topo, mesh=mesh))
@@ -156,6 +158,25 @@ def test_bounded_async_bitwise_across_lifts(gossip_wire, wire):
     # the straggler actually exercised the late path on both lifts
     assert int(np.asarray(m_v["late_commits"]).sum()) > 0
     assert int(np.asarray(m_v["edge_staleness"]).max()) == 2
+
+
+@pytest.mark.parametrize("bucketed", [None, 4])
+@pytest.mark.parametrize("wire", ["int8", "bf16"])
+def test_carrier_resident_bitwise_across_lifts(wire, bucketed):
+    """Carrier-resident gossip state (ISSUE 17) is part of the
+    cross-lift parity surface: the wire-dtype receive buffers and the
+    per-leaf dequant scales are carried state like everything else,
+    compared `==` (in the carrier dtype — both lifts store the same
+    bits) across the vmap simulator and the shard_map mesh."""
+    s_v, m_v = _run("vmap", wire=wire, bucketed=bucketed, carrier=True)
+    s_s, m_s = _run("shard_map", wire=wire, bucketed=bucketed,
+                    carrier=True)
+    # the parity claim is about the CARRIER program: both lifts must
+    # actually hold wire-dtype buffers, not a silently demoted f32 copy
+    wdt = {"int8": jnp.int8, "bf16": jnp.bfloat16}[wire]
+    for s in (s_v, s_s):
+        assert all(b.dtype == wdt for b in jax.tree.leaves(s.event.bufs))
+    _assert_bitwise(s_v, s_s, m_v, m_s)
 
 
 def test_telemetry_bitwise_across_lifts():

@@ -141,6 +141,22 @@ def test_committed_ledger_covers_six_rounds_with_mfu_and_roofline():
             # step_ms but no MFU-bearing op-point
             assert e["backend"] != "vmap" and e["step_ms"]
             continue
+        if str(e.get("config", "")).startswith("frontier-"):
+            # ISSUE 16/17: frontier rows are bytes-vs-accuracy
+            # instruments (policy x wire at a fixed capacity point),
+            # not timed data rounds — no MFU, but the policy must be
+            # on the comparability key with real byte/accuracy payload
+            assert e["policy"] and e["sent_bytes_wire_real"]
+            assert e["test_accuracy"] is not None
+            continue
+        if str(e.get("config", "")).startswith("resident-"):
+            # ISSUE 17: carrier-residency rows record where the HBM
+            # bytes went when the receive buffers shrank — analytic
+            # bytes + roofline next to the scanned step time, no MFU
+            assert e["resident_dtype"] in ("f32", "bf16", "int8")
+            assert e["hbm_bytes_per_step"] and e["step_ms"]
+            assert e["roofline_bound"] in ("compute", "memory")
+            continue
         # the acceptance instrument: every data round carries MFU and a
         # roofline verdict (cost-model-backfilled on the CPU rounds,
         # record-carried on chip), nominal-spec flagged honestly

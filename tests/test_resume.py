@@ -155,6 +155,57 @@ def test_hybrid_lm_resume_matches_uninterrupted(tmp_path):
     )
 
 
+def test_resume_across_carrier_residency_fails_loudly(tmp_path):
+    """The resident dtype of the receive buffers is checkpoint layout,
+    like the bounded-async depth: resuming across a different residency
+    fails LOUDLY in BOTH directions. The bf16-carrier <-> f32 pair is
+    the dangerous one — identical pytree structure and shapes, so
+    without the guard the restore would silently CAST the buffers
+    instead of failing."""
+    import pytest
+
+    x, y = synthetic_dataset(64, (8, 8, 1), seed=3)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    common = dict(
+        algo="eventgrad", epochs=1, batch_size=4, event_cfg=cfg, seed=0,
+        log_every_epoch=False, save_every=1, arena=True,
+    )
+
+    def go(ck, **kw):
+        return train(MLP(hidden=16), Ring(4), x, y, checkpoint_dir=ck,
+                     **{**common, **kw})
+
+    d1 = str(tmp_path / "car_int8")
+    go(d1, wire="int8", carrier_resident=True)
+    # carrier snapshot -> f32-resident resume (scales would be orphaned)
+    with pytest.raises(RuntimeError, match="carrier"):
+        go(d1, wire="int8", resume=True, epochs=2)
+    # carrier-int8 snapshot -> carrier-bf16 resume (dtype mismatch)
+    with pytest.raises(RuntimeError, match="carrier"):
+        go(d1, wire="bf16", carrier_resident=True, resume=True, epochs=2)
+
+    d2 = str(tmp_path / "f32_resident")
+    go(d2, wire="int8")
+    # f32-resident snapshot -> carrier resume (the grow direction)
+    with pytest.raises(RuntimeError, match="carrier"):
+        go(d2, wire="int8", carrier_resident=True, resume=True, epochs=2)
+
+    d3 = str(tmp_path / "car_bf16")
+    go(d3, wire="bf16", carrier_resident=True)
+    # bf16-carrier snapshot -> f32 resume: structurally LEGAL (same
+    # pytree/shapes), so this is exactly the silent-cast hazard
+    with pytest.raises(RuntimeError, match="carrier"):
+        go(d3, wire="bf16", resume=True, epochs=2)
+
+    # same-layout resumes round-trip on both carrier dtypes
+    _, h1 = go(d1, wire="int8", carrier_resident=True, resume=True,
+               epochs=2)
+    assert [r["epoch"] for r in h1] == [2]
+    _, h3 = go(d3, wire="bf16", carrier_resident=True, resume=True,
+               epochs=2)
+    assert [r["epoch"] for r in h3] == [2]
+
+
 def test_delayed_gossip_resume_matches_uninterrupted(tmp_path):
     """staleness=1 carries its pending exchange in EventState.bufs, which is
     part of the snapshot — an interrupted delayed-gossip run resumes onto

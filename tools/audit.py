@@ -3,7 +3,9 @@
 Walks the full configuration matrix of the fused train step
 (eventgrad_tpu/analysis/audit.py: dpsgd/eventgrad/sp_eventgrad x
 masked|compact x arena on/off x obs/chaos/integrity on/off x wire
-dtypes x the bucketed gossip schedule at K=4 — ON THE PRODUCTION
+dtypes x the bucketed gossip schedule at K=4 x carrier-resident
+receive buffers (EventState.bufs held in the wire dtype) — ON THE
+PRODUCTION
 GEOMETRIES: LeNetCifar and ResNet18 (conv rank-major merges tracked as
 blocked layouts), a small transformer full+flash (Pallas kernels via
 the declared-kernel registry, analysis/kernels.py), alongside the MLP

@@ -20,11 +20,22 @@ in tools/validate_artifacts.py):
     (tools/mesh_ablation.py) joins the trajectory as
     backend="shard_map" entries: real-collective step times at the
     ablation op-point plus the 64-rank scale leg;
+  * frontier rows — artifacts/frontier_*.json (tools/frontier.py)
+    joins per policy x wire leg (config="frontier-<wire>", `policy` in
+    the group key), so the bytes-vs-accuracy sweep's sent-bytes and
+    msgs-saved numbers get the same regression tracking as the bench
+    tiers without ever cross-gating between legs;
+  * residency rows — artifacts/resident_ablation_*.json
+    (tools/overhead_ablation.py resident) joins per residency leg
+    (config="resident-<dtype>", `resident_dtype` on the row) with each
+    leg's analytic bytes/step and roofline next to its measured
+    scanned step time;
   * regression gates — explicit ratio-vs-previous-round thresholds,
     evaluated within comparability groups (same
-    platform+model+config+backend; a TPU flagship round is never
-    compared against a CPU tiny smoke, and a shard_map mesh row never
-    gates against a vmap simulator row).
+    platform+model+config+backend+policy; a TPU flagship round is
+    never compared against a CPU tiny smoke, a shard_map mesh row
+    never gates against a vmap simulator row, and a sparse trigger
+    policy's traffic never gates against a dense one's).
     A failed gate fails `--check` (exit 1) AND the committed artifact
     (the schema pins `gates_all_ok: true`), so a regression cannot be
     committed silently.
@@ -82,14 +93,18 @@ _PER_RANK_BY_CONFIG = {
 
 def comparable_key(
     rec: Dict[str, Any],
-) -> Optional[Tuple[str, str, str, str]]:
+) -> Optional[Tuple[str, str, str, str, str]]:
     """Comparability group of a bench record/ledger entry: rounds are
     gated against each other ONLY within (platform, model, config,
-    backend). The backend dimension (vmap single-chip simulator vs
-    shard_map device mesh, ISSUE 14) keeps mesh rows from ever gating
-    against vmap rows — a real-collective step time is not a
+    backend, policy). The backend dimension (vmap single-chip simulator
+    vs shard_map device mesh, ISSUE 14) keeps mesh rows from ever
+    gating against vmap rows — a real-collective step time is not a
     regression of a batched-simulation one; records predating the
-    field were all vmap."""
+    field were all vmap. The policy dimension (trigger policies,
+    ISSUE 16: threshold vs micro vs topk rows from the frontier sweep)
+    keeps a sparser policy's sent-bytes/msgs-saved from ever gating
+    against a denser one's; records predating the field all ran the
+    default adaptive-threshold trigger."""
     plat, model, cfg = (
         rec.get("platform"), rec.get("model"), rec.get("config"),
     )
@@ -98,6 +113,7 @@ def comparable_key(
     return (
         str(plat), str(model), str(cfg),
         str(rec.get("backend") or "vmap"),
+        str(rec.get("policy") or "default"),
     )
 
 
@@ -220,6 +236,92 @@ def _mesh_entries(root: str, next_round: int) -> List[Dict[str, Any]]:
     return out
 
 
+def _frontier_entries(root: str, next_round: int) -> List[Dict[str, Any]]:
+    """Bytes-vs-accuracy frontier rows from artifacts/frontier_*.json
+    (tools/frontier.py, ISSUE 16): each policy x wire leg joins the
+    trajectory as its own comparability group — `policy` rides the
+    group key and the wire folds into `config` ("frontier-<wire>"), so
+    an int8 leg's sent-bytes never gates against an f32 leg's and a
+    sparse policy's msgs-saved never gates against a dense one's."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+        os.path.join(root, "artifacts", "frontier_*.json")
+    )):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        name = os.path.basename(path)
+        op = rec.get("op_point", {})
+        for leg in rec.get("legs", ()):
+            wire = leg.get("wire") or "f32"
+            out.append({
+                "round": next_round, "source": f"{name}#{leg.get('policy')}-{wire}",
+                "status": "ok", "git_round": None,
+                "provenance": op.get("data", "synthetic-prototype"),
+                "platform": rec.get("platform"),
+                "config": f"frontier-{wire}",
+                "model": rec.get("model"),
+                "backend": leg.get("backend", "vmap"),
+                "policy": leg.get("policy"),
+                "wire": leg.get("wire"),
+                "gossip_wire": leg.get("gossip_wire"),
+                "msgs_saved_pct": leg.get("msgs_saved_pct"),
+                "sent_bytes_wire_real": leg.get("bytes_per_step_per_chip"),
+                "test_accuracy": leg.get("test_accuracy"),
+                "fired_frac": leg.get("fired_frac"),
+                "mfu": None,
+                "mfu_source": None,
+            })
+    return out
+
+
+def _resident_entries(root: str, next_round: int) -> List[Dict[str, Any]]:
+    """Carrier-residency rows from artifacts/resident_ablation_*.json
+    (tools/overhead_ablation.py resident, ISSUE 17): the f32-resident
+    and carrier-resident legs join as separate comparability groups
+    (the residency folds into `config`), each carrying its analytic
+    bytes/step and roofline next to the measured scanned step time —
+    the ledger's record of WHERE the bytes went when the buffers
+    shrank."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+        os.path.join(root, "artifacts", "resident_ablation_*.json")
+    )):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        name = os.path.basename(path)
+        op = rec.get("op_point", {})
+        for leg, res in (rec.get("results") or {}).items():
+            if not isinstance(res, dict):
+                continue
+            out.append({
+                "round": next_round, "source": f"{name}#{leg}",
+                "status": "ok", "git_round": None,
+                "provenance": "synthetic-prototype",
+                "platform": rec.get("platform"),
+                "config": f"resident-{res.get('resident_dtype')}",
+                "model": op.get("model"),
+                "backend": "vmap",
+                "policy": "default",
+                "resident_dtype": res.get("resident_dtype"),
+                "wire": op.get("wire"),
+                "gossip_wire": op.get("gossip_wire"),
+                "step_ms": res.get("step_ms_p50"),
+                "hbm_bytes_per_step": res.get("hbm_bytes_per_step"),
+                "arithmetic_intensity": res.get("arithmetic_intensity"),
+                "roofline_bound": res.get("roofline_bound"),
+                "roofline_frac": res.get("roofline_frac"),
+                "mfu": None,
+                "mfu_source": None,
+            })
+    return out
+
+
 #: perf-ablation artifacts folded in as trajectory snapshots: each is
 #: already schema-gated on its own acceptance bound; the ledger records
 #: the headline number so one file answers "where does the perf stand"
@@ -228,6 +330,7 @@ _ABLATIONS = (
     ("bucketed", "bucketed_ablation_cpu.json", "overhead_ratio"),
     ("pipeline_bubble", "pipeline_bubble_cpu.json", "bubble_ratio"),
     ("obs_overhead", "obs_overhead_cpu.json", "overhead_pct_p50"),
+    ("resident", "resident_ablation_cpu.json", "consumer_bytes_drop_pct"),
 )
 
 
@@ -356,7 +459,7 @@ def evaluate_gates(entries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     comparability group. Pure on the entry dicts — the seeded-regression
     test drives this directly."""
     results: List[Dict[str, Any]] = []
-    by_group: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
+    by_group: Dict[Tuple[str, ...], List[Dict[str, Any]]] = {}
     for e in sorted(
         (e for e in entries if e.get("status") == "ok"),
         key=lambda e: e["round"],
@@ -405,6 +508,8 @@ def build_ledger(root: str, with_costmodel: bool = True,
     entries.sort(key=lambda e: e["round"])
     next_round = (entries[-1]["round"] + 1) if entries else 1
     entries.extend(_mesh_entries(root, next_round))
+    entries.extend(_frontier_entries(root, next_round))
+    entries.extend(_resident_entries(root, next_round))
     if with_costmodel:
         _costmodel_fill(entries, quiet)
     gates = evaluate_gates(entries)

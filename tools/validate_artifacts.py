@@ -599,18 +599,21 @@ AUDIT_SCHEMA = {
         # is flagged, and the AST lint rules pass repo-wide. The ISSUE
         # 16 extension adds the partitioned trigger-policy cells
         # (micro/hybrid x masked|compact x f32/int8, partition offsets
-        # declared + checked) and the partition_overlap oracle: >= 26
-        # cells, >= 12 oracles
-        "n_configs": {"type": "integer", "minimum": 26},
-        "n_clean": {"type": "integer", "minimum": 26},
-        "configs": {"type": "array", "minItems": 26, "items": _AUDIT_CELL},
+        # declared + checked) and the partition_overlap oracle; the
+        # ISSUE 17 extension adds the carrier-resident cells
+        # (masked-int8 + compact-bf16, EventState.bufs held in the wire
+        # dtype) and the stale_scale_reuse oracle: >= 28 cells,
+        # >= 13 oracles
+        "n_configs": {"type": "integer", "minimum": 28},
+        "n_clean": {"type": "integer", "minimum": 28},
+        "configs": {"type": "array", "minItems": 28, "items": _AUDIT_CELL},
         # the distinct audit geometries the matrix covered: all four
         "models": {"type": "array", "minItems": 4},
-        "n_oracles": {"type": "integer", "minimum": 12},
-        "n_detected": {"type": "integer", "minimum": 12},
+        "n_oracles": {"type": "integer", "minimum": 13},
+        "n_detected": {"type": "integer", "minimum": 13},
         "oracles": {
             "type": "array",
-            "minItems": 12,
+            "minItems": 13,
             "items": {
                 "type": "object",
                 "required": ["name", "detected"],
@@ -759,6 +762,51 @@ FRONTIER_SCHEMA = {
     },
 }
 
+RESIDENT_ABLATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "schema_version", "op_point", "results", "step_ratio",
+        "analytic_bytes_f32", "analytic_bytes_carrier",
+        "analytic_bytes_drop_pct", "consumer_bytes_f32",
+        "consumer_bytes_carrier", "consumer_bytes_drop_pct",
+        "roofline_frac_f32", "roofline_frac_carrier", "bitwise_state",
+        "platform",
+    ],
+    "properties": {
+        "bench": {"enum": ["resident_ablation"]},
+        "schema_version": {"type": "integer", "minimum": 1},
+        "op_point": {"type": "object"},
+        "results": {"type": "object"},
+        # the carrier-residency acceptance gates (ISSUE 17): the
+        # buffer-consumer subsystem (receive-dequant + commit select +
+        # mix read, traced from the production collectives by
+        # obs/costmodel.py) moves >= 25% fewer analytic HBM bytes when
+        # the buffers stay in the int8 carrier; the WHOLE-step analytic
+        # bytes also drop strictly (the step total is dominated by
+        # trigger/gate-pack/grad/optimizer traffic residency never
+        # touches, so its percentage is structurally diluted); the
+        # scanned median-paired step ratio shows the dequant fusion is
+        # free on CPU; and the carrier leg's final TrainState + scanned
+        # metrics equal the f32-resident leg's bitwise — a committed
+        # artifact violating any of these is a schema violation
+        "step_ratio": {"type": "number", "minimum": 0, "maximum": 1.02},
+        "analytic_bytes_f32": {"type": "number", "minimum": 1},
+        "analytic_bytes_carrier": {"type": "number", "minimum": 1},
+        "analytic_bytes_drop_pct": {
+            "type": "number", "minimum": 1e-9, "maximum": 100,
+        },
+        "consumer_bytes_f32": {"type": "number", "minimum": 1},
+        "consumer_bytes_carrier": {"type": "number", "minimum": 1},
+        "consumer_bytes_drop_pct": {
+            "type": "number", "minimum": 25, "maximum": 100,
+        },
+        "roofline_frac_f32": {"type": "number", "minimum": 0},
+        "roofline_frac_carrier": {"type": "number", "minimum": 0},
+        "bitwise_state": {"enum": [True]},
+        "platform": {"type": "string"},
+    },
+}
+
 PERF_LEDGER_SCHEMA = {
     "type": "object",
     "required": [
@@ -811,6 +859,7 @@ _ARTIFACT_FAMILIES = (
     ("bucketed_ablation_", BUCKETED_ABLATION_SCHEMA),
     ("mesh_ablation_", MESH_ABLATION_SCHEMA),
     ("pipeline_bubble_", PIPELINE_BUBBLE_SCHEMA),
+    ("resident_ablation_", RESIDENT_ABLATION_SCHEMA),
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
     ("frontier_", FRONTIER_SCHEMA),
