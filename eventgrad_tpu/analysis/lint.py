@@ -47,6 +47,13 @@ The rules (docs/ANALYSIS.md has the rationale for each):
     restore guard keys on.  Honesty runs the other way too: the
     EventState owner must still route its arena allocation through the
     helper, or the rule covers nothing.
+  * telemetry-counter-ledgered — message-lifecycle disposition
+    counters move ONLY through `obs/ledger.py ledger_update`; outside
+    `obs/` a `ledger=` keyword must be a pass-through and the ledger's
+    counter arrays must never be `.at[...]`-mutated, else a path can
+    double-count or skip a fate — exactly the leaks the conservation
+    auditor (tools/ledger_audit.py) exists to catch.  Honesty-checked:
+    the helper must still perform the scatter-adds itself.
   * trigger-policy-registered — every trigger-policy name referenced
     as a string (train's `trigger_policy=`, the CLI's
     `--trigger-policy` choices, bench's `EG_BENCH_POLICY` default,
@@ -819,6 +826,125 @@ class CarrierDtypeDeclared(Rule):
         return out
 
 
+class TelemetryCounterLedgered(Rule):
+    """Message-lifecycle disposition counters move ONLY through the
+    ledger helper (`obs/ledger.py ledger_update`) — that single site is
+    what makes the conservation laws auditable (tools/ledger_audit.py):
+    a path that increments a disposition with its own `.at[...].add` or
+    `+ 1` can double-count or skip a fate, exactly the leaks the
+    auditor exists to catch.  Outside `eventgrad_tpu/obs/`, a
+    `ledger=` keyword must be a pass-through (a bare name/attribute or
+    None), never computed in place, and the ledger's `counts`/`queue`
+    arrays must never be `.at[...]`-mutated.  The stale direction
+    flags too: `obs/ledger.py` must still define `ledger_update` and
+    perform the counter scatter-adds itself, or the rule covers
+    nothing."""
+
+    name = "telemetry-counter-ledgered"
+    OWNER = os.path.join("eventgrad_tpu", "obs", "ledger.py")
+    HELPER = "ledger_update"
+    #: ledger= values that are NOT ad-hoc counter math: a pass-through
+    #: reference, None (the known-added default), or a call to the
+    #: helper / the ledger constructor
+    ALLOWED_CALLS = frozenset({"ledger_update", "init", "replace"})
+
+    @staticmethod
+    def _chain(node) -> list:
+        """Attribute chain names of `a.b.c` -> ['a', 'b', 'c'] (best
+        effort; non-name bases contribute nothing)."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return parts[::-1]
+
+    def _is_ledger_mutation(self, node) -> bool:
+        """`<...>.ledger.counts.at[...]` / `<...>.ledger.queue.at[...]`
+        — an in-place scatter on the ledger's counter arrays."""
+        if not (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "at"
+        ):
+            return False
+        chain = self._chain(node.value.value)
+        return any("ledger" in p for p in chain) and (
+            "counts" in chain or "queue" in chain or "late_queue" in chain
+        )
+
+    def check(self, files):
+        out = []
+        owner_seen = False
+        owner_scatter = False
+        for sf in files:
+            if not _in_package(sf):
+                continue
+            in_obs = sf.rel.startswith(
+                os.path.join("eventgrad_tpu", "obs") + os.sep
+            )
+            if sf.rel == self.OWNER:
+                owner_seen = True
+                for node in ast.walk(sf.tree):
+                    if (
+                        isinstance(node, ast.FunctionDef)
+                        and node.name == self.HELPER
+                    ):
+                        for sub in ast.walk(node):
+                            if (
+                                isinstance(sub, ast.Attribute)
+                                and sub.attr == "add"
+                                and isinstance(sub.value, ast.Subscript)
+                            ):
+                                owner_scatter = True
+            if in_obs:
+                continue
+            for node in ast.walk(sf.tree):
+                if self._is_ledger_mutation(node):
+                    out.append(self._v(
+                        sf, node.lineno,
+                        "ad-hoc mutation of the message ledger's "
+                        "counter arrays — disposition counters move "
+                        "only through obs.ledger.ledger_update (the "
+                        "one site the conservation auditor can hold "
+                        "to account; tools/ledger_audit.py)",
+                    ))
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "ledger":
+                        continue
+                    v = kw.value
+                    if isinstance(v, (ast.Name, ast.Attribute)):
+                        continue  # pass-through
+                    if isinstance(v, ast.Constant) and v.value is None:
+                        continue  # known-added default
+                    if (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Attribute)
+                        and v.func.attr in self.ALLOWED_CALLS
+                    ):
+                        continue
+                    out.append(self._v(
+                        sf, v.lineno,
+                        "computed ledger= value outside obs/ — "
+                        "disposition accounting lives in "
+                        "obs.ledger.ledger_update; pass the branch's "
+                        "raw observables (ledger_inputs=) instead of "
+                        "doing counter math at the call site",
+                    ))
+        if owner_seen and not owner_scatter:
+            out.append(Violation(
+                self.name, self.OWNER, 1,
+                "obs/ledger.py no longer performs the disposition "
+                "counter scatter-adds inside ledger_update — the "
+                "helper is the ONE place message counters move; "
+                "without it this rule covers nothing",
+            ))
+        return out
+
+
 RULES: Sequence[Rule] = (
     ExitCodeLiterals(),
     OsExitConfined(),
@@ -831,6 +957,7 @@ RULES: Sequence[Rule] = (
     ShardMapExemptHonest(),
     TriggerPolicyRegistered(),
     CarrierDtypeDeclared(),
+    TelemetryCounterLedgered(),
 )
 
 

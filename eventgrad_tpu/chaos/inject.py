@@ -64,6 +64,39 @@ def rank_and_sources(topo: Topology) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return me, jnp.stack(srcs)
 
 
+def host_source_table(topo: Topology):
+    """Host twin of `rank_and_sources`: np.int64 [n_ranks, n_neighbors],
+    entry (r, e) = the flat rank whose payload rank r receives on edge
+    e (`Topology.neighbor_source`). The ledger auditor's cross-rank
+    map (obs/ledger.py audit_window)."""
+    import numpy as np
+
+    return np.asarray(
+        [
+            [topo.neighbor_source(r, nb) for nb in topo.neighbors]
+            for r in range(topo.n_ranks)
+        ],
+        np.int64,
+    ).reshape(topo.n_ranks, topo.n_neighbors)
+
+
+def reverse_edge_index(topo: Topology):
+    """Per edge index e, the index of the reverse edge (same axis,
+    negated offset), or None when any edge lacks its reverse — the
+    repo's Ring/Torus topologies are symmetric, so the ledger auditor's
+    cross-rank law always has a well-defined sender edge."""
+    rev = []
+    for nb in topo.neighbors:
+        match = [
+            j for j, other in enumerate(topo.neighbors)
+            if other.axis == nb.axis and other.offset == -nb.offset
+        ]
+        if not match:
+            return None
+        rev.append(match[0])
+    return rev
+
+
 def delivery_mask(
     sched: ChaosSchedule,
     topo: Topology,

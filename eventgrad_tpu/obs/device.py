@@ -19,6 +19,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import struct
 
+from eventgrad_tpu.obs import ledger as obs_ledger
 from eventgrad_tpu.obs.schema import SILENCE_BUCKETS
 
 
@@ -55,10 +56,17 @@ class TelemetryState(struct.PyTreeNode):
     edge_staleness: jnp.ndarray = None  # type: ignore[assignment]  # f32 [n_edges]
     staleness_hist: jnp.ndarray = None  # type: ignore[assignment]  # i32 [SILENCE_BUCKETS]
     late_commits: jnp.ndarray = None    # type: ignore[assignment]  # i32 []
+    # the message-lifecycle ledger (obs/ledger.py): cumulative per-edge
+    # disposition counters + the bounded-async in-flight queue, mutated
+    # only through obs.ledger.ledger_update (the `telemetry-counter-
+    # ledgered` lint rule). Defaulted like the other known-added fields
+    # so pre-ledger snapshots restore via restore_with_fill.
+    ledger: obs_ledger.MessageLedger = None  # type: ignore[assignment]
 
     @classmethod
     def init(
         cls, n_leaves: int, n_edges: int, n_buckets: int = 1,
+        queue_depth: int = 0,
     ) -> "TelemetryState":
         zl = jnp.zeros((n_leaves,), jnp.float32)
         return cls(
@@ -77,6 +85,9 @@ class TelemetryState(struct.PyTreeNode):
             edge_staleness=jnp.zeros((n_edges,), jnp.float32),
             staleness_hist=jnp.zeros((SILENCE_BUCKETS,), jnp.int32),
             late_commits=jnp.zeros((), jnp.int32),
+            ledger=obs_ledger.MessageLedger.init(
+                n_edges, queue_depth=queue_depth
+            ),
         )
 
 
@@ -105,6 +116,7 @@ def accumulate(
     bucket_bytes: Optional[jnp.ndarray] = None,  # f32 [n_buckets] this pass
     edge_staleness: Optional[jnp.ndarray] = None,  # i32/f32 [n_edges]
     late_commits: Optional[jnp.ndarray] = None,    # i32 [] this pass
+    ledger_inputs: Optional[dict] = None,  # kwargs for ledger_update
 ) -> TelemetryState:
     """One pass of counter updates; omitted (None) quantities leave their
     counters untouched (the non-event algorithms pass only edge_bytes).
@@ -145,6 +157,13 @@ def accumulate(
     if late_commits is not None:
         upd["late_commits"] = tel.late_commits + late_commits.astype(
             jnp.int32
+        )
+    if ledger_inputs is not None and tel.ledger is not None:
+        # the message-lifecycle ledger: ALL disposition math lives in
+        # obs.ledger.ledger_update — the step only hands over the
+        # branch's raw observables (obs/schema.py DISPOSITIONS)
+        upd["ledger"] = obs_ledger.ledger_update(
+            tel.ledger, **ledger_inputs
         )
     return tel.replace(**upd)
 
@@ -217,4 +236,11 @@ def window_record(cur, prev=None):
             int(v) for v in d("staleness_hist").sum(axis=0)
         ]
         rec["late_commit_count"] = int(d("late_commits").sum())
+    if cur.ledger is not None:
+        # message-lifecycle ledger (known-added like the riders above):
+        # per-disposition per-edge window deltas summed over ranks +
+        # the in-flight gauge at the window end (obs/ledger.py)
+        rec["message_ledger"] = obs_ledger.window_block(
+            cur.ledger, None if prev is None else prev.ledger
+        )
     return rec

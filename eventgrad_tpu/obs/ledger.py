@@ -1,0 +1,336 @@
+"""Message-lifecycle ledger: per-edge disposition accounting + auditor.
+
+EventGraD's value claim is an accounting claim ("~70% of messages saved
+at no accuracy cost"), but the counters that tell each message's fate
+grew up in five different subsystems: capacity deferrals in
+`EventState.num_deferred`, chaos drops, integrity wire rejections,
+bounded-async late commits, membership forced fires. Nothing proved
+them mutually consistent — a path that silently leaks messages (a drop
+nobody counts, a rejection counted twice) was invisible.
+
+This module is the one place message counters move. `MessageLedger`
+rides inside `TelemetryState` (cumulative int32 per-edge counters, one
+row per `schema.DISPOSITIONS` leaf) and **every** message-affecting
+path — the event branches of train/steps.py, the chaos delivery mask,
+the integrity verdicts, the bounded-async delivery queue — feeds one
+call to `ledger_update` per pass. The helper derives each disposition
+from the branch's raw observables (proposal bits, suppress mask, fire
+bits, raw wire census, deliver/integrity verdicts, lag), so no ad-hoc
+counter math lives in the step, and the derivation makes the balance
+laws hold by construction:
+
+    proposed = suppressed + deferred + fired          (per rank, edge)
+    fired    = delivered + dropped + rejected + in_flight
+                                           (per edge, summed over ranks)
+    sender.fired(e) = receiver.(delivered + dropped + rejected +
+                     in_flight)(e)                    (per rank, edge)
+
+`audit_window` re-checks those laws on the host with INTEGER equality,
+per edge per flush window — tools/ledger_audit.py proves the auditor
+catches seeded leaks (an uncounted drop, a double-counted rejection,
+enabled via EG_LEDGER_LEAK for the oracle runs only).
+
+Message unit: one leaf-fire per edge (matching `EventState.num_events`
+= fires x neighbors). Sender-side rows broadcast the same count to all
+edges; receiver-side rows attribute the neighbor's raw wire bits to
+exactly one of delivered / dropped / rejected / in-flight.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+from eventgrad_tpu.obs.schema import LEDGER_COUNTER_ROWS
+
+#: row index of each cumulative disposition counter in MessageLedger.counts
+ROW = {name: i for i, name in enumerate(LEDGER_COUNTER_ROWS)}
+
+#: seeded leak oracles (tools/ledger_audit.py): read at TRACE time, so a
+#: leaky step only exists in processes that ask for one. The two leaks
+#: are the two classic counter bugs the auditor must catch — a message
+#: fate nobody counts, and one counted twice.
+LEAK_ENV = "EG_LEDGER_LEAK"
+LEAKS = ("uncounted_drop", "double_reject")
+
+
+def _leak() -> str:
+    v = os.environ.get(LEAK_ENV, "")
+    if v and v not in LEAKS:
+        raise ValueError(f"{LEAK_ENV}={v!r}: known leaks are {LEAKS}")
+    return v
+
+
+class MessageLedger(struct.PyTreeNode):
+    """Cumulative per-edge disposition counters + the bounded-async
+    in-flight queue (schema.DISPOSITIONS / LEDGER_COUNTER_ROWS).
+
+    `queue[s, e]` counts accepted messages on edge e committing in s+1
+    passes (the count twin of `EventState.pending`: slot 0 drains into
+    `delivered` this pass, then the queue shifts and this pass's
+    accepted census enters at slot lag-1 — additive where the payload
+    queue merges, because committing a merged slot is bitwise
+    committing every message in it). `late_queue` carries the lag >= 2
+    sub-census the same way; its drain is `late_committed`. Both are
+    None on the synchronous paths (staleness <= 1), where acceptance
+    commits the same pass and in_flight is identically zero."""
+
+    counts: jnp.ndarray                 # i32 [n_rows, n_edges]
+    queue: jnp.ndarray = None           # type: ignore[assignment]  # i32 [D, n_edges]
+    late_queue: jnp.ndarray = None      # type: ignore[assignment]  # i32 [D, n_edges]
+
+    @classmethod
+    def init(cls, n_edges: int, queue_depth: int = 0) -> "MessageLedger":
+        q = (
+            jnp.zeros((queue_depth, n_edges), jnp.int32)
+            if queue_depth else None
+        )
+        return cls(
+            counts=jnp.zeros((len(LEDGER_COUNTER_ROWS), n_edges), jnp.int32),
+            queue=q,
+            late_queue=q,
+        )
+
+    def in_flight(self) -> jnp.ndarray:
+        """Gauge: queued-but-uncommitted messages per edge, i32 [n_edges]."""
+        if self.queue is None:
+            return jnp.zeros(self.counts.shape[-1:], jnp.int32)
+        return jnp.sum(self.queue, axis=0)
+
+
+def ledger_update(
+    led: MessageLedger,
+    *,
+    prop_fire: Optional[jnp.ndarray] = None,   # bool [L] trigger proposals
+    suppress: Optional[jnp.ndarray] = None,    # bool [L] quarantine/policy veto
+    fire_vec: Optional[jnp.ndarray] = None,    # bool [L] on-the-wire fires
+    n_msgs: Optional[jnp.ndarray] = None,      # i32 [E] raw wire census
+    deliver: Optional[jnp.ndarray] = None,     # bool [E] chaos delivery bits
+    oks: Optional[jnp.ndarray] = None,         # bool [E] integrity verdicts
+    lag_vec: Optional[jnp.ndarray] = None,     # i32 [E] bounded-async lags
+) -> MessageLedger:
+    """THE disposition helper: one pass of message accounting.
+
+    Every message-affecting path calls this once per pass with its raw
+    observables; the disposition derivation lives here and nowhere else
+    (the `telemetry-counter-ledgered` lint rule keeps it that way).
+
+    Sender side (`prop_fire`/`suppress`/`fire_vec`, broadcast per edge):
+    suppressed counts proposals the mask vetoed, deferred counts
+    proposals that survived the mask but missed the wire (the capacity
+    gate), fired counts what actually shipped — computed independently,
+    so the proposed = suppressed + deferred + fired law checks the
+    mask-subset invariants instead of restating an identity.
+
+    Receiver side (`n_msgs` = per-edge sum of the neighbor's RAW fire
+    bits on the wire): a dropped edge loses its whole census, a
+    delivered-but-rejected edge refuses it, the rest commits — same
+    pass without `lag_vec`, through the delivery queue with it (the
+    count twin of events.async_delivery_commit: drain slot 0, shift,
+    enqueue this pass's accepted census at slot lag-1)."""
+    leak = _leak()
+    counts = led.counts
+    queue, late_queue = led.queue, led.late_queue
+    n_edges = counts.shape[-1]
+
+    if prop_fire is not None:
+        prop = prop_fire.astype(bool)
+        fire = fire_vec.astype(bool)
+        sup_mask = (
+            prop & suppress.astype(bool)
+            if suppress is not None
+            else jnp.zeros_like(prop)
+        )
+        kept = prop & ~sup_mask
+        proposed = jnp.sum(prop.astype(jnp.int32))
+        suppressed = jnp.sum(sup_mask.astype(jnp.int32))
+        deferred = jnp.sum((kept & ~fire).astype(jnp.int32))
+        fired = jnp.sum(fire.astype(jnp.int32))
+        for row, inc in (
+            ("proposed", proposed), ("suppressed", suppressed),
+            ("deferred", deferred), ("fired", fired),
+        ):
+            counts = counts.at[ROW[row]].add(
+                jnp.broadcast_to(inc, (n_edges,))
+            )
+
+    if n_msgs is not None:
+        msgs = n_msgs.astype(jnp.int32)
+        ok_e = (
+            oks.astype(bool) if oks is not None
+            else jnp.ones((n_edges,), bool)
+        )
+        del_e = (
+            deliver.astype(bool) if deliver is not None
+            else jnp.ones((n_edges,), bool)
+        )
+        dropped = jnp.where(~del_e, msgs, 0)
+        rejected = jnp.where(del_e & ~ok_e, msgs, 0)
+        accepted = jnp.where(del_e & ok_e, msgs, 0)
+        if leak == "uncounted_drop":
+            # seeded leak oracle: the drop path forgets its census
+            dropped = jnp.zeros_like(dropped)
+        if leak == "double_reject":
+            # seeded leak oracle: rejections booked twice
+            rejected = 2 * rejected
+        counts = counts.at[ROW["dropped"]].add(dropped)
+        counts = counts.at[ROW["rejected"]].add(rejected)
+        if lag_vec is None:
+            counts = counts.at[ROW["delivered"]].add(accepted)
+        else:
+            # bounded async: accepted messages commit when their lag
+            # elapses — mirror events.async_delivery_commit exactly
+            # (drain slot 0, shift, enqueue at slot lag-1), so the
+            # in-flight gauge balances fired against delivered at any
+            # block boundary
+            lag = jnp.clip(
+                lag_vec.astype(jnp.int32), 1, queue.shape[0]
+            )
+            slot = (
+                jnp.arange(queue.shape[0], dtype=jnp.int32)[:, None]
+                == (lag - 1)[None, :]
+            )
+            late_acc = jnp.where(lag >= 2, accepted, 0)
+            counts = counts.at[ROW["delivered"]].add(queue[0])
+            counts = counts.at[ROW["late_committed"]].add(late_queue[0])
+            shift = jnp.zeros_like(queue).at[:-1].set(queue[1:])
+            queue = shift + jnp.where(slot, accepted[None, :], 0)
+            lshift = jnp.zeros_like(late_queue).at[:-1].set(late_queue[1:])
+            late_queue = lshift + jnp.where(slot, late_acc[None, :], 0)
+
+    return led.replace(counts=counts, queue=queue, late_queue=late_queue)
+
+
+# ---------------------------------------------------------------------------
+# host side: the flush-window record block and the conservation auditor
+
+
+def window_block(cur: MessageLedger, prev=None):
+    """Host-side flush twin of obs.device.window_record for the ledger:
+    per-disposition per-edge window deltas summed over ranks (stacked
+    snapshots, leading axis = ranks), plus the in-flight gauge at the
+    window end — the `message_ledger` block of the record's obs dict."""
+    import numpy as np
+
+    c = np.asarray(cur.counts, np.int64)
+    if prev is not None:
+        c = c - np.asarray(prev.counts, np.int64)
+    blk = {
+        name: [int(v) for v in c[:, ROW[name]].sum(axis=0)]
+        for name in LEDGER_COUNTER_ROWS
+    }
+    q = (
+        np.asarray(cur.queue, np.int64).sum(axis=1)
+        if cur.queue is not None
+        else np.zeros(c.shape[:1] + c.shape[2:], np.int64)
+    )
+    blk["in_flight"] = [int(v) for v in q.sum(axis=0)]
+    return blk
+
+
+def audit_window(cur: MessageLedger, prev, topo, max_violations: int = 8):
+    """The conservation-law auditor: integer equality per edge per flush
+    window, on the stacked host snapshots (leading axis = ranks).
+
+    Checks, in order:
+      1. monotonicity — every cumulative counter's window delta >= 0;
+      2. sender law, per rank per edge:
+         proposed = suppressed + deferred + fired;
+      3. receiver law, per edge summed over ranks:
+         fired = delivered + dropped + rejected + delta(in_flight);
+      4. cross-rank law, per rank per edge: the fired count of the
+         edge's source rank (on the reverse edge, chaos.inject.
+         reverse_edge_index) equals this rank's received census
+         delivered + dropped + rejected + delta(in_flight);
+      5. late sub-law, per rank per edge:
+         late_committed <= delivered.
+
+    Returns {"ok": bool, "checks": int, "violations": [...]} with at
+    most `max_violations` named violations (law, rank, edge, lhs, rhs).
+    """
+    import numpy as np
+
+    from eventgrad_tpu.chaos import inject as chaos_inject
+
+    cumc = np.asarray(cur.counts, np.int64)        # [R, rows, E]
+    d = cumc - (
+        np.asarray(prev.counts, np.int64) if prev is not None else 0
+    )
+    n_ranks, _, n_edges = d.shape
+
+    def q_sum(led):
+        if led is None or led.queue is None:
+            return np.zeros((n_ranks, n_edges), np.int64)
+        return np.asarray(led.queue, np.int64).sum(axis=1)
+
+    d_inflight = q_sum(cur) - q_sum(prev)
+
+    def row(name, arr=None):
+        return (arr if arr is not None else d)[:, ROW[name], :]
+
+    violations = []
+    checks = 0
+
+    def check(ok_mask, law, lhs, rhs):
+        nonlocal checks
+        checks += int(ok_mask.size)
+        if bool(ok_mask.all()):
+            return
+        for r, e in zip(*np.nonzero(~ok_mask)):
+            if len(violations) >= max_violations:
+                return
+            violations.append({
+                "law": law, "rank": int(r), "edge": int(e),
+                "lhs": int(lhs[r, e]), "rhs": int(rhs[r, e]),
+            })
+
+    # 1. monotone counters
+    for name in LEDGER_COUNTER_ROWS:
+        check(
+            row(name) >= 0, f"monotone:{name}",
+            row(name), np.zeros_like(row(name)),
+        )
+
+    # 2. sender law
+    lhs = row("proposed")
+    rhs = row("suppressed") + row("deferred") + row("fired")
+    check(lhs == rhs, "proposed=suppressed+deferred+fired", lhs, rhs)
+
+    recv = (
+        row("delivered") + row("dropped") + row("rejected") + d_inflight
+    )
+
+    # 3. receiver law, rank-summed per edge (every rank's send on edge
+    # index e is received by exactly one rank on e's reverse, so the
+    # rank sums balance even though each rank's own fired and received
+    # census count different messages)
+    lhs_e = row("fired").sum(axis=0, keepdims=True)
+    rhs_e = recv.sum(axis=0, keepdims=True)
+    check(
+        lhs_e == rhs_e, "fired=delivered+dropped+rejected+in_flight",
+        lhs_e, rhs_e,
+    )
+
+    # 4. cross-rank law: the per-rank refinement of (3)
+    sources = chaos_inject.host_source_table(topo)      # [R, E]
+    rev = chaos_inject.reverse_edge_index(topo)         # [E] or None
+    if rev is not None and sources.shape == (n_ranks, n_edges):
+        fired = row("fired")
+        sender = fired[sources, np.asarray(rev)[None, :]]
+        check(
+            sender == recv, "sender.fired=receiver.census", sender, recv,
+        )
+
+    # 5. late commits are a sub-count of delivered
+    lhs = row("late_committed")
+    check(lhs <= row("delivered"), "late_committed<=delivered", lhs,
+          row("delivered"))
+
+    return {
+        "ok": not violations,
+        "checks": checks,
+        "violations": violations,
+    }

@@ -227,6 +227,46 @@ def build_report(history: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
     else:
         report["edge_staleness"] = None
+
+    # message-lifecycle ledger (obs/ledger.py): fold the per-window
+    # `message_ledger` blocks into a run-total per-edge disposition
+    # table, keep the per-window timeline (rank+edge sums), and
+    # aggregate the conservation auditor's verdicts
+    led_rows = [
+        (e, w["message_ledger"], w.get("ledger_audit"))
+        for e, w in windows if "message_ledger" in w
+    ]
+    if led_rows:
+        totals: Dict[str, List[int]] = {}
+        for _, blk, _ in led_rows:
+            for k, v in blk.items():
+                if k == "in_flight":
+                    continue  # gauge, not a windowable count
+                totals[k] = (
+                    [a + b for a, b in zip(totals[k], v)]
+                    if k in totals else list(v)
+                )
+        audits = [a for _, _, a in led_rows if a]
+        report["message_lifecycle"] = {
+            "epochs": [e for e, _, _ in led_rows],
+            "edges": meta.get("edges"),
+            "totals": totals,
+            "in_flight_final": led_rows[-1][1].get("in_flight"),
+            "timeline": [
+                {"epoch": e, **{k: sum(v) for k, v in blk.items()}}
+                for e, blk, _ in led_rows
+            ],
+            "audit": {
+                "windows": len(audits),
+                "checks": sum(int(a.get("checks", 0)) for a in audits),
+                "ok": all(a.get("ok", False) for a in audits),
+                "violations": [
+                    v for a in audits for v in a.get("violations", [])
+                ][:8],
+            } if audits else None,
+        }
+    else:
+        report["message_lifecycle"] = None
     return report
 
 
@@ -272,4 +312,50 @@ def render_text(report: Dict[str, Any]) -> str:
             f"edge {names[worst]} at {last[worst]:.2f} passes (last "
             f"window), {sum(st['late_commits'])} late commits total"
         )
+    ml = report.get("message_lifecycle")
+    if ml and ml.get("totals"):
+        totals = ml["totals"]
+        rows = list(totals)
+        n_edges = len(next(iter(totals.values())))
+        names = ml.get("edges") or [str(i) for i in range(n_edges)]
+        aud = ml.get("audit")
+        aud_s = (
+            f"audit {aud['checks']} checks "
+            + ("OK" if aud["ok"] else
+               f"FAILED ({len(aud['violations'])}+ violations)")
+            if aud else "no audit"
+        )
+        lines.append(
+            f"message lifecycle ({len(ml['epochs'])} windows, {aud_s}):"
+        )
+        width = max(len(n) for n in names) if names else 4
+        lines.append(
+            "  " + "edge".ljust(width) + "  "
+            + "  ".join(f"{r:>10}" for r in rows)
+        )
+        for e in range(n_edges):
+            lines.append(
+                "  " + str(names[e]).ljust(width) + "  "
+                + "  ".join(f"{totals[r][e]:>10d}" for r in rows)
+            )
+        infl = ml.get("in_flight_final")
+        if infl and any(infl):
+            lines.append(f"  in-flight at run end: {infl}")
+        tl = ml.get("timeline") or []
+        if len(tl) > 1:
+            lines.append(
+                "  timeline (fired/delivered/dropped/rejected per window): "
+                + " ".join(
+                    f"e{t['epoch']}:{t.get('fired', 0)}/"
+                    f"{t.get('delivered', 0)}/{t.get('dropped', 0)}/"
+                    f"{t.get('rejected', 0)}"
+                    for t in tl
+                )
+            )
+        if aud and not aud["ok"]:
+            for v in aud["violations"][:4]:
+                lines.append(
+                    f"  VIOLATION {v['law']} rank={v['rank']} "
+                    f"edge={v['edge']}: {v['lhs']} != {v['rhs']}"
+                )
     return "\n".join(lines)

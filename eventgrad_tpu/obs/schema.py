@@ -27,6 +27,77 @@ SILENCE_BUCKETS = 16
 #: Prometheus metric-name prefix for every exported gauge
 PROM_PREFIX = "eventgrad"
 
+#: The message-lifecycle disposition taxonomy (obs/ledger.py): every
+#: per-edge message a pass can affect lands in EXACTLY ONE leaf
+#: disposition, so integer balance laws hold per edge per flush window
+#: (docs/OBSERVABILITY.md "Message-lifecycle ledger"):
+#:
+#:   proposed  = suppressed + deferred + fired            (sender side)
+#:   fired     = delivered + dropped + rejected + in_flight
+#:                                         (receiver side, rank-summed)
+#:   sender.fired(e) = receiver.(delivered+dropped+rejected+
+#:                     in_flight)(e)       (cross-rank, per edge)
+#:
+#: name -> (parent disposition or None, description). The dict order IS
+#: the counter-row order of MessageLedger.counts.
+DISPOSITIONS = {
+    "proposed": (
+        None,
+        "trigger raised the leaf this pass (threshold crossing, "
+        "max-silence forced fire, or membership force-fire) — the root "
+        "of the sender-side tree",
+    ),
+    "suppressed": (
+        "proposed",
+        "proposal cancelled before the wire: quarantine (non-finite "
+        "grads/params) or a trigger-policy veto — nothing shipped",
+    ),
+    "deferred": (
+        "proposed",
+        "proposal admitted by the trigger but pushed past this pass by "
+        "the compact-wire capacity gate — ships on a later pass",
+    ),
+    "fired": (
+        "proposed",
+        "proposal actually put on the wire this pass (post-suppression, "
+        "post-capacity-gate) — the sender-side leaf that the "
+        "receiver-side tree partitions",
+    ),
+    "delivered": (
+        "fired",
+        "message committed into the receiver's gossip buffer (same pass "
+        "on the synchronous paths; on arrival under bounded async)",
+    ),
+    "dropped": (
+        "fired",
+        "message lost on the wire (chaos delivery mask) — the receiver "
+        "kept the stale buffer",
+    ),
+    "rejected": (
+        "fired",
+        "message refused at the wire by the integrity engine (checksum "
+        "mismatch or non-finite payload) — stale buffer kept, "
+        "bitwise an event that did not fire",
+    ),
+    "in_flight": (
+        "fired",
+        "message accepted into the bounded-async delivery queue but not "
+        "yet committed (a gauge, not a cumulative counter: the queued "
+        "census drains into delivered)",
+    ),
+    "late_committed": (
+        "in_flight",
+        "delivered message that committed >= 2 passes after its send — "
+        "the genuinely-late arrivals the staleness bound admitted "
+        "(a sub-count of delivered, never exceeding it)",
+    ),
+}
+
+#: the cumulative-counter rows of MessageLedger.counts, in row order —
+#: every DISPOSITIONS leaf except the in_flight gauge (derived from the
+#: ledger's delivery queue instead)
+LEDGER_COUNTER_ROWS = tuple(d for d in DISPOSITIONS if d != "in_flight")
+
 #: On-device accumulator fields (obs.device.TelemetryState). All counters
 #: are CUMULATIVE on device — the host diffs consecutive flushes, so a
 #: flush costs one device->host read and zero device writes.
@@ -392,6 +463,139 @@ PERF_FIELDS = {
 }
 
 
+#: Message-lifecycle ledger surfaces (obs/ledger.py): the per-edge
+#: disposition counters inside TelemetryState, the `message_ledger`
+#: block window_record attaches to the record's `obs` dict, and the
+#: host-side conservation auditor's verdict. name -> (units, modes,
+#: description)
+LEDGER_FIELDS = {
+    "ledger": (
+        "counts[disposition][edge]", "gossip algos",
+        "the on-device MessageLedger block of TelemetryState: cumulative "
+        "int32 per-edge counters, one row per DISPOSITIONS leaf (plus "
+        "the bounded-async in-flight delivery queue the in_flight gauge "
+        "derives from); every message-affecting path increments exactly "
+        "one disposition through obs.ledger.ledger_update",
+    ),
+    "message_ledger": (
+        "counts[disposition][edge]", "gossip algos",
+        "record-surface twin of the device ledger: per-disposition "
+        "per-edge window deltas summed over ranks, plus the in_flight "
+        "gauge at the window end",
+    ),
+    "ledger_audit": (
+        "verdict dict", "gossip algos",
+        "the host-side conservation auditor's verdict for the flush "
+        "window (obs.ledger.audit_window): ok, checks performed, and "
+        "the first few violations with edge/rank/law attribution",
+    ),
+    "in_flight": (
+        "messages[edge]", "bounded-async runs",
+        "gauge: messages accepted into the bounded-async delivery queue "
+        "but not yet committed (row-sum of the ledger's queue) — the "
+        "balancing term that makes fired = delivered + dropped + "
+        "rejected + in_flight exact mid-flight",
+    ),
+}
+
+
+#: The Prometheus export contract (satellite of ISSUE 18): every field
+#: of every *_FIELDS group above is either exported as a gauge (its
+#: entry here names the gauge, sans PROM_PREFIX) or listed in
+#: PROM_EXCLUDED with a reason — a new field can no longer silently
+#: skip the exporter (tests/test_ledger.py keeps the partition total).
+PROM_EXPORTED = {
+    # TELEMETRY_FIELDS
+    "edge_staleness": "edge_staleness",          # {edge=...} labels
+    "late_commits": "late_commits_total",
+    "wire_reject": "wire_rejects_total",         # cumulative twin
+    "quarantined": "quarantined_steps_total",    # cumulative twin
+    # MEMBERSHIP_FIELDS
+    "active_ranks": "active_ranks",
+    "membership_transitions": "membership_transitions_total",
+    # INTEGRITY_FIELDS
+    "wire_rejects": "wire_rejects_total",
+    "quarantined_steps": "quarantined_steps_total",
+    "integrity_rollbacks": "integrity_rollbacks_total",
+    # PREEMPTION_FIELDS
+    "preemptions_total": "preemptions_total",
+    # LEDGER_FIELDS: one gauge per cumulative disposition row (summed
+    # over ranks and edges) + the in-flight gauge + the audit verdict
+    "ledger": "ledger_disposition_total",        # {disposition=...}
+    "message_ledger": "ledger_disposition_total",
+    "in_flight": "ledger_in_flight",
+    "ledger_audit": "ledger_audit_failures_total",
+}
+
+#: field -> why it is NOT a Prometheus gauge. Vectors/histograms stay on
+#: the JSONL/report surface (Prometheus gauges are scalars per label
+#: set and these would explode cardinality); config/info dicts are
+#: replayability riders, not time series; perf/report fields live in
+#: artifacts, not the live exporter.
+PROM_EXCLUDED = {
+    # TELEMETRY_FIELDS — per-leaf/bucket vectors and report-only scalars
+    "steps": "window bookkeeping; wall-clock rates come from the span "
+             "registry, not a pass counter",
+    "fire_count": "per-leaf vector (one gauge per leaf would explode "
+                  "cardinality); report surface renders the heatmap",
+    "defer_count": "per-leaf vector; the ledger's deferred row carries "
+                   "the per-edge scalar twin",
+    "thres_sum": "per-leaf vector; report-surface heatmap",
+    "drift_sum": "per-leaf vector; report-surface heatmap",
+    "silence_hist": "histogram; chaos.monitor exports edge_silence_max "
+                    "as the live scalar",
+    "fired_elems_sum": "capacity-utilization numerator; report surface",
+    "fired_elems_peak": "running max, not a rate; report surface",
+    "edge_bytes": "per-edge byte vector; sent_bytes rides the history "
+                  "records and bench artifacts",
+    "bucket_bytes": "per-bucket vector; report surface",
+    "staleness_hist": "histogram; edge_staleness is the live gauge",
+    # RECORD_FIELDS — window-delta twins of the device counters above;
+    # the JSONL history is their surface
+    "schema": "version stamp, not a metric",
+    "thres_mean": "per-leaf vector (see thres_sum)",
+    "drift_mean": "per-leaf vector (see drift_sum)",
+    "fired_elems_mean": "report surface (see fired_elems_sum)",
+    "edge_bytes_per_step": "per-edge vector (see edge_bytes)",
+    "wire_reject_count": "window delta; wire_rejects_total is the "
+                         "cumulative gauge",
+    "bucket_bytes_per_step": "per-bucket vector (see bucket_bytes)",
+    "edge_staleness_per_step": "window delta; edge_staleness is the "
+                               "live gauge",
+    "late_commit_count": "window delta; late_commits_total is the "
+                         "cumulative gauge",
+    # RECORD_META_FIELDS — run metadata, not time series
+    "leaves": "metadata rider", "edges": "metadata rider",
+    "silence_buckets": "metadata rider", "n_ranks": "metadata rider",
+    "n_neighbors": "metadata rider", "wire": "metadata rider",
+    # MEMBERSHIP / INTEGRITY / PREEMPTION info dicts
+    "membership": "config dict replayability rider",
+    "integrity": "config dict replayability rider",
+    "integrity_rollback": "info dict; integrity_rollbacks_total is the "
+                          "gauge",
+    "preempted": "terminal record; preemptions_total is the gauge",
+    "drain_s": "inside the terminal preempted record",
+    "crashpoint": "replayability rider",
+    # PERF_FIELDS — artifact surface (perf ledger), not the live
+    # exporter: one reason for the whole group
+    **{f: "perf-ledger artifact surface (tools/perf_ledger.py), not a "
+          "live exporter metric" for f in (
+        "flops_per_step", "hbm_bytes_per_step", "flops_by_phase",
+        "hbm_bytes_by_phase", "mfu", "achieved_flops_per_s",
+        "achieved_bytes_per_s", "arithmetic_intensity",
+        "ridge_intensity", "roofline_bound", "roofline_frac",
+        "device_spec", "peak_hbm_bytes", "compile_spans",
+        "resident_dtype",
+    )},
+    # REPORT_FIELDS — derived report series
+    **{f: "derived report series (tools/obs_report.py), not a live "
+          "exporter metric" for f in (
+        "msgs_saved_pct_per_leaf", "fire_rate_heatmap", "thres_heatmap",
+        "capacity_utilization", "consensus_error", "message_lifecycle",
+    )},
+}
+
+
 #: derived series emitted by obs.report.build_report (tools/obs_report.py)
 REPORT_FIELDS = {
     "msgs_saved_pct_per_leaf": (
@@ -418,6 +622,12 @@ REPORT_FIELDS = {
         "||p_i - mean(p)||_2 trajectory at block ends (max/mean over "
         "ranks)",
     ),
+    "message_lifecycle": (
+        "counts[disposition][edge]", "gossip algos",
+        "run-total per-edge disposition table + per-window timeline + "
+        "aggregated conservation-audit verdict, folded from the "
+        "message_ledger / ledger_audit blocks of the obs records",
+    ),
 }
 
 
@@ -427,4 +637,19 @@ def all_field_names():
     names |= set(RECORD_META_FIELDS) | set(REPORT_FIELDS)
     names |= set(MEMBERSHIP_FIELDS) | set(INTEGRITY_FIELDS)
     names |= set(PREEMPTION_FIELDS) | set(PERF_FIELDS)
+    names |= set(LEDGER_FIELDS) | set(DISPOSITIONS)
     return sorted(names)
+
+
+def field_groups():
+    """name -> fields for every *_FIELDS group in this module, for the
+    Prometheus export-coverage test (each field must be PROM_EXPORTED
+    or PROM_EXCLUDED)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    return {
+        name: getattr(mod, name)
+        for name in dir(mod)
+        if name.endswith("_FIELDS") and isinstance(getattr(mod, name), dict)
+    }

@@ -702,6 +702,27 @@ def compact_neighbor_vals(
     return tuple(new_bufs), tuple(recv_fires)
 
 
+def raw_msg_counts(raws) -> jnp.ndarray:
+    """Per-edge census of the neighbor's RAW fire bits on the wire —
+    int32 [n_neighbors] message counts for the lifecycle ledger
+    (obs/ledger.py). `raws` is the per-neighbor third return of the
+    masked/compact exchanges: a [L] bool vector on the flat paths, a
+    pytree of per-leaf fire bools on the tree paths, or a tuple of
+    per-bucket [L_b] vectors concatenated by the bucketed step — every
+    form counts the same leaf-fire messages, whatever else (drops,
+    rejections, lag) later happens to them."""
+    counts = [
+        sum(
+            jnp.sum(l.astype(jnp.int32))
+            for l in jax.tree.leaves(r)
+        )
+        for r in raws
+    ]
+    if not counts:
+        return jnp.zeros((0,), jnp.int32)
+    return jnp.stack(counts).astype(jnp.int32)
+
+
 def wire_real_bytes_per_neighbor(
     n_params: int,
     n_leaves: int,

@@ -452,6 +452,12 @@ def commit(
     their thres/norm/iter/slopes stay untouched (the rollback), and
     `num_events` counts effective sends only, so msgs-saved-% keeps
     matching what the wire really carried.
+
+    `num_deferred` conflates capacity deferrals with quarantine/policy
+    suppressions (both look like "proposed but not on the wire" here);
+    the message-lifecycle ledger (obs/ledger.py, schema.DISPOSITIONS)
+    splits them into `deferred` vs `suppressed` — use the ledger when
+    the distinction matters.
     """
     slope_avg = jnp.mean(prop.new_slopes, axis=1)
     if cfg.adaptive:
@@ -512,7 +518,14 @@ def async_delivery_commit(
     integrity-rejected exchange is not a delivery, so its silence keeps
     the gauge growing. Returns (new_state, visible bufs — post-arrival,
     what this pass mixes with, edge staleness int32 [n_nb], late
-    commits this pass int32 [])."""
+    commits this pass int32 []).
+
+    The message-lifecycle ledger (obs.ledger.MessageLedger.queue) keeps
+    an int32 COUNT twin of this queue with the same drain/shift/enqueue
+    discipline, so the auditor's in-flight balancing term matches this
+    engine slot for slot; its `late_committed` row counts leaf-messages
+    where the `late_commits` return counts edge-exchanges — same events,
+    different units."""
     D = int(bound)
     pass_i = jnp.asarray(pass_num, jnp.int32)
     seg = spec.seg_expand()

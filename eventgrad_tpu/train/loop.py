@@ -35,6 +35,7 @@ from eventgrad_tpu.chaos import schedule as chaos_schedule
 from eventgrad_tpu.chaos.policy import RecoveryPolicy
 from eventgrad_tpu.obs import OBS_MODES
 from eventgrad_tpu.obs import device as obs_device
+from eventgrad_tpu.obs import ledger as obs_ledger
 from eventgrad_tpu.data.prefetch import EpochPrefetcher
 from eventgrad_tpu.data.sharding import epoch_index_plan, epoch_steps
 from eventgrad_tpu.ops import arena_tuning
@@ -1016,6 +1017,9 @@ def train(
                     n_buckets=min(
                         bucketed_k, trees.tree_num_leaves(state.params)
                     ),
+                    # bounded-async: size the ledger's in-flight count
+                    # queue like the payload queues (obs/ledger.py)
+                    queue_depth=staleness if staleness >= 2 else 0,
                 ),
                 topo,
             )
@@ -1439,6 +1443,9 @@ def train(
     # diff base) and the one-time run metadata rider
     obs_prev = None
     obs_meta_pending = obs_on
+    # cumulative count of flush windows whose conservation audit failed
+    # (the ledger_audit_failures_total Prometheus gauge)
+    ledger_audit_fails = 0
     eval_on = (
         x_test is not None and log_every_epoch and not multi and not hybrid
     )
@@ -1505,6 +1512,7 @@ def train(
         donated the state, so the results are bitwise mode-independent.
         """
         nonlocal obs_prev, obs_meta_pending, last_ready_t
+        nonlocal ledger_audit_fails
         nonlocal compact_capacity, compact_done, compact_note
         nonlocal compact_fired_peak, compact_post_steps
         nonlocal run_epoch, run_epoch_idx
@@ -1536,6 +1544,18 @@ def train(
                     np.asarray, multihost.to_host(hw["tel"])
                 )
                 obs_rec = obs_device.window_record(tel_host, obs_prev)
+                if tel_host.ledger is not None:
+                    # conservation-law audit of the flush window, BEFORE
+                    # obs_prev is overwritten (the window's other end).
+                    # Integer-exact per edge; violations name the law
+                    # and the (rank, edge) that broke it (obs/ledger.py)
+                    obs_rec["ledger_audit"] = obs_ledger.audit_window(
+                        tel_host.ledger,
+                        None if obs_prev is None else obs_prev.ledger,
+                        topo,
+                    )
+                    if not obs_rec["ledger_audit"]["ok"]:
+                        ledger_audit_fails += 1
                 obs_prev = tel_host
             if obs_meta_pending:
                 obs_rec["meta"] = {
@@ -1759,6 +1779,26 @@ def train(
                 registry.gauge(
                     "late_commits_total",
                     float(np.asarray(m["late_commits"])[-1].sum()),
+                )
+            if obs_rec is not None and "message_ledger" in obs_rec:
+                # message-lifecycle ledger faces (obs/schema.py
+                # PROM_EXPORTED): cumulative per-disposition totals, the
+                # in-flight gauge at the block boundary, and how many
+                # flush-window conservation audits have failed
+                _cum = np.asarray(obs_prev.ledger.counts, np.int64)
+                for _name, _ri in obs_ledger.ROW.items():
+                    registry.gauge(
+                        "ledger_disposition_total",
+                        float(_cum[:, _ri, :].sum()),
+                        labels={"disposition": _name},
+                    )
+                registry.gauge(
+                    "ledger_in_flight",
+                    float(sum(obs_rec["message_ledger"]["in_flight"])),
+                )
+                registry.gauge(
+                    "ledger_audit_failures_total",
+                    float(ledger_audit_fails),
                 )
             if memb_engine is not None:
                 registry.gauge(

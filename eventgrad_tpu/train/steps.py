@@ -690,6 +690,16 @@ def make_train_step(
         # staleness gauge [n_nb] and this pass's late-commit count
         edge_stale = None
         late_now = None
+        # message-lifecycle ledger observables (obs/ledger.py; obs=True
+        # only): the suppress mask the branch actually applied to the
+        # proposal, the per-edge census of the neighbor's raw wire fire
+        # bits, and the bounded-async lag vector (the per-edge integrity
+        # verdicts `oks` and chaos `deliver` are already in scope) —
+        # ledger_update derives every disposition from these, so no
+        # counter math lives in this file
+        obs_suppress = None
+        obs_n_msgs = None
+        obs_lag_vec = None
 
         # flat-arena lift (static, trace-time decision): one contiguous
         # [n_params] buffer per rank carries the gossip hot path; the
@@ -845,6 +855,14 @@ def make_train_step(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
                 obs_prop, obs_fire_vec = prop, fire_vec
+                if quar is not None or pol_suppress is not None:
+                    obs_suppress = jnp.zeros_like(prop.fire_vec)
+                    if quar is not None:
+                        obs_suppress = obs_suppress | jnp.broadcast_to(
+                            quar, prop.fire_vec.shape
+                        )
+                    if pol_suppress is not None:
+                        obs_suppress = obs_suppress | pol_suppress
                 arena_fire_vec = fire_vec
                 scale_vec = (
                     collectives._masked_scales(
@@ -1103,6 +1121,17 @@ def make_train_step(
                     w_g = 1.0 / (1.0 + n_alive)
                     for bi in range(B):
                         _mix(bi, w_g, gate)
+            if obs:
+                # ledger census: the neighbor's raw wire bits, every
+                # bucket of an edge concatenated (a leaf lives in
+                # exactly one bucket, so the concat counts leaf-fire
+                # messages exactly once)
+                obs_n_msgs = collectives.raw_msg_counts([
+                    jnp.concatenate([
+                        shipped[bi][2][i] for bi in range(B)
+                    ])
+                    for i in range(n_nb)
+                ])
             event_state = event_state.replace(bufs=tuple(
                 tuple(new_bufs_b[bi][i] for bi in range(B))
                 for i in range(n_nb)
@@ -1175,6 +1204,7 @@ def make_train_step(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
             obs_prop, obs_fire_vec = prop, fire_vec
+            obs_suppress = suppress
             arena_fire_vec = fire_vec
             if gossip_wire == "compact":
                 with _phase("exchange"):
@@ -1222,6 +1252,8 @@ def make_train_step(
                 cands, effs, raws, oks = res
             else:
                 cands, effs, raws = res
+            if obs:
+                obs_n_msgs = collectives.raw_msg_counts(raws)
             if deliver is not None:
                 # raws are the RAW sender bits (what was on the wire); a
                 # rejected payload is NOT a delivery — its silence keeps
@@ -1258,6 +1290,7 @@ def make_train_step(
                     lag_vec_e = chaos_inject.lag_vector(
                         chaos, topo, pass_num, bound=staleness
                     )
+                    obs_lag_vec = lag_vec_e
                     delivered_bits = deliver
                     if oks is not None:
                         delivered_bits = (
@@ -1349,6 +1382,14 @@ def make_train_step(
                     event_state, prop, fire_vec, event_cfg, n_nb
                 )
             obs_prop, obs_fire_vec = prop, fire_vec
+            if quar is not None or pol_suppress is not None:
+                obs_suppress = jnp.zeros_like(prop.fire_vec)
+                if quar is not None:
+                    obs_suppress = obs_suppress | jnp.broadcast_to(
+                        quar, prop.fire_vec.shape
+                    )
+                if pol_suppress is not None:
+                    obs_suppress = obs_suppress | pol_suppress
             fire = jax.tree.unflatten(
                 p_def, [fire_vec[i] for i in range(len(p_leaves))]
             )
@@ -1384,6 +1425,8 @@ def make_train_step(
                 new_bufs, recv_fires, oks = res
             else:
                 new_bufs, recv_fires = res
+            if obs:
+                obs_n_msgs = collectives.raw_msg_counts(recv_fires)
             if deliver is not None:
                 # recv_fires are the RAW sender bits: sent & delivered
                 # resets silence, sent & ~delivered is an observed
@@ -1433,6 +1476,17 @@ def make_train_step(
                 p_def, [prop.fire_vec[i] for i in range(len(p_leaves))]
             )
             obs_prop, obs_fire_vec = prop, prop.fire_vec
+            if obs:
+                # sp ships no raw fire bits on the wire (the top-k lanes
+                # are masked on receipt), so the ledger's receiver census
+                # is the neighbor's fired-leaf count itself: one scalar
+                # ppermute per edge. sp supports neither chaos nor
+                # integrity, so every censused message is a delivery.
+                _sp_cnt = jnp.sum(prop.fire_vec.astype(jnp.int32))
+                obs_n_msgs = jnp.stack([
+                    collectives.recv_from(_sp_cnt, topo, nb)
+                    for nb in topo.neighbors
+                ]).astype(jnp.int32)
             stale_replicas = sparse_state.replicas
             with _phase("exchange"):
                 sparse_state = sparse_exchange(
@@ -1704,6 +1758,21 @@ def make_train_step(
                     else jnp.reshape(wire_real, (1,))
                 )
             if obs_prop is not None:
+                # message-lifecycle ledger inputs: every disposition is
+                # derived inside obs.ledger.ledger_update from the
+                # branch's raw observables — no counter arithmetic here
+                # (analysis/lint.py telemetry-counter-ledgered)
+                ledger_inputs = None
+                if obs_n_msgs is not None:
+                    ledger_inputs = dict(
+                        prop_fire=obs_prop.fire_vec,
+                        suppress=obs_suppress,
+                        fire_vec=obs_fire_vec,
+                        n_msgs=obs_n_msgs,
+                        deliver=deliver,
+                        oks=oks,
+                        lag_vec=obs_lag_vec,
+                    )
                 telemetry = obs_device.accumulate(
                     telemetry,
                     fire_vec=obs_fire_vec,
@@ -1718,11 +1787,28 @@ def make_train_step(
                     quarantined=quar_eff,
                     edge_staleness=edge_stale,
                     late_commits=late_now,
+                    ledger_inputs=ledger_inputs,
                 )
             else:
+                # dense gossip (dpsgd) still moves messages: every leaf
+                # proposes and fires every pass, and chaos drops are the
+                # only non-delivery (no integrity, no deferral). The
+                # ledger sees the same taxonomy with degenerate inputs.
+                ledger_inputs = None
+                if algo == "dpsgd" and n_nb:
+                    ones_l = jnp.ones((n_leaves_static,), bool)
+                    ledger_inputs = dict(
+                        prop_fire=ones_l,
+                        fire_vec=ones_l,
+                        n_msgs=jnp.full(
+                            (n_nb,), n_leaves_static, jnp.int32
+                        ),
+                        deliver=deliver,
+                    )
                 telemetry = obs_device.accumulate(
                     telemetry, edge_bytes=per_edge,
                     bucket_bytes=per_bucket_tel,
+                    ledger_inputs=ledger_inputs,
                 )
 
         new_state = state.replace(

@@ -75,7 +75,41 @@ def test_repo_artifacts_all_valid():
     # scanned paired step ratio <= 1.02, bitwise state
     # (RESIDENT_ABLATION_SCHEMA)
     assert "resident_ablation_cpu.json" in names
+    # the message-lifecycle conservation proof (ISSUE 18): every flush
+    # window audits ok, zero violations, all dispositions exercised,
+    # both leak oracles caught, obs='off' bitwise-unchanged
+    # (LEDGER_CONSERVATION_SCHEMA)
+    assert "ledger_conservation_cpu.json" in names
     assert out["errors"] == []
+
+
+def test_ledger_conservation_gates_encoded_in_schema():
+    """The conservation gates live IN the schema: a window that fails
+    its audit, a nonzero violation count, a missed leak oracle, or a
+    perturbed obs='off' run is a schema violation, not a judgment
+    call."""
+    with open(os.path.join(
+        _ROOT, "artifacts", "ledger_conservation_cpu.json"
+    )) as f:
+        rec = json.load(f)
+    assert va.validate(rec, va.LEDGER_CONSERVATION_SCHEMA) == []
+    for k, bad in [
+        ("all_dispositions_exercised", False),
+        ("all_leaks_caught", False),
+        ("obs_off_deterministic", False),
+        ("obs_off_matches_obs_run", False),
+        ("conservation", dict(rec["conservation"], violations=3)),
+        ("conservation", dict(rec["conservation"], all_windows_ok=False)),
+        ("leak_oracles", [dict(rec["leak_oracles"][0], caught=False)]
+         + rec["leak_oracles"][1:]),
+        ("windows", [dict(rec["windows"][0], audit_ok=False)]
+         + rec["windows"][1:]),
+        ("leak_oracles", rec["leak_oracles"][:1]),  # minItems 2
+    ]:
+        broken = dict(rec, **{k: bad})
+        assert va.validate(broken, va.LEDGER_CONSERVATION_SCHEMA), (
+            f"schema must reject {k}={bad!r}"
+        )
 
 
 def test_resident_gates_encoded_in_schema():

@@ -364,3 +364,65 @@ def test_trigger_policy_rule_clean_and_fires():
     )
     for ok in (ok_chaos, ok_env, ok_test):
         assert not rule.check([ok]), ok.rel
+
+
+def test_telemetry_counter_ledgered_rule_clean_and_fires():
+    """telemetry-counter-ledgered: the repo routes every disposition
+    count through obs.ledger.ledger_update (clean run); a seeded
+    `.at[...]` mutation of the ledger's counter arrays fires, a
+    computed ledger= value outside obs/ fires, and the honesty
+    direction fires when the helper stops doing the scatter-adds."""
+    sep = os.sep
+    rule = lint.TelemetryCounterLedgered()
+    offenders = _run_rule(rule)
+    assert not offenders, _fmt(offenders)
+
+    bad_scatter = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}bad_ledger.py",
+        "def f(tel, row):\n"
+        "    return tel.ledger.counts.at[row].add(1)\n",
+    )
+    viols = rule.check([bad_scatter])
+    assert any("ad-hoc mutation" in v.message for v in viols), _fmt(viols)
+    bad_queue = _pkg_file(
+        f"eventgrad_tpu{sep}parallel{sep}bad_ledger2.py",
+        "def g(ledger, msgs):\n"
+        "    return ledger.replace(queue=ledger.queue.at[0].add(msgs))\n",
+    )
+    viols = rule.check([bad_queue])
+    assert any("ad-hoc mutation" in v.message for v in viols), _fmt(viols)
+    bad_kwarg = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}bad_ledger3.py",
+        "def h(tel):\n"
+        "    return tel.replace(ledger=make_counts(tel) + 1)\n",
+    )
+    viols = rule.check([bad_kwarg])
+    assert any("computed ledger=" in v.message for v in viols), _fmt(viols)
+    # the honesty direction: a helper that no longer scatter-adds the
+    # counters covers nothing and flags
+    stale_owner = _pkg_file(
+        f"eventgrad_tpu{sep}obs{sep}ledger.py",
+        "def ledger_update(led):\n    return led\n",
+    )
+    viols = rule.check([stale_owner])
+    assert any("scatter-adds" in v.message for v in viols), _fmt(viols)
+    # pass-throughs, None defaults, and helper calls stay clean; obs/
+    # itself owns the counter math; tests may mutate freely
+    ok_pass = _pkg_file(
+        f"eventgrad_tpu{sep}train{sep}ok_ledger.py",
+        "def k(tel, led):\n"
+        "    tel = tel.replace(ledger=led)\n"
+        "    tel = tel.replace(ledger=None)\n"
+        "    return tel.replace(ledger=obs_ledger.ledger_update(led))\n",
+    )
+    ok_obs = _pkg_file(
+        f"eventgrad_tpu{sep}obs{sep}device.py",
+        "def m(tel):\n"
+        "    return tel.ledger.counts.at[0].add(1)\n",
+    )
+    ok_test = _pkg_file(
+        f"tests{sep}test_whatever.py",
+        "led.counts.at[0].add(9)\n",
+    )
+    for ok in (ok_pass, ok_obs, ok_test):
+        assert not rule.check([ok]), ok.rel

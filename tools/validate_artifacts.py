@@ -704,6 +704,103 @@ STRAGGLER_ABLATION_SCHEMA = {
     },
 }
 
+_LEDGER_TOTALS = {
+    "type": "object",
+    # the full disposition taxonomy (obs/schema.py LEDGER_COUNTER_ROWS),
+    # every row EXERCISED: a composed run whose chaos/integrity/
+    # capacity/async machinery left a row at zero proves nothing about
+    # that row's accounting
+    "required": [
+        "proposed", "suppressed", "deferred", "fired", "delivered",
+        "dropped", "rejected", "late_committed",
+    ],
+    "properties": {
+        name: {"type": "integer", "minimum": 1}
+        for name in (
+            "proposed", "suppressed", "deferred", "fired", "delivered",
+            "dropped", "rejected", "late_committed",
+        )
+    },
+}
+
+LEDGER_CONSERVATION_SCHEMA = {
+    "type": "object",
+    "required": [
+        "bench", "schema_version", "topo", "algo", "op_point", "chaos",
+        "integrity", "windows", "totals", "in_flight_final",
+        "conservation", "dispositions_exercised",
+        "all_dispositions_exercised", "leak_oracles",
+        "all_leaks_caught", "obs_off_deterministic",
+        "obs_off_matches_obs_run", "wall_s",
+    ],
+    "properties": {
+        "bench": {"enum": ["ledger_conservation"]},
+        "schema_version": {"type": "integer", "minimum": 1},
+        "topo": {"type": "string"},
+        "algo": {"enum": ["eventgrad"]},
+        "op_point": {"type": "object"},
+        "chaos": {"type": "string"},
+        "integrity": {"type": "object"},
+        # the message-lifecycle acceptance gates (ISSUE 18): every flush
+        # window's conservation audit held with INTEGER equality (zero
+        # violations), the run-total sender and receiver identities
+        # hold, every disposition of the taxonomy was exercised, BOTH
+        # seeded leak oracles were caught by the auditor, and obs="off"
+        # is bitwise untouched by the ledger
+        "windows": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["epoch", "ledger", "audit_ok"],
+                "properties": {
+                    "epoch": {"type": "integer", "minimum": 1},
+                    "ledger": {"type": "object"},
+                    "audit_ok": {"enum": [True]},
+                },
+            },
+        },
+        "totals": _LEDGER_TOTALS,
+        "in_flight_final": {"type": "integer", "minimum": 0},
+        "conservation": {
+            "type": "object",
+            "required": [
+                "checks", "violations", "all_windows_ok",
+                "sender_identity_run_total",
+                "receiver_identity_run_total",
+            ],
+            "properties": {
+                "checks": {"type": "integer", "minimum": 1},
+                "violations": {"enum": [0]},
+                "all_windows_ok": {"enum": [True]},
+                "sender_identity_run_total": {"enum": [True]},
+                "receiver_identity_run_total": {"enum": [True]},
+            },
+        },
+        "dispositions_exercised": {"type": "object"},
+        "all_dispositions_exercised": {"enum": [True]},
+        "leak_oracles": {
+            "type": "array",
+            "minItems": 2,
+            "items": {
+                "type": "object",
+                "required": ["leak", "caught", "violated_laws"],
+                "properties": {
+                    "leak": {
+                        "enum": ["uncounted_drop", "double_reject"],
+                    },
+                    "caught": {"enum": [True]},
+                    "violated_laws": {"type": "array", "minItems": 1},
+                },
+            },
+        },
+        "all_leaks_caught": {"enum": [True]},
+        "obs_off_deterministic": {"enum": [True]},
+        "obs_off_matches_obs_run": {"enum": [True]},
+        "wall_s": {"type": "number", "minimum": 0},
+    },
+}
+
 FRONTIER_SCHEMA = {
     "type": "object",
     "required": [
@@ -863,6 +960,7 @@ _ARTIFACT_FAMILIES = (
     ("bench_direct_best_", _METRIC_LINE),
     ("bench_supervised_", _METRIC_LINE),
     ("frontier_", FRONTIER_SCHEMA),
+    ("ledger_conservation_", LEDGER_CONSERVATION_SCHEMA),
     ("perf_ledger", PERF_LEDGER_SCHEMA),
     ("soak_", SOAK_SCHEMA),
     ("straggler_ablation_", STRAGGLER_ABLATION_SCHEMA),
