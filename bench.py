@@ -325,6 +325,15 @@ def main() -> None:
     # rungs were calibrated against). Records carry rec["policy"], so
     # the perf ledger never gates one policy's rows against another's.
     bench_policy = os.environ.get("EG_BENCH_POLICY", "") or None
+    # bounded-async gossip (train/steps.py staleness=D): event legs only
+    # — D >= 2 carries per-edge D-slot delivery queues with commit-on-
+    # arrival, the straggler-tolerant production config (composes with
+    # bucketed/compact/carrier-resident; tools/straggler_ablation.py
+    # measures the wall-clock claim). EG_BENCH_STALENESS=D turns it on,
+    # 0 (default) keeps the lockstep step. Records carry rec["staleness"]
+    # so the perf ledger never gates a bounded-async row against a
+    # lockstep one.
+    bench_staleness = int(os.environ.get("EG_BENCH_STALENESS", "0"))
     common = dict(
         epochs=epochs, batch_size=per_rank,
         learning_rate=1e-2, momentum=0.9,  # dcifar10/event/event.cpp:196-200
@@ -347,7 +356,8 @@ def main() -> None:
         state, hist = train(
             model, topo, x, y, algo="eventgrad", event_cfg=event_cfg,
             registry=obs_reg, bucketed=bench_bucketed,
-            trigger_policy=bench_policy, **common
+            trigger_policy=bench_policy, staleness=bench_staleness,
+            **common
         )
     wall_event = time.perf_counter() - t0
     with obs_reg.span("eval_eventgrad", cat="leg"):
@@ -407,6 +417,7 @@ def main() -> None:
             learning_rate=0.05, random_sampler=False, log_every_epoch=False,
             epochs_per_dispatch=k_disp, registry=obs_reg,
             backend=bench_backend, trigger_policy=bench_policy,
+            staleness=bench_staleness,
         )
     mnist_saved = hist_m[-1]["msgs_saved_pct"]
 
@@ -654,6 +665,10 @@ def main() -> None:
                 # leg (1 = monolithic) and its per-bucket wire split —
                 # the in-step comm/compute-overlap knob next to step_ms
                 "buckets": int(hist[-1].get("buckets", 1)),
+                # bounded-async staleness bound of the event legs (0 =
+                # lockstep; D >= 2 = delivery-queue config) — a
+                # comparability-group axis, like backend and policy
+                "staleness": bench_staleness,
                 "sent_bytes_wire_real_per_bucket": hist[-1].get(
                     "sent_bytes_wire_real_per_bucket"
                 ),
@@ -706,9 +721,10 @@ def main() -> None:
 
     # one-line perf-trajectory delta vs the committed ledger
     # (tools/perf_ledger.py) — stderr, because stdout is the result-line
-    # contract; comparability = same (platform, model, config, backend)
-    # so a CPU smoke never reads as a regression of a chip round and a
-    # shard_map mesh run never reads against a vmap one
+    # contract; comparability = same (platform, model, config, backend,
+    # policy, staleness) so a CPU smoke never reads as a regression of a
+    # chip round, a shard_map mesh run never reads against a vmap one,
+    # and a bounded-async row never gates against a lockstep round
     try:
         import sys as _sys
 
@@ -725,6 +741,7 @@ def main() -> None:
             "status": "ok", "platform": jax.devices()[0].platform,
             "model": type(model).__name__, "config": tier,
             "backend": hist[-1].get("backend", "vmap"),
+            "staleness": bench_staleness,
             "step_ms": round(1000 * step_s, 2),
             "mfu": (
                 mfu if mfu is not None

@@ -206,6 +206,22 @@ CONFIGS: Tuple[AuditConfig, ...] = (
     AuditConfig("event_compact_int8_arena_stale4", gossip_wire="compact",
                 capacity=CAPACITY, wire="int8", arena=True, staleness=4),
     AuditConfig("sp_f32_tree", algo="sp_eventgrad"),
+    # the composed overlap stack (ISSUE 20): bounded-async delivery
+    # queues CARRIED PER-BUCKET in the carrier dtype under the compact
+    # wire — every overlap mechanism at once, exactly the production
+    # configuration tools/straggler_ablation.py --measured times. The
+    # queue slots add no wire lanes (commit deferral is state, not
+    # traffic), so the same declared offsets and exact wire-byte
+    # equality must hold over the fully composed program; the seeded
+    # bucket_queue_skew oracle proves the queue-in-bucket carry is
+    # actually checked
+    AuditConfig("event_compact_int8_arena_b4_stale2_carrier",
+                gossip_wire="compact", capacity=BUCKETED_CAPACITY,
+                wire="int8", arena=True, bucketed=4, staleness=2,
+                carrier=True),
+    # sp_eventgrad's payload queues at D=2 (SparseState.pending): the
+    # top-k lanes are unchanged — the deferred scatter is state too
+    AuditConfig("sp_f32_tree_stale2", algo="sp_eventgrad", staleness=2),
     # carrier-resident gossip state (ISSUE 17): the receive buffers live
     # in the wire dtype and the dequant runs inside the commit/mix
     # reads — the exchange lanes themselves are UNCHANGED, so the
@@ -331,7 +347,9 @@ def build(cfg: AuditConfig):
     state = init_train_state(
         model, in_shape, tx, topo, cfg.algo, CFG, seed=0, arena=cfg.arena,
         bucketed=cfg.bucketed or 1, input_dtype=in_dtype,
-        staleness=cfg.staleness if cfg.algo == "eventgrad" else 0,
+        # init_train_state routes the depth itself: eventgrad's queues
+        # live in EventState.pending, sp's in SparseState.pending
+        staleness=cfg.staleness,
         resident_wire=(
             cfg.wire if cfg.carrier and cfg.algo == "eventgrad" else None
         ),
@@ -986,25 +1004,33 @@ def oracle_host_callback() -> Tuple[bool, str]:
     return rep["callbacks"] > 0, f"{rep['callbacks']} host callbacks"
 
 
-def _run_steps(cfg: AuditConfig, n_steps: int = 4, sabotage=None):
+def _run_steps(cfg: AuditConfig, n_steps: int = 4, sabotage=None,
+               sabotage_bucket=None):
     """Final params after `n_steps` eager vmap steps of one cell —
-    the value harness the late-delivery oracle drives. `sabotage`
-    temporarily rebinds train.steps' async_delivery_commit."""
+    the value harness the bounded-async oracles drive. `sabotage`
+    temporarily rebinds train.steps' async_delivery_commit (the
+    monolithic queue seam); `sabotage_bucket` rebinds
+    async_bucket_commit (the per-bucket queue seam of the composed
+    schedule)."""
     from eventgrad_tpu.train import steps as steps_mod
 
     batch = _batch(cfg)
     orig = steps_mod.async_delivery_commit
+    orig_b = steps_mod.async_bucket_commit
     try:
         if sabotage is not None:
             # steps.py resolves the name at TRACE time (module global),
             # so building the step under the rebinding suffices
             steps_mod.async_delivery_commit = sabotage
+        if sabotage_bucket is not None:
+            steps_mod.async_bucket_commit = sabotage_bucket
         state, step, topo = build(cfg)
         lifted = spmd(step, topo)
         for _ in range(n_steps):
             state, _m = lifted(state, batch)
     finally:
         steps_mod.async_delivery_commit = orig
+        steps_mod.async_bucket_commit = orig_b
     return state
 
 
@@ -1023,9 +1049,10 @@ def oracle_late_delivery_drift() -> Tuple[bool, str]:
     cfg1 = dataclasses.replace(cfg2, name="stale1_ref", staleness=1)
 
     def sabotaged(state, cands, effs, delivered, lag_vec, pass_num,
-                  spec, bound):
+                  spec, bound, cand_scales=None):
         new_state, bufs, stale, late = events_mod.async_delivery_commit(
-            state, cands, effs, delivered, lag_vec, pass_num, spec, bound
+            state, cands, effs, delivered, lag_vec, pass_num, spec, bound,
+            cand_scales=cand_scales,
         )
         return new_state, state.bufs, stale, late  # mix reads PRE-arrival
 
@@ -1045,6 +1072,56 @@ def oracle_late_delivery_drift() -> Tuple[bool, str]:
         "clean D=2 == D=1 bitwise; sabotaged commit-on-arrival "
         "diverges from the deferred-fire reference"
         if detected else "equivalence harness failed to fire"
+    )
+
+
+def oracle_bucket_queue_skew() -> Tuple[bool, str]:
+    """ONE bucket's delivery queue shifted by a slot WITHOUT its edge
+    clock (the composed queue-in-bucket carry desynchronized: payload
+    slots rotated, the scalar sent/late ledger untouched). The
+    bitwise contract of the composed stack — bucketed D=2 under
+    all-baseline lags ≡ D=1 — must catch it: a queue whose slots no
+    longer line up with the clock commits the wrong pass's payload
+    for that bucket, and the trajectory diverges from the reference
+    while the clean composed run stays bitwise."""
+    from eventgrad_tpu.parallel import events as events_mod
+
+    cfg2 = dataclasses.replace(
+        config_by_name("event_masked_f32_arena_b4"),
+        name="b4_stale2", staleness=2,
+    )
+    cfg1 = dataclasses.replace(cfg2, name="b4_stale1_ref", staleness=1)
+
+    def skewed(slots, here, cand, eff, last, seg, bucket=None,
+               cand_scale=None, last_scale=None):
+        buf, ncs, nes, nss, bs = events_mod.async_bucket_commit(
+            slots, here, cand, eff, last, seg, bucket=bucket,
+            cand_scale=cand_scale, last_scale=last_scale,
+        )
+        if bucket == 0:
+            # rotate bucket 0's payload queue one slot; the clock
+            # (async_delivery_plan's sent/late scalars) stays put
+            ncs = tuple(ncs[1:]) + (ncs[0],)
+            nes = tuple(nes[1:]) + (nes[0],)
+        return buf, ncs, nes, nss, bs
+
+    ref = _run_steps(cfg1)
+    good = _run_steps(cfg2)
+    bad = _run_steps(cfg2, sabotage_bucket=skewed)
+
+    def _same(a, b):
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves(a.params),
+                            jax.tree.leaves(b.params))
+        )
+
+    clean_holds = _same(ref, good)
+    detected = clean_holds and not _same(ref, bad)
+    return detected, (
+        "clean composed bucketed D=2 == D=1 bitwise; skewing one "
+        "bucket's queue against its clock diverges"
+        if detected else "composed equivalence harness failed to fire"
     )
 
 
@@ -1264,6 +1341,8 @@ def oracle_stale_scale_reuse() -> Tuple[bool, str]:
 ORACLES = {
     "rank_coupling_ppermute": oracle_rank_coupling,
     "late_delivery_drift": oracle_late_delivery_drift,
+    # ISSUE 20: the composed queue-in-bucket carry
+    "bucket_queue_skew": oracle_bucket_queue_skew,
     "bucket_undeclared_offset": oracle_bucket_undeclared_offset,
     "rank_coupling_roll": oracle_rank_roll,
     "wire_dtype_upcast": oracle_wire_dtype_upcast,

@@ -260,8 +260,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "reads — bitwise-identical training at a "
                         "fraction of the buffer HBM traffic "
                         "(tools/overhead_ablation.py resident). Needs "
-                        "eventgrad + the arena + --wire, and is not "
-                        "combinable with --staleness >= 2")
+                        "eventgrad + the arena + --wire; composes with "
+                        "--bucketed and --staleness >= 2 (the delivery "
+                        "queues allocate carrier-resident slots too)")
     p.add_argument("--bucketed", type=int, default=0, metavar="K",
                    help="bucketed gossip schedule (train/steps.py): "
                         "segment the flat arena into K leaf-aligned "
@@ -511,11 +512,6 @@ def main(argv=None) -> int:
     if args.staleness:
         if args.algo not in ("eventgrad", "sp_eventgrad"):
             raise SystemExit("--staleness applies to the event algorithms only")
-        if args.staleness >= 2 and args.algo != "eventgrad":
-            raise SystemExit(
-                "--staleness >= 2 (the bounded-async bound D) is "
-                "eventgrad-only; sp_eventgrad supports staleness 0/1"
-            )
         if args.trace_file:
             raise SystemExit(
                 "--trace-file records the synchronous exchange; not "

@@ -313,3 +313,45 @@ def alloc_event_bufs(
     else:
         s0 = jnp.ones((spec.n_leaves,), jnp.float32)
     return bufs, tuple(s0 for _ in range(int(n_neighbors)))
+
+
+def alloc_event_queue(
+    spec: ArenaSpec, n_neighbors: int, depth: int, *, wire=None,
+    buckets: int = 1,
+):
+    """Bounded-async delivery-queue slot allocation (`EventState.pending`
+    for staleness=D >= 2) — the queue twin of `alloc_event_bufs`, and
+    routed THROUGH it so the carrier layout (resident dtype + dequant
+    scales) stays declared in exactly one place.
+
+    Per neighbor: `depth` slots of
+        (candidate, eff fire bits, sent-pass i32, late-count i32
+         [, dequant scales — int8 carrier only])
+    where the candidate (and scales) carry the SAME layout as the
+    receive buffers themselves: flat [n_total] monolithic or the
+    per-bucket tuple of the bucketed schedule, in the wire dtype under
+    carrier residency. A queued zero slot commits nothing (eff all
+    False) and a zero carrier dequantizes to exactly +0.0, so the zero
+    init is bitwise the empty queue. The slot index stays the second
+    path component of the checkpoint layout
+    (`state/event/pending/<edge>/<slot>/...`), which the cross-depth
+    restore guard keys on."""
+    k = int(buckets) if buckets else 1
+    bufs, scales = alloc_event_bufs(spec, 1, wire=wire, buckets=k)
+    cand0, scale0 = bufs[0], (scales[0] if scales is not None else None)
+    if k > 1:
+        eff0 = tuple(
+            jnp.zeros((b.n_leaves,), bool) for b in spec.buckets(k)
+        )
+    else:
+        eff0 = jnp.zeros((spec.n_leaves,), bool)
+    slot0 = (
+        cand0,                      # zero candidate (immutable — shared)
+        eff0,                       # eff: commits are no-ops
+        jnp.zeros((), jnp.int32),   # sent pass 0 = empty
+        jnp.zeros((), jnp.int32),   # late messages in the slot
+    ) + ((scale0,) if scale0 is not None else ())
+    return tuple(
+        tuple(slot0 for _ in range(int(depth)))
+        for _ in range(int(n_neighbors))
+    )

@@ -210,13 +210,17 @@ class EventState(struct.PyTreeNode):
     #: bounded-async delivery queues (train(staleness=D) for D >= 2;
     #: None otherwise — D <= 1 states keep the legacy structure so old
     #: checkpoints restore unchanged): per neighbor, D slots of
-    #: (candidate flat [n] buffer, effective [L] fire bits, sent-pass
-    #: int32 scalar, late-message count int32 scalar), slot r holding
-    #: the in-flight message that commits r+1 passes from now (the
-    #: late count survives same-arrival-pass merges, where the merged
-    #: sent-pass keeps only the newest). Zero slots are no-op commits
-    #: (eff all False), so the zero init needs no special casing —
-    #: exactly the reference's zero RMA window (event.cpp:177-179).
+    #: (candidate buffer, effective fire bits, sent-pass int32 scalar,
+    #: late-message count int32 scalar[, dequant scales — int8 carrier
+    #: only]), slot r holding the in-flight message that commits r+1
+    #: passes from now (the late count survives same-arrival-pass
+    #: merges, where the merged sent-pass keeps only the newest). The
+    #: candidate/eff/scale entries carry the buffers' own layout — flat
+    #: [n] monolithic or per-bucket tuples under bucketed=K, in the
+    #: wire dtype under carrier residency (arena.alloc_event_queue).
+    #: Zero slots are no-op commits (eff all False), so the zero init
+    #: needs no special casing — exactly the reference's zero RMA
+    #: window (event.cpp:177-179).
     pending: Any = None
     #: int32 [n_neighbors] per-edge staleness clock: the send pass of
     #: the newest DELIVERED exchange committed on each edge (0 = none
@@ -272,19 +276,8 @@ class EventState(struct.PyTreeNode):
             raise ValueError(
                 "EventState.init(staleness>=2) carries flat per-edge "
                 "delivery queues and needs arena=True (the bounded-"
-                "async engine is an arena hot path)"
-            )
-        if depth and buckets and int(buckets) > 1:
-            raise ValueError(
-                "bounded-async staleness>=2 does not compose with the "
-                "bucketed buffer layout (per-edge delivery queues are "
-                "whole-wire state)"
-            )
-        if depth and resident_wire is not None:
-            raise ValueError(
-                "carrier-resident buffers do not compose with the "
-                "bounded-async delivery queues (staleness>=2): the "
-                "in-flight slots are f32 candidate state"
+                "async engine is an arena hot path) — drop staleness "
+                "to <= 1 or pass arena=True"
             )
         buf_scales = None
         if arena:
@@ -317,14 +310,13 @@ class EventState(struct.PyTreeNode):
         edge_clock = None
         late_commits = None
         if depth:
-            slot0 = (
-                buf0,  # zero candidate (immutable — sharing is fine)
-                jnp.zeros((n,), bool),  # eff: commits are no-ops
-                jnp.zeros((), jnp.int32),  # sent pass 0 = empty
-                jnp.zeros((), jnp.int32),  # late messages in the slot
-            )
-            pending = tuple(
-                tuple(slot0 for _ in range(depth)) for _ in topo.neighbors
+            # queue slots share the buffers' exact layout — per-bucket
+            # tuples under bucketed=K, the wire carrier dtype (+ per-slot
+            # dequant scales) under carrier residency — allocated through
+            # the one arena helper that declares the resident dtype
+            pending = arena_mod.alloc_event_queue(
+                spec, topo.n_neighbors, depth, wire=resident_wire,
+                buckets=int(buckets) if buckets else 1,
             )
             edge_clock = jnp.zeros((topo.n_neighbors,), jnp.int32)
             late_commits = jnp.zeros((), jnp.int32)
@@ -476,6 +468,127 @@ def commit(
     )
 
 
+def async_delivery_plan(
+    state: EventState,
+    delivered: "Any",
+    lag_vec: jnp.ndarray,
+    pass_num: jnp.ndarray,
+    bound: int,
+):
+    """The scalar half of one bounded-async pass, shared by every bucket
+    of the buffer layout: arrival clocks from slot 0's sent stamps, the
+    late-commit drain, and the shift+merge of the per-slot (sent, late)
+    scalars — none of which depend on the candidate arrays, so the
+    bucketed schedule computes them ONCE and threads the enqueue masks
+    into each per-bucket commit tail (`async_bucket_commit`).
+
+    Returns `(here, sent_slots, late_slots, new_clock, late_now)`:
+    `here[i][r]` the bool enqueue mask of edge i's slot r (this pass's
+    message lands where its lag says), `sent_slots`/`late_slots` the
+    post-shift-and-merge per-edge per-slot i32 stamps, `new_clock` the
+    advanced per-edge staleness clock, `late_now` the late commits
+    drained this pass (slot 0's counts)."""
+    D = int(bound)
+    pass_i = jnp.asarray(pass_num, jnp.int32)
+    n_nb = len(state.pending)
+    if delivered is None:
+        delivered = jnp.ones((n_nb,), bool)
+    sent_new = jnp.where(delivered, pass_i, jnp.int32(0))  # [n_nb]
+    # a delivered message enqueued at lag >= 2 WILL commit late; the
+    # count rides its slot so same-arrival-pass merges (whose sent-pass
+    # keeps only the newest message) still account every late one
+    late_new = (delivered & (lag_vec >= 2)).astype(jnp.int32)  # [n_nb]
+    here_all, sent_all, late_all, clock_out = [], [], [], []
+    late = jnp.zeros((), jnp.int32)
+    for i in range(n_nb):
+        slots = state.pending[i]
+        s0, l0 = slots[0][2], slots[0][3]
+        arrived = s0 > 0
+        clock_out.append(jnp.where(
+            arrived, jnp.maximum(state.edge_clock[i], s0),
+            state.edge_clock[i],
+        ))
+        late = late + l0
+        d = lag_vec[i]
+        hs, ss_out, ls_out = [], [], []
+        for r in range(D):
+            if r + 1 < D:
+                ss, sl = slots[r + 1][2], slots[r + 1][3]
+            else:
+                ss = sl = jnp.zeros((), jnp.int32)
+            h = (d - 1) == r
+            hs.append(h)
+            ss_out.append(jnp.where(h, jnp.maximum(ss, sent_new[i]), ss))
+            ls_out.append(jnp.where(h, sl + late_new[i], sl))
+        here_all.append(tuple(hs))
+        sent_all.append(tuple(ss_out))
+        late_all.append(tuple(ls_out))
+    clock = jnp.stack(clock_out) if n_nb else state.edge_clock
+    return here_all, sent_all, late_all, clock, late
+
+
+def async_bucket_commit(
+    slots,
+    here,
+    cand: jnp.ndarray,
+    eff: jnp.ndarray,
+    last: jnp.ndarray,
+    seg: jnp.ndarray,
+    bucket=None,
+    cand_scale=None,
+    last_scale=None,
+):
+    """The array half of one edge's bounded-async update, restricted to
+    one bucket of the buffer layout (`bucket=None` = the monolithic
+    whole-wire slice): slot 0's arrival commits into the persistent
+    buffer with the same `where(eff, cand, stale)` select every
+    synchronous path uses, the queue shifts, and this pass's shipped
+    (cand, eff[, scale]) merge-inserts at the slots `here` flags (from
+    `async_delivery_plan`) — later-sent-wins, elementwise per bucket.
+    Under an int8 carrier the per-slot dequant scales ride the same
+    discipline: arrivals land their scales next to their payload, so a
+    committed leaf always dequantizes through the scale it crossed the
+    wire with.
+
+    Returns `(buf, new_cands, new_effs, new_scales, buf_scale)` — the
+    post-arrival buffer, the D per-slot candidate/eff (and scale)
+    entries for this bucket, and the post-arrival dequant scales
+    (scale returns are None without an int8 carrier)."""
+    D = len(slots)
+
+    def pick(slot, idx):
+        v = slot[idx]
+        return v if bucket is None else v[bucket]
+
+    c0, e0 = pick(slots[0], 0), pick(slots[0], 1)
+    buf = jnp.where(e0[seg], c0, last)
+    buf_scale = None
+    if last_scale is not None:
+        buf_scale = jnp.where(e0, pick(slots[0], 4), last_scale)
+    eff_exp = eff[seg]
+    new_cands, new_effs, new_scales = [], [], []
+    for r in range(D):
+        if r + 1 < D:
+            sc, se = pick(slots[r + 1], 0), pick(slots[r + 1], 1)
+            ssc = pick(slots[r + 1], 4) if last_scale is not None else None
+        else:
+            sc, se = jnp.zeros_like(c0), jnp.zeros_like(e0)
+            ssc = (
+                jnp.zeros_like(last_scale)
+                if last_scale is not None else None
+            )
+        h = here[r]
+        new_cands.append(jnp.where(h & eff_exp, cand, sc))
+        new_effs.append(jnp.where(h, se | eff, se))
+        if last_scale is not None:
+            new_scales.append(jnp.where(h & eff, cand_scale, ssc))
+    return (
+        buf, tuple(new_cands), tuple(new_effs),
+        tuple(new_scales) if last_scale is not None else None,
+        buf_scale,
+    )
+
+
 def async_delivery_commit(
     state: EventState,
     cands: Tuple[jnp.ndarray, ...],
@@ -485,6 +598,7 @@ def async_delivery_commit(
     pass_num: jnp.ndarray,
     spec,
     bound: int,
+    cand_scales=None,
 ) -> Tuple[EventState, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray]:
     """One pass of the bounded-async delivery engine (staleness=D >= 2).
 
@@ -525,60 +639,52 @@ def async_delivery_commit(
     discipline, so the auditor's in-flight balancing term matches this
     engine slot for slot; its `late_committed` row counts leaf-messages
     where the `late_commits` return counts edge-exchanges — same events,
-    different units."""
+    different units.
+
+    Under an int8 carrier (`state.buf_scales` set) the caller passes
+    `cand_scales` — the exchange's per-neighbor [L] dequant scales —
+    and both the queue slots and the post-arrival `buf_scales` carry
+    them alongside their payloads. The bucketed schedule does not call
+    this wrapper: it runs `async_delivery_plan` once and
+    `async_bucket_commit` inside each per-bucket commit tail."""
     D = int(bound)
     pass_i = jnp.asarray(pass_num, jnp.int32)
     seg = spec.seg_expand()
     n_nb = len(cands)
-    if delivered is None:
-        delivered = jnp.ones((n_nb,), bool)
-    sent_new = jnp.where(delivered, pass_i, jnp.int32(0))  # [n_nb]
-    # a delivered message enqueued at lag >= 2 WILL commit late; the
-    # count rides its slot so same-arrival-pass merges (whose sent-pass
-    # keeps only the newest message) still account every late one
-    late_new = (delivered & (lag_vec >= 2)).astype(jnp.int32)  # [n_nb]
-    new_bufs = []
-    new_pending = []
-    clock_out = []
-    late = jnp.zeros((), jnp.int32)
-    for i in range(n_nb):
-        slots = state.pending[i]
-        c0, e0, s0, l0 = slots[0]
-        # 1. arrivals (oldest in-flight message) commit on arrival
-        buf = jnp.where(e0[seg], c0, state.bufs[i])
-        arrived = s0 > 0
-        clock_i = jnp.where(
-            arrived, jnp.maximum(state.edge_clock[i], s0),
-            state.edge_clock[i],
+    scaled = state.buf_scales is not None
+    if scaled and cand_scales is None:
+        raise ValueError(
+            "async_delivery_commit on an int8-carrier state needs the "
+            "exchange's cand_scales (the per-slot dequant scales ride "
+            "the queue)"
         )
-        late = late + l0
-        # 2 + 3. shift the queue and merge-insert this pass's message
-        # at its (dynamic) lag slot — D wide selects, D static
-        d = lag_vec[i]
-        eff_exp = effs[i][seg]
-        empty = (
-            jnp.zeros_like(c0), jnp.zeros_like(e0),
-            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+    here, sent_slots, late_slots, clock, late = async_delivery_plan(
+        state, delivered, lag_vec, pass_num, bound
+    )
+    new_bufs, new_pending, new_bscales = [], [], []
+    for i in range(n_nb):
+        buf, ncs, nes, nss, bscale = async_bucket_commit(
+            state.pending[i], here[i], cands[i], effs[i],
+            state.bufs[i], seg,
+            cand_scale=cand_scales[i] if scaled else None,
+            last_scale=state.buf_scales[i] if scaled else None,
         )
         slots_next = []
         for r in range(D):
-            sc, se, ss, sl = slots[r + 1] if r + 1 < D else empty
-            here = (d - 1) == r
-            slots_next.append((
-                jnp.where(here & eff_exp, cands[i], sc),
-                jnp.where(here, se | effs[i], se),
-                jnp.where(here, jnp.maximum(ss, sent_new[i]), ss),
-                jnp.where(here, sl + late_new[i], sl),
-            ))
+            slot = (ncs[r], nes[r], sent_slots[i][r], late_slots[i][r])
+            if scaled:
+                slot = slot + (nss[r],)
+            slots_next.append(slot)
         new_bufs.append(buf)
         new_pending.append(tuple(slots_next))
-        clock_out.append(clock_i)
-    clock = jnp.stack(clock_out) if n_nb else state.edge_clock
+        if scaled:
+            new_bscales.append(bscale)
     new_state = state.replace(
         bufs=tuple(new_bufs),
         pending=tuple(new_pending),
         edge_clock=clock,
         late_commits=state.late_commits + late,
+        buf_scales=tuple(new_bscales) if scaled else state.buf_scales,
     )
     return new_state, tuple(new_bufs), pass_i - clock, late
 

@@ -54,17 +54,50 @@ class SparseConfig:
 
 class SparseState(struct.PyTreeNode):
     """prev_sent: sender shadow of last-transmitted values (spevent.cpp:128-131).
-    replicas: per-neighbor persistent full-model replicas (:133-136)."""
+    replicas: per-neighbor persistent full-model replicas (:133-136).
+    pending: bounded-async delivery queues (staleness >= 2 only; None
+    under lockstep/delayed gossip) — per neighbor, `staleness` slots of
+    decoded `(vals, idxs, fire)` payload trees. Slot 0 commits into the
+    replicas at the start of the NEXT exchange (commit-on-arrival, the
+    EventState.pending discipline); sp composes with D >= 2 but not
+    chaos lag clauses, so every payload enqueues at slot 0 and slots
+    >= 1 only pad the runway. The slot index is the 2nd path component
+    (`state/sparse/pending/{i}/{d}/...`), which is what lets checkpoint
+    restore sniff the queue depth and refuse a cross-D resume."""
 
     prev_sent: Any
     replicas: Tuple[Any, ...]
+    pending: Any = None
 
     @classmethod
-    def init(cls, params: Any, topo: Topology) -> "SparseState":
+    def init(
+        cls, params: Any, topo: Topology, cfg: "SparseConfig" = None,
+        staleness: int = 0,
+    ) -> "SparseState":
         copy = jax.tree.map(lambda x: x, params)
+        pending = None
+        if staleness >= 2:
+            if cfg is None:
+                raise ValueError(
+                    "SparseState.init: staleness >= 2 needs cfg= — the "
+                    "queued payload shapes depend on topk_percent"
+                )
+            zv = jax.tree.map(
+                lambda x: jnp.zeros((cfg.k_for(x.size),), x.dtype), params
+            )
+            zi = jax.tree.map(
+                lambda x: jnp.zeros((cfg.k_for(x.size),), jnp.int32), params
+            )
+            zf = jax.tree.map(lambda x: jnp.zeros((), bool), params)
+            slot = (zv, zi, zf)
+            pending = tuple(
+                tuple(slot for _ in range(int(staleness)))
+                for _ in topo.neighbors
+            )
         return cls(
             prev_sent=copy,
             replicas=tuple(jax.tree.map(lambda x: x, params) for _ in topo.neighbors),
+            pending=pending,
         )
 
 
@@ -76,6 +109,7 @@ def sparse_exchange(
     cfg: SparseConfig,
     wire=None,
     buckets=None,
+    staleness: int = 0,
 ) -> SparseState:
     """One step of sparsified gossip: build top-k payloads, ship them to every
     neighbor (masked — receivers apply only when the sender fired), update the
@@ -90,7 +124,16 @@ def sparse_exchange(
     b-1's replica scatters are emitted, so XLA's scheduler is free to
     overlap one bucket's exchange with another's commit work. Every op
     is per-leaf either way — the result is bitwise the monolithic call
-    (tests/test_bucketed.py); the state layout is unchanged."""
+    (tests/test_bucketed.py); the state layout is unchanged.
+
+    `staleness` >= 2 turns on the bounded-async payload queue: this
+    pass's received payloads land in `sp.pending` slot 0 instead of the
+    replicas, and the slot-0 payload enqueued LAST pass commits into the
+    replicas first (commit-on-arrival). The caller then mixes the
+    post-exchange replicas directly — payloads from passes <= p-1, i.e.
+    bitwise the staleness=1 stale-replica mix, which is the D=2-at-
+    baseline-lag ≡ D=1 pin. sp never composes with chaos lag clauses,
+    so the deeper slots are runway, never occupied."""
     vals, idxs = topk_payload(params, sp.prev_sent, cfg)
 
     new_prev = scatter_into(sp.prev_sent, vals, idxs, fire)
@@ -117,15 +160,32 @@ def sparse_exchange(
 
     if buckets is None:
         new_replicas = []
-        for nb, replica in zip(topo.neighbors, sp.replicas):
+        new_pending = [] if staleness >= 2 else sp.pending
+        for ni, (nb, replica) in enumerate(zip(topo.neighbors, sp.replicas)):
             got_vals, got_s, got_idxs, got_fire = collectives.recv_from(
                 (q, scale_vec, idxs, fire), topo, nb
             )
             got_vals = _decode(got_vals, got_s, vals)
-            new_replicas.append(
-                scatter_into(replica, got_vals, got_idxs, got_fire)
-            )
-        return sp.replace(prev_sent=new_prev, replicas=tuple(new_replicas))
+            if staleness >= 2:
+                # commit-on-arrival: LAST pass's slot-0 payload lands in
+                # the replica; this pass's payload takes its place (lag
+                # is always 1 here — sp x chaos stays refused upstream)
+                v0, i0, f0 = sp.pending[ni][0]
+                new_replicas.append(scatter_into(replica, v0, i0, f0))
+                new_pending.append(
+                    ((got_vals, got_idxs, got_fire),)
+                    + tuple(sp.pending[ni][1:])
+                )
+            else:
+                new_replicas.append(
+                    scatter_into(replica, got_vals, got_idxs, got_fire)
+                )
+        if staleness >= 2:
+            new_pending = tuple(new_pending)
+        return sp.replace(
+            prev_sent=new_prev, replicas=tuple(new_replicas),
+            pending=new_pending,
+        )
 
     # bucketed: leaf-sliced lanes per bucket, shipped with pipelined
     # emission (ship b, scatter b-1, ship b+1, ...)
@@ -135,9 +195,22 @@ def sparse_exchange(
     v_l, i_l, f_l = _leaves(vals), _leaves(idxs), _leaves(fire)
     q_l = _leaves(q)
     r_l = [_leaves(r) for r in sp.replicas]  # [n_nb][L]
+    n_nb = len(topo.neighbors)
     B = len(buckets)
+    L = len(v_l)
     shipped = [None] * B   # per bucket: per-neighbor received lane lists
     out_l = [list(rl) for rl in r_l]
+    # queue mode: the replicas receive slot 0's payload (full-leaf
+    # lanes, sliced per bucket inside the same pipelined commit tails);
+    # this pass's received lanes assemble into the new slot 0
+    queue = staleness >= 2
+    if queue:
+        p0_v = [_leaves(sp.pending[ni][0][0]) for ni in range(n_nb)]
+        p0_i = [_leaves(sp.pending[ni][0][1]) for ni in range(n_nb)]
+        p0_f = [_leaves(sp.pending[ni][0][2]) for ni in range(n_nb)]
+        recv_v = [[None] * L for _ in range(n_nb)]
+        recv_i = [[None] * L for _ in range(n_nb)]
+        recv_f = [[None] * L for _ in range(n_nb)]
 
     def _ship(bi):
         b = buckets[bi]
@@ -151,20 +224,34 @@ def sparse_exchange(
             collectives.recv_from(lanes, topo, nb) for nb in topo.neighbors
         ]
 
+    def _scatter(ni, ks, gv, gi, gf):
+        for j, k in enumerate(ks):
+            scattered = (
+                out_l[ni][k].reshape(-1).at[gi[j]]
+                .set(gv[j]).reshape(out_l[ni][k].shape)
+            )
+            out_l[ni][k] = jnp.where(gf[j], scattered, out_l[ni][k])
+
     def _commit(bi):
         b = buckets[bi]
         like = tuple(v_l[b.lo:b.hi])
-        for ni in range(len(topo.neighbors)):
+        ks = range(b.lo, b.hi)
+        for ni in range(n_nb):
             got_q, got_s, got_idxs, got_fire = shipped[bi][ni]
             got_vals = _decode(got_q, got_s, like)
-            for j, k in enumerate(range(b.lo, b.hi)):
-                scattered = (
-                    out_l[ni][k].reshape(-1).at[got_idxs[j]]
-                    .set(got_vals[j]).reshape(out_l[ni][k].shape)
+            if queue:
+                for j, k in enumerate(ks):
+                    recv_v[ni][k] = got_vals[j]
+                    recv_i[ni][k] = got_idxs[j]
+                    recv_f[ni][k] = got_fire[j]
+                _scatter(
+                    ni, ks,
+                    [p0_v[ni][k] for k in ks],
+                    [p0_i[ni][k] for k in ks],
+                    [p0_f[ni][k] for k in ks],
                 )
-                out_l[ni][k] = jnp.where(
-                    got_fire[j], scattered, out_l[ni][k]
-                )
+            else:
+                _scatter(ni, ks, got_vals, got_idxs, got_fire)
 
     _ship(0)
     for bi in range(1, B):
@@ -175,6 +262,22 @@ def sparse_exchange(
     rep_def = jax.tree.structure(sp.replicas[0])
     new_replicas = tuple(
         jax.tree.unflatten(rep_def, out_l[ni])
-        for ni in range(len(topo.neighbors))
+        for ni in range(n_nb)
     )
-    return sp.replace(prev_sent=new_prev, replicas=tuple(new_replicas))
+    new_pending = sp.pending
+    if queue:
+        vdef = jax.tree.structure(vals)
+        new_pending = tuple(
+            (
+                (
+                    jax.tree.unflatten(vdef, recv_v[ni]),
+                    jax.tree.unflatten(vdef, recv_i[ni]),
+                    jax.tree.unflatten(vdef, recv_f[ni]),
+                ),
+            ) + tuple(sp.pending[ni][1:])
+            for ni in range(n_nb)
+        )
+    return sp.replace(
+        prev_sent=new_prev, replicas=tuple(new_replicas),
+        pending=new_pending,
+    )

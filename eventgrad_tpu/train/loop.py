@@ -83,17 +83,43 @@ def _snapshot_async_depth(raw: Any) -> int:
     """The bounded-async queue depth D a peeked snapshot was written
     with (0 = no per-edge delivery queues, i.e. staleness <= 1) —
     inferred from the leaf paths, so it works on the template-free
-    orbax restore regardless of container kinds."""
+    orbax restore regardless of container kinds. The slot index is the
+    2nd path component under pending/ for BOTH queue owners:
+    eventgrad's EventState.pending and sp_eventgrad's
+    SparseState.pending payload queues."""
     import re as _re
 
     from eventgrad_tpu.utils.checkpoint import _path_name
 
     slots = set()
     for kp, _ in jax.tree_util.tree_flatten_with_path(raw)[0]:
-        m = _re.match(r"state/event/pending/\d+/(\d+)/", _path_name(kp))
+        m = _re.match(
+            r"state/(?:event|sparse)/pending/\d+/(\d+)/", _path_name(kp)
+        )
         if m:
             slots.add(int(m.group(1)))
     return max(slots) + 1 if slots else 0
+
+
+def _snapshot_bucket_count(raw: Any) -> int:
+    """The bucket count K a peeked snapshot's EventState receive
+    buffers were written with (1 = monolithic flat arena or tree
+    layout) — inferred from the leaf paths: per-bucket buffers are
+    per-neighbor TUPLES, so the 2nd component under bufs/ is a numeric
+    bucket index; monolithic flat bufs are leaves at bufs/{i} (no 2nd
+    component) and tree-layout bufs have non-numeric module names
+    there. Lets a cross-K resume fail with the cause named BEFORE the
+    structural restore produces an unhelpful treedef mismatch."""
+    import re as _re
+
+    from eventgrad_tpu.utils.checkpoint import _path_name
+
+    buckets = set()
+    for kp, _ in jax.tree_util.tree_flatten_with_path(raw)[0]:
+        m = _re.match(r"state/event/bufs/\d+/(\d+)(?:/|$)", _path_name(kp))
+        if m:
+            buckets.add(int(m.group(1)))
+    return max(buckets) + 1 if buckets else 1
 
 
 def _snapshot_resident_wire(raw: Any) -> Optional[str]:
@@ -918,27 +944,19 @@ def train(
     # the combinability guards must fire BEFORE state init
     staleness = int(staleness)
     if staleness >= 2:
-        if algo != "eventgrad":
+        if algo not in ("eventgrad", "sp_eventgrad"):
             raise ValueError(
                 f"staleness={staleness} (the bounded-async bound D) "
                 "rides the event exchange's per-edge delivery queues "
-                f"(algo='eventgrad'); got algo={algo!r} — sp_eventgrad "
-                "supports staleness 0/1 only"
+                f"(algos: eventgrad, sp_eventgrad); got algo={algo!r}"
             )
-        if not arena_on:
+        if algo == "eventgrad" and not arena_on:
             raise ValueError(
                 f"staleness={staleness} carries its delivery queues as "
                 "flat arena buffers, but this run resolved arena OFF "
                 "(explicit arena=False, a sharded topology, or "
                 "heterogeneous parameter dtypes) — drop staleness>=2 "
                 "or make the run arena-eligible"
-            )
-        if bucketed_k > 1:
-            raise ValueError(
-                f"staleness={staleness} is not combinable with "
-                "bucketed=K: the per-edge delivery queues are "
-                "whole-wire state, which the bucketed schedule splits "
-                "K ways"
             )
         if fused_update:
             raise ValueError(
@@ -985,19 +1003,16 @@ def train(
                 f"carrier dtype, but wire={_wire_now!r} has none — use "
                 "wire='bf16'/'int8' (f32 wires are already resident)"
             )
-        elif staleness >= 2:
-            raise ValueError(
-                f"carrier_resident=True is not combinable with "
-                f"staleness={staleness}: the bounded-async delivery "
-                "queues carry f32 candidate slots"
-            )
         else:
+            # bounded-async composes: the delivery queues allocate their
+            # candidate slots in the wire dtype with per-slot scales
+            # (arena.alloc_event_queue)
             resident_wire = _wire_now
     state = init_fn(
         model, input_shape, tx, topo, algo, event_cfg, seed=seed,
         input_dtype=input_dtype, arena=arena_on, bucketed=bucketed_k,
-        staleness=staleness if algo == "eventgrad" else 0,
-        resident_wire=resident_wire,
+        staleness=staleness, resident_wire=resident_wire,
+        sparse_cfg=sparse_cfg,
     )
     if chaos_sched is not None:
         # per-edge receiver-side health, stacked like every other state
@@ -1092,7 +1107,8 @@ def train(
             # leaves), dropping in-flight messages on the floor
             snap_depth = _snapshot_async_depth(memb_raw)
             want_depth = staleness if staleness >= 2 else 0
-            if snap_depth != want_depth and algo == "eventgrad":
+            if (snap_depth != want_depth
+                    and algo in ("eventgrad", "sp_eventgrad")):
                 snap_word = (
                     f"staleness={snap_depth} (bounded-async, "
                     f"{snap_depth}-deep per-edge delivery queues)"
@@ -1102,7 +1118,8 @@ def train(
                     f"checkpoint restore failed with staleness="
                     f"{staleness}: this snapshot was written by a "
                     f"{snap_word} run, and the bounded-async queue "
-                    "depth D is part of the EventState layout — "
+                    "depth D is part of the state layout (EventState"
+                    ".pending / SparseState.pending) — "
                     "resuming across a different D would "
                     + ("silently drop the snapshot's in-flight "
                        "messages" if snap_depth else
@@ -1135,6 +1152,31 @@ def train(
                     "silently cast the buffers (and orphan or fabricate "
                     "the int8 dequant scales); resume with the "
                     "snapshot's original carrier_resident/wire setting, "
+                    "then re-snapshot to migrate"
+                )
+
+            # bucketed-K layout guard, BOTH directions: receive buffers
+            # (and under D >= 2 the delivery queues) are carried
+            # per-bucket, so K is checkpoint layout like the queue
+            # depth — sniffed up front so the K=4 -> K=1 direction gets
+            # the cause named instead of a raw treedef mismatch
+            snap_k = _snapshot_bucket_count(memb_raw)
+            if snap_k != bucketed_k and algo == "eventgrad":
+                snap_kword = (
+                    f"bucketed={snap_k} (per-bucket EventState buffers)"
+                    if snap_k > 1 else
+                    "monolithic (bucketed off) layout"
+                )
+                raise RuntimeError(
+                    "checkpoint restore failed with bucketed="
+                    f"{bucketed_k if bucketed_k > 1 else 'off'}: this "
+                    f"snapshot was written by a {snap_kword} run, and "
+                    "the bucket count K is part of the EventState "
+                    "layout (receive buffers, dequant scales, and "
+                    "bounded-async delivery queues are carried "
+                    "per-bucket) — resume with the snapshot's original "
+                    "bucketed="
+                    f"{'%d' % snap_k if snap_k > 1 else 'off'} setting, "
                     "then re-snapshot to migrate"
                 )
 

@@ -20,7 +20,7 @@ import optax
 from flax import struct
 
 from eventgrad_tpu.parallel.events import EventConfig, EventState
-from eventgrad_tpu.parallel.sparsify import SparseState
+from eventgrad_tpu.parallel.sparsify import SparseConfig, SparseState
 from eventgrad_tpu.parallel.topology import Topology
 from eventgrad_tpu.parallel.spmd import stack_for_ranks
 
@@ -61,6 +61,7 @@ def init_train_state(
     bucketed: int = 1,
     staleness: int = 0,
     resident_wire=None,
+    sparse_cfg: Optional[SparseConfig] = None,
 ) -> TrainState:
     """Build a stacked TrainState for `topo.n_ranks` ranks.
 
@@ -97,14 +98,21 @@ def init_train_state(
         sparse = None
         if algo in ("eventgrad", "sp_eventgrad"):
             # arena=True stores the neighbor receive buffers flat (the
-            # flat-arena step's layout; see EventState.init)
+            # flat-arena step's layout; see EventState.init). Under
+            # bounded-async, eventgrad's delivery queues live in the
+            # EventState; sp's live in the SparseState payload queues —
+            # its (arena-free) trigger EventState stays depth 0.
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
-                buckets=bucketed, staleness=staleness,
+                buckets=bucketed,
+                staleness=staleness if algo == "eventgrad" else 0,
                 resident_wire=resident_wire,
             )
         if algo == "sp_eventgrad":
-            sparse = SparseState.init(params, topo)
+            sparse = SparseState.init(
+                params, topo, cfg=sparse_cfg or SparseConfig(),
+                staleness=staleness,
+            )
 
         per_rank = TrainState(
             params=params,
@@ -136,6 +144,7 @@ def init_train_state_spmd(
     bucketed: int = 1,
     staleness: int = 0,
     resident_wire=None,
+    sparse_cfg: Optional[SparseConfig] = None,
 ) -> TrainState:
     """Per-rank initialization inside the SPMD context — required when the
     topology has `sharded_axes` (tensor/expert parallelism): sharded layers
@@ -155,11 +164,15 @@ def init_train_state_spmd(
         if algo in ("eventgrad", "sp_eventgrad"):
             event = EventState.init(
                 params, topo, event_cfg or EventConfig(), arena=arena,
-                buckets=bucketed, staleness=staleness,
+                buckets=bucketed,
+                staleness=staleness if algo == "eventgrad" else 0,
                 resident_wire=resident_wire,
             )
         if algo == "sp_eventgrad":
-            sparse = SparseState.init(params, topo)
+            sparse = SparseState.init(
+                params, topo, cfg=sparse_cfg or SparseConfig(),
+                staleness=staleness,
+            )
         return TrainState(
             params=params,
             opt_state=tx.init(params),

@@ -55,7 +55,8 @@ from eventgrad_tpu.parallel import arena as arena_lib
 from eventgrad_tpu.parallel import collectives
 from eventgrad_tpu.parallel import policy as policy_lib
 from eventgrad_tpu.parallel.events import (
-    EventConfig, async_delivery_commit, capacity_gate,
+    EventConfig, async_bucket_commit, async_delivery_commit,
+    async_delivery_plan, capacity_gate,
 )
 from eventgrad_tpu.parallel.sparsify import SparseConfig, sparse_exchange
 from eventgrad_tpu.parallel.topology import Topology
@@ -292,8 +293,7 @@ def make_train_step(
     if staleness and algo not in ("eventgrad", "sp_eventgrad"):
         raise ValueError(
             f"staleness={staleness} models the one-sided RMA asynchrony "
-            "of the event algorithms (eventgrad, sp_eventgrad; the "
-            "bounded-async D >= 2 engine is eventgrad-only); "
+            "of the event algorithms (eventgrad, sp_eventgrad); "
             "allreduce/dpsgd are synchronous in the reference"
         )
     if staleness and trace:
@@ -303,33 +303,24 @@ def make_train_step(
         )
     if staleness >= 2:
         # the bounded-async engine: per-edge delivery queues carried in
-        # EventState.pending (D slots deep), commit-on-arrival semantics
-        if algo != "eventgrad":
-            raise ValueError(
-                f"staleness={staleness} (the bounded-async bound D) "
-                "rides the event exchange's per-edge delivery queues "
-                f"(algo='eventgrad'); got algo={algo!r} — sp_eventgrad "
-                "supports staleness 0/1 only"
-            )
-        if not arena:
+        # EventState.pending (eventgrad; D slots deep, per-bucket under
+        # bucketed=K, carrier-resident under carrier_resident=True) or
+        # SparseState.pending (sp_eventgrad payload queues) —
+        # commit-on-arrival semantics either way
+        if algo == "eventgrad" and not arena:
             raise ValueError(
                 f"staleness={staleness} carries its delivery queues as "
                 "flat arena buffers — algo='eventgrad' needs arena=True "
                 "(the loop's auto mode resolves this; see "
-                "train(staleness=...))"
-            )
-        if bucketed and int(bucketed) > 1:
-            raise ValueError(
-                f"staleness={staleness} is not combinable with "
-                "bucketed=K: the per-edge delivery queues are "
-                "whole-wire state, which the bucketed schedule splits "
-                "K ways — use staleness<=1 or bucketed=None"
+                "train(staleness=...)) — drop staleness to <= 1 or "
+                "pass arena=True"
             )
         if fused_sgd is not None:
             raise ValueError(
                 f"staleness={staleness} is not combinable with the "
                 "fused update tail: the kernel bakes in a mix-stale "
-                "bool, not a D-deep delivery queue"
+                "bool, not a D-deep delivery queue — drop fused_update "
+                "(or staleness to <= 1) to compose"
             )
     if chaos is not None and algo not in ("dpsgd", "eventgrad"):
         raise ValueError(
@@ -494,12 +485,6 @@ def make_train_step(
                     "carrier_resident=True keeps the buffers in the wire "
                     f"carrier dtype, but wire={wire!r} has none — use "
                     "wire='bf16'/'int8' (f32 wires are already resident)"
-                )
-            if staleness >= 2:
-                raise ValueError(
-                    f"carrier_resident=True is not combinable with "
-                    f"staleness={staleness}: the bounded-async delivery "
-                    "queues carry f32 candidate slots"
                 )
             if integ_checksum or integ_quar:
                 raise ValueError(
@@ -710,7 +695,9 @@ def make_train_step(
             spec is not None and spec.homogeneous and spec.n_leaves
             and algo in ("dpsgd", "eventgrad")  # the consuming algos
         )
-        if staleness >= 2 and not use_arena:
+        if staleness >= 2 and algo == "eventgrad" and not use_arena:
+            # sp_eventgrad is exempt: its payload queues are tree state
+            # (SparseState.pending), no arena flattening involved
             raise ValueError(
                 f"staleness={staleness} (bounded-async) needs the "
                 "flat-arena hot path, and this model's parameters are "
@@ -878,6 +865,35 @@ def make_train_step(
             new_bufs_b = [None] * B   # per bucket: per-neighbor tuple
             new_scales_b = [None] * B # per bucket: per-neighbor [L_b] scales
             mixed_leaves = [None] * spec.n_leaves
+            # bounded-async (staleness >= 2): the delivery queue's scalar
+            # half — arrival clocks, late drain, per-slot (sent, late)
+            # shift+merge — is bucket-invariant, so it runs ONCE here;
+            # the array half (async_bucket_commit) is fused into each
+            # per-bucket commit tail below, keeping the pipelined
+            # ship/commit/mix emission the jaxpr interleaving gate pins
+            q_plan = None
+            pend_cands = pend_effs = pend_scales = None
+            if staleness >= 2:
+                lag_vec_e = chaos_inject.lag_vector(
+                    chaos, topo, pass_num, bound=staleness
+                )
+                obs_lag_vec = lag_vec_e
+                q_plan = async_delivery_plan(
+                    event_state, deliver, lag_vec_e, pass_num, staleness
+                )
+                pend_cands = [
+                    [[None] * B for _ in range(staleness)]
+                    for _ in range(n_nb)
+                ]
+                pend_effs = [
+                    [[None] * B for _ in range(staleness)]
+                    for _ in range(n_nb)
+                ]
+                if last_scales is not None:
+                    pend_scales = [
+                        [[None] * B for _ in range(staleness)]
+                        for _ in range(n_nb)
+                    ]
 
             def _bflat(xs):
                 if len(xs) == 1:
@@ -923,6 +939,48 @@ def make_train_step(
                     b = buckets_eff[bi]
                     cands, effs, _raws = shipped[bi][:3]
                     last_b = tuple(lasts[i][bi] for i in range(n_nb))
+                    if q_plan is not None:
+                        # D >= 2: this pass's candidates enter the
+                        # delivery queue; what commits into the bucket
+                        # buffers is whatever queue slot 0 says ARRIVED
+                        # this pass (commit-on-arrival). The scalar half
+                        # (q_plan) is shared across buckets; only the
+                        # [L_b] array half runs here, inside the same
+                        # commit tail slot of the pipeline.
+                        here_all = q_plan[0]
+                        seg_b = b.seg_expand()
+                        bufs_i, scales_i = [], []
+                        for i in range(n_nb):
+                            cs = (
+                                shipped[bi][3][i]
+                                if (last_scales is not None
+                                    and shipped[bi][3] is not None)
+                                else None
+                            )
+                            ls = (
+                                last_scales[i][bi]
+                                if last_scales is not None else None
+                            )
+                            buf_i, ncs, nes, nss, bs_i = (
+                                async_bucket_commit(
+                                    event_state.pending[i], here_all[i],
+                                    cands[i], effs[i], last_b[i], seg_b,
+                                    bucket=bi, cand_scale=cs,
+                                    last_scale=ls,
+                                )
+                            )
+                            bufs_i.append(buf_i)
+                            if bs_i is not None:
+                                scales_i.append(bs_i)
+                            for r in range(staleness):
+                                pend_cands[i][r][bi] = ncs[r]
+                                pend_effs[i][r][bi] = nes[r]
+                                if pend_scales is not None:
+                                    pend_scales[i][r][bi] = nss[r]
+                        new_bufs_b[bi] = tuple(bufs_i)
+                        if scales_i:
+                            new_scales_b[bi] = tuple(scales_i)
+                        return
                     new_bufs_b[bi] = collectives.commit_bufs_flat(
                         cands, effs, last_b, b
                     )
@@ -943,15 +1001,20 @@ def make_train_step(
                 # the fly with the leaf's scalar committed/stale scale
                 with _phase(f"commit_mix.b{bi}"):
                     b = buckets_eff[bi]
+                    # staleness == 1 mixes the pre-exchange buffers (the
+                    # classic one-pass delay); D >= 2 mixes POST-arrival
+                    # buffers — the queue's commit-on-arrival at lag 1
+                    # already supplies exactly that one-pass delay, which
+                    # is what makes D=2-at-baseline-lag ≡ D=1 bitwise
                     use_b = (
                         tuple(lasts[i][bi] for i in range(n_nb))
-                        if staleness else new_bufs_b[bi]
+                        if staleness == 1 else new_bufs_b[bi]
                     )
                     use_s = None
                     if use_carrier and last_scales is not None:
                         use_s = (
                             tuple(last_scales[i][bi] for i in range(n_nb))
-                            if staleness else new_scales_b[bi]
+                            if staleness == 1 else new_scales_b[bi]
                         )
                     for j, k in enumerate(range(b.lo, b.hi)):
                         p = leaves[k]
@@ -1141,6 +1204,34 @@ def make_train_step(
                     tuple(new_scales_b[bi][i] for bi in range(B))
                     for i in range(n_nb)
                 ))
+            if q_plan is not None:
+                # reassemble the per-bucket queue: every bucket's array
+                # half (filled inside its commit tail) joins the shared
+                # scalar stamps computed once up front
+                _, sent_all, late_all, q_clock, q_late = q_plan
+                new_pending = []
+                for i in range(n_nb):
+                    slots_i = []
+                    for r in range(staleness):
+                        slot = (
+                            tuple(pend_cands[i][r][bi] for bi in range(B)),
+                            tuple(pend_effs[i][r][bi] for bi in range(B)),
+                            sent_all[i][r],
+                            late_all[i][r],
+                        )
+                        if pend_scales is not None:
+                            slot = slot + (tuple(
+                                pend_scales[i][r][bi] for bi in range(B)
+                            ),)
+                        slots_i.append(slot)
+                    new_pending.append(tuple(slots_i))
+                event_state = event_state.replace(
+                    pending=tuple(new_pending),
+                    edge_clock=q_clock,
+                    late_commits=event_state.late_commits + q_late,
+                )
+                edge_stale = jnp.asarray(pass_num, jnp.int32) - q_clock
+                late_now = q_late
             if not bucketed_tail_done:
                 bucketed_mixed = jax.tree.unflatten(
                     spec.treedef, mixed_leaves
@@ -1301,8 +1392,13 @@ def make_train_step(
                         async_delivery_commit(
                             event_state, cands, effs, delivered_bits,
                             lag_vec_e, pass_num, spec, staleness,
+                            cand_scales=cand_scales,
                         )
                     )
+                    # carrier: the queue committed scales alongside their
+                    # payloads — the mix dequantizes post-arrival buffers
+                    # through post-arrival scales
+                    arena_buf_scales = event_state.buf_scales
             else:
                 with _phase("commit_mix"):
                     # dtype-agnostic wide select: carriers commit through
@@ -1491,9 +1587,19 @@ def make_train_step(
             with _phase("exchange"):
                 sparse_state = sparse_exchange(
                     params, fire, sparse_state, topo, sparse_cfg, wire,
-                    buckets=buckets_eff,
+                    buckets=buckets_eff, staleness=staleness,
                 )
-            bufs = stale_replicas if staleness else sparse_state.replicas
+            # staleness == 1 mixes the pre-exchange replicas; D >= 2
+            # mixes POST-exchange replicas, whose newest content is the
+            # queue's slot-0 commit (payloads from passes <= p-1) — the
+            # same one-pass delay, which is the D=2 ≡ D=1 bitwise pin
+            bufs = (
+                stale_replicas if staleness == 1 else sparse_state.replicas
+            )
+            if staleness >= 2 and obs:
+                # sp composes with D >= 2 but never with chaos lag, so
+                # the ledger's queue twin sees every message at lag 1
+                obs_lag_vec = jnp.ones((n_nb,), jnp.int32)
             ks = tuple(
                 sparse_cfg.k_for(p.size) for p in jax.tree.leaves(params)
             )

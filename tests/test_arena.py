@@ -359,15 +359,21 @@ def test_carrier_resident_guards():
     with pytest.raises(ValueError, match="wire="):
         make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
                         arena=True, carrier_resident=True)
-    with pytest.raises(ValueError, match="staleness=2"):
-        make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
-                        arena=True, wire="int8", staleness=2,
-                        carrier_resident=True)
-    # a carrier state cannot be built for the bounded-async layout either
-    with pytest.raises(ValueError, match="staleness"):
-        init_train_state(model, IN_SHAPE, tx, topo, "eventgrad", CFG,
-                         seed=0, arena=True, staleness=2,
-                         resident_wire="int8")
+    # ISSUE 20 lifted carrier x bounded-async: the D-slot delivery
+    # queues ride the wire carrier too (per-slot dequant scales), so
+    # staleness >= 2 now BUILDS instead of refusing — both the step and
+    # the state, with every queue candidate slot in the carrier dtype
+    make_train_step(model, tx, topo, "eventgrad", event_cfg=CFG,
+                    arena=True, wire="int8", staleness=2,
+                    carrier_resident=True)
+    st = init_train_state(model, IN_SHAPE, tx, topo, "eventgrad", CFG,
+                          seed=0, arena=True, staleness=2,
+                          resident_wire="int8")
+    assert st.event.pending is not None
+    for queue in st.event.pending:
+        assert len(queue) == 2
+        for slot in queue:
+            assert slot[0].dtype == jnp.int8
     # carrier buffers only exist on the flat arena layout
     with pytest.raises(ValueError, match="arena"):
         init_train_state(model, IN_SHAPE, tx, topo, "eventgrad", CFG,

@@ -26,6 +26,7 @@ import numpy as np
 import optax
 import pytest
 
+from _spmd import requires_shard_map
 from eventgrad_tpu.chaos import inject as chaos_inject
 from eventgrad_tpu.chaos import monitor as chaos_monitor
 from eventgrad_tpu.chaos.schedule import ChaosSchedule, LagWindow
@@ -192,13 +193,15 @@ def _batches(steps=5, seed=3):
     ]
 
 
-def _run(staleness, chaos=None, gossip_wire="dense", wire=None, steps=5):
+def _run(staleness, chaos=None, gossip_wire="dense", wire=None, steps=5,
+         bucketed=None, carrier=False):
     topo = Ring(N_RANKS)
     model = MLP(**MODEL)
     tx = optax.sgd(0.05)
     state = init_train_state(
         model, IN_SHAPE, tx, topo, "eventgrad", CFG, seed=0, arena=True,
-        staleness=staleness,
+        staleness=staleness, bucketed=bucketed or 1,
+        resident_wire=wire if carrier else None,
     )
     if chaos is not None:
         state = state.replace(
@@ -206,12 +209,20 @@ def _run(staleness, chaos=None, gossip_wire="dense", wire=None, steps=5):
         )
     capacity = None
     if gossip_wire == "compact":
-        from eventgrad_tpu.utils import trees
-        capacity = trees.tree_count_params(state.params) // topo.n_ranks
+        if bucketed:
+            from eventgrad_tpu.parallel import collectives
+            params0 = jax.tree.map(lambda x: x[0], state.params)
+            capacity = int(collectives.bucketed_capacity_floor(
+                arena_lib.arena_spec(params0).buckets(bucketed)
+            ))
+        else:
+            from eventgrad_tpu.utils import trees
+            capacity = trees.tree_count_params(state.params) // topo.n_ranks
     step = make_train_step(
         model, tx, topo, "eventgrad", event_cfg=CFG, arena=True,
         staleness=staleness, chaos=chaos, gossip_wire=gossip_wire,
-        compact_capacity=capacity, wire=wire,
+        compact_capacity=capacity, wire=wire, bucketed=bucketed,
+        carrier_resident=carrier,
     )
     lifted = jax.jit(spmd(step, topo))
     m = None
@@ -246,6 +257,159 @@ def test_baseline_lag_reproduces_staleness1_bitwise(gossip_wire, wire):
     # no late deliveries at the baseline lag
     assert int(np.asarray(m2["late_commits"]).sum()) == 0
     assert np.asarray(m2["edge_staleness"]).max() <= 1
+
+
+# --- the composed overlap stack (ISSUE 20) -----------------------------
+
+
+@pytest.mark.parametrize("bucketed,gossip_wire,wire,carrier", [
+    # queue slots carried per-bucket, masked and compact wires
+    (4, "dense", None, False),
+    (4, "compact", None, False),
+    # ... and carrier-resident: queue slots in the wire dtype with
+    # per-slot dequant scales
+    (4, "dense", "int8", True),
+    (4, "compact", "int8", True),   # the full composed stack
+    (None, "dense", "int8", True),  # monolithic carrier queue
+    (None, "compact", "int8", True),
+])
+def test_composed_baseline_lag_reproduces_staleness1_bitwise(
+        bucketed, gossip_wire, wire, carrier):
+    """The D=2 ≡ D=1 contract survives FULL composition: bounded-async
+    delivery queues x bucketed schedule x compact wire x int8
+    carrier-resident buffers in one step. Params, optimizer, trigger
+    state, receive buffers, and every shared metric bitwise."""
+    kw = dict(gossip_wire=gossip_wire, wire=wire, bucketed=bucketed,
+              carrier=carrier)
+    s1, m1 = _run(1, **kw)
+    s2, m2 = _run(2, **kw)
+    for field in ("params", "opt_state", "batch_stats"):
+        for a, b in zip(jax.tree.leaves(getattr(s1, field)),
+                        jax.tree.leaves(getattr(s2, field))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for attr in ("thres", "last_sent_norm", "slopes", "num_events",
+                 "num_deferred", "bufs", "buf_scales"):
+        for a, b in zip(jax.tree.leaves(getattr(s1.event, attr)),
+                        jax.tree.leaves(getattr(s2.event, attr))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m1:
+        np.testing.assert_array_equal(
+            np.asarray(m1[k]), np.asarray(m2[k]), err_msg=k
+        )
+    assert set(m2) - set(m1) == {"edge_staleness", "late_commits"}
+    assert int(np.asarray(m2["late_commits"]).sum()) == 0
+    if carrier:
+        # the queue carry stayed carrier-resident: receive buffers in
+        # the wire dtype on BOTH legs
+        assert all(
+            np.asarray(leaf).dtype == np.int8
+            for leaf in jax.tree.leaves(s2.event.bufs)
+        )
+
+
+def test_composed_deep_queue_baseline_lag_matches_staleness1():
+    """D=4 at the baseline lag on the full composed stack: the three
+    extra runway slots are pure padding — still bitwise the
+    staleness=1 model."""
+    kw = dict(gossip_wire="compact", wire="int8", bucketed=4,
+              carrier=True)
+    s1, _ = _run(1, **kw)
+    s4, m4 = _run(4, **kw)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(m4["late_commits"]).sum()) == 0
+
+
+def test_composed_straggler_stack_replays_bitwise():
+    """The full stack under a REAL straggler (slow=1@7 beyond the
+    bound): gauges clamp at D, late commits accrue, and the whole
+    composed story replays bitwise from its seed."""
+    sched = ChaosSchedule(seed=5, slow=((1, 7),))
+    kw = dict(chaos=sched, gossip_wire="compact", wire="int8",
+              bucketed=4, carrier=True, steps=8)
+    s_a, m_a = _run(4, **kw)
+    es = np.asarray(m_a["edge_staleness"])
+    assert es.max() == 4
+    assert int(np.asarray(m_a["late_commits"]).sum()) > 0
+    assert set(np.argwhere(es == 4)[:, 0].tolist()) == {0, 2}
+    s_b, m_b = _run(4, **kw)
+    for a, b in zip(jax.tree.leaves(s_a), jax.tree.leaves(s_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m_a:
+        np.testing.assert_array_equal(
+            np.asarray(m_a[k]), np.asarray(m_b[k]), err_msg=k
+        )
+
+
+def _run_sp(staleness, steps=5, bucketed=None, wire=None):
+    """sp_eventgrad runner: payload queues live in SparseState.pending
+    (sp's trigger EventState stays depth 0)."""
+    topo = Ring(N_RANKS)
+    model = MLP(**MODEL)
+    tx = optax.sgd(0.05)
+    state = init_train_state(
+        model, IN_SHAPE, tx, topo, "sp_eventgrad", CFG, seed=0,
+        staleness=staleness,
+    )
+    step = make_train_step(
+        model, tx, topo, "sp_eventgrad", event_cfg=CFG,
+        staleness=staleness, wire=wire, bucketed=bucketed,
+    )
+    lifted = jax.jit(spmd(step, topo))
+    m = None
+    for b in _batches(steps):
+        state, m = lifted(state, b)
+    return state, m
+
+
+@pytest.mark.parametrize("bucketed,wire", [
+    (None, None), (4, "int8"),
+])
+def test_sp_payload_queue_baseline_matches_staleness1(bucketed, wire):
+    """sp_eventgrad at D=2 through its payload queues ≡ staleness=1
+    bitwise (sp x chaos stays refused, so every payload enqueues at
+    slot 0 — commit-on-arrival IS the one-pass-stale replica mix),
+    monolithic and bucketed-int8 alike."""
+    s1, m1 = _run_sp(1, bucketed=bucketed, wire=wire)
+    s2, m2 = _run_sp(2, bucketed=bucketed, wire=wire)
+    # params/opt_state bitwise — the MIX consumed identical replicas.
+    # SparseState.replicas themselves legitimately differ by one pass:
+    # D=2's resident replicas hold payloads <= p-1 (this pass's sits in
+    # the queue), staleness=1's hold pass p (it mixes a pre-exchange
+    # stale copy instead) — same mix input, different carrier.
+    for field in ("params", "opt_state"):
+        for a, b in zip(jax.tree.leaves(getattr(s1, field)),
+                        jax.tree.leaves(getattr(s2, field))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m1:
+        np.testing.assert_array_equal(
+            np.asarray(m1[k]), np.asarray(m2[k]), err_msg=k
+        )
+
+
+@requires_shard_map
+def test_composed_stack_vmap_shard_map_parity():
+    """The composed production config (bounded-async D=4 x compact
+    int8 x bucketed K=4 x carrier-resident) is bitwise identical
+    across the vmap simulator and the real shard_map mesh."""
+    if len(jax.devices()) < N_RANKS:
+        pytest.skip(f"needs {N_RANKS} devices")
+    x, y = synthetic_dataset(128, IN_SHAPE, seed=3)
+    kw = dict(
+        algo="eventgrad", epochs=2, batch_size=8, event_cfg=CFG, seed=0,
+        log_every_epoch=False, staleness=4, gossip_wire="compact",
+        compact_frac=0.5, wire="int8", bucketed=4, carrier_resident=True,
+        chaos="slow=1@3,seed=5",
+    )
+    s_v, h_v = train(MLP(**MODEL), Ring(N_RANKS), x, y, backend="vmap",
+                     **kw)
+    s_s, h_s = train(MLP(**MODEL), Ring(N_RANKS), x, y,
+                     backend="shard_map", **kw)
+    for a, b in zip(jax.tree.leaves(s_v.params), jax.tree.leaves(s_s.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s_v.event), jax.tree.leaves(s_s.event)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert h_v[-1]["late_commits"] == h_s[-1]["late_commits"] > 0
 
 
 def test_straggler_staleness_clamps_at_bound():
@@ -308,14 +472,8 @@ def test_bounded_async_guards():
     tx = optax.sgd(0.1)
     with pytest.raises(ValueError, match="bounded-async"):
         make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=-1)
-    with pytest.raises(ValueError, match="staleness 0/1 only"):
-        make_train_step(MLP(**MODEL), tx, topo, "sp_eventgrad",
-                        staleness=2)
     with pytest.raises(ValueError, match="arena=True"):
         make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2)
-    with pytest.raises(ValueError, match="bucketed"):
-        make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2,
-                        arena=True, bucketed=2)
     with pytest.raises(ValueError, match="fused"):
         make_train_step(MLP(**MODEL), tx, topo, "eventgrad", staleness=2,
                         arena=True, fused_sgd=(0.05, 0.0))
@@ -369,9 +527,11 @@ def test_resume_across_staleness_depth_fails_loudly(tmp_path):
 
 
 def test_straggler_ablation_fast_leg_schema_valid(tmp_path):
-    """The proof instrument's --fast leg runs end to end and its output
-    validates against STRAGGLER_ABLATION_SCHEMA — the same gates the
-    committed artifact is held to."""
+    """The proof instrument's --fast --measured leg runs end to end —
+    composed config (compact int8 x bucketed x carrier-resident),
+    modeled AND real-wall-clock legs — and its output validates
+    against STRAGGLER_ABLATION_SCHEMA — the same gates the committed
+    artifact is held to."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
         "straggler_ablation",
@@ -387,7 +547,7 @@ def test_straggler_ablation_fast_leg_schema_valid(tmp_path):
     va_spec.loader.exec_module(va)
 
     out = str(tmp_path / "straggler_fast.json")
-    assert tool.main(["--fast", "--out", out]) == 0
+    assert tool.main(["--fast", "--measured", "--out", out]) == 0
     with open(out) as f:
         rec = json.load(f)
     errs = va.validate(rec, va.STRAGGLER_ABLATION_SCHEMA)
@@ -395,3 +555,26 @@ def test_straggler_ablation_fast_leg_schema_valid(tmp_path):
     assert rec["bounded_async_beats_lockstep"]
     assert any(leg["staleness"] >= 2 and leg["late_commits"] > 0
                for leg in rec["legs"])
+    # the measured leg: real seconds, lockstep strictly slower, both
+    # instruments agreeing on direction
+    assert rec["measured"] is True
+    assert rec["measured_ratio"] > 1.0
+    assert rec["measured_lockstep_wall_s"] > rec["measured_bounded_wall_s"]
+    assert rec["measured_agrees_with_modeled"] is True
+    # the gates are IN the schema: breaking any measured field must be
+    # a schema violation, not a judgment call
+    for k, bad in [
+        ("measured", False),
+        ("measured_ratio", 0.9),
+        ("measured_agrees_with_modeled", False),
+        ("measured_bounded_staleness", 1),
+    ]:
+        assert va.validate(dict(rec, **{k: bad}),
+                           va.STRAGGLER_ABLATION_SCHEMA), (
+            f"schema must reject {k}={bad!r}"
+        )
+    # dropping the measured leg entirely must also be rejected — the
+    # committed artifact carries BOTH instruments
+    stripped = {k: v for k, v in rec.items()
+                if not k.startswith("measured")}
+    assert va.validate(stripped, va.STRAGGLER_ABLATION_SCHEMA)

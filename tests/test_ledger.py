@@ -98,6 +98,9 @@ MATRIX = [
     ("eventgrad", "compact", 2, True, True),
     ("sp_eventgrad", "dense", 0, False, False),
     ("sp_eventgrad", "compact", 1, False, False),
+    # ISSUE 20: sp payload queues at D >= 2 (bounded-async sparse
+    # carrier) must keep the same books as the shallow depths
+    ("sp_eventgrad", "dense", 2, False, False),
 ]
 
 
@@ -120,6 +123,33 @@ def test_conservation_matrix(algo, wire, staleness, chaos_on,
         assert b["ledger_audit"]["checks"] > 0
     _assert_conserved(_totals(blocks), chaos_on=chaos_on,
                       staleness=staleness)
+
+
+def test_conservation_composed_overlap_stack():
+    """The ISSUE 20 production composition — bounded-async D=2,
+    bucketed K=4 commit->mix tails, compact wire at half capacity,
+    int8 carrier-resident delivery queues, arena slots — keeps the
+    books under drop chaos and a straggler: every flush window audits
+    clean and the run totals balance integer-exactly, with real late
+    commits in the ledger (the queue path is exercised, not idle)."""
+    chaos = ChaosSchedule.parse("seed=7,drop=0.25,slow=1@3")
+    x, y = synthetic_dataset(64, (8, 8, 1), seed=1)
+    _, hist = train(
+        MLP(hidden=8), Ring(N_RANKS), x, y, algo="eventgrad",
+        epochs=3, batch_size=8, learning_rate=0.1, obs="epoch",
+        seed=0, staleness=2, gossip_wire="compact", compact_frac=0.5,
+        wire="int8", arena=True, bucketed=4, carrier_resident=True,
+        chaos=chaos, log_every_epoch=False,
+        event_cfg=EventConfig(adaptive=True, horizon=0.95,
+                              warmup_passes=2, max_silence=4),
+    )
+    blocks = _blocks(hist)
+    for b in blocks:
+        assert b["ledger_audit"]["ok"], b["ledger_audit"]["violations"]
+        assert b["ledger_audit"]["checks"] > 0
+    tot = _totals(blocks)
+    _assert_conserved(tot, chaos_on=True, staleness=2)
+    assert tot["late_committed"] > 0, tot
 
 
 def test_conservation_dpsgd_dense_census():

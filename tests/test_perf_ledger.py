@@ -70,6 +70,13 @@ def test_comparability_groups_separate_platforms_and_tiers():
     assert pl.comparable_key(rounds[6]) != pl.comparable_key(rounds[5])
     assert pl.comparable_key(rounds[6]) != pl.comparable_key(rounds[3])
     assert pl.comparable_key(rounds[2]) == pl.comparable_key(rounds[3])
+    # a bounded-async row (EG_BENCH_STALENESS=D, ISSUE 20) is its own
+    # group: D >= 2 carries queue-commit work a lockstep round doesn't
+    assert (pl.comparable_key(dict(rounds[6], staleness=4))
+            != pl.comparable_key(rounds[6]))
+    # pre-field rows (no staleness key) read as lockstep
+    assert (pl.comparable_key(dict(rounds[6], staleness=0))
+            == pl.comparable_key(rounds[6]))
     gated_pairs = {
         (g["prev_round"], g["round"]) for g in ledger["gates"]
     }

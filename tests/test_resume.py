@@ -206,6 +206,57 @@ def test_resume_across_carrier_residency_fails_loudly(tmp_path):
     assert [r["epoch"] for r in h3] == [2]
 
 
+def test_resume_across_composed_queue_layout_fails_loudly(tmp_path):
+    """The COMPOSED overlap stack (bounded-async D=4 x bucketed K=4 x
+    compact int8 x carrier-resident) carries its delivery queues
+    per-bucket inside EventState.pending — BOTH the depth D and the
+    bucket count K are checkpoint layout now. Resuming a composed
+    snapshot into a lockstep or monolithic loop (or vice versa) fails
+    LOUDLY with an actionable message in every direction; the
+    same-layout resume round-trips."""
+    import pytest
+
+    x, y = synthetic_dataset(64, (8, 8, 1), seed=3)
+    cfg = EventConfig(adaptive=True, horizon=0.9, warmup_passes=2)
+    composed = dict(
+        staleness=4, bucketed=4, gossip_wire="compact", compact_frac=0.5,
+        wire="int8", carrier_resident=True,
+    )
+    common = dict(
+        algo="eventgrad", epochs=1, batch_size=4, event_cfg=cfg, seed=0,
+        log_every_epoch=False, save_every=1, arena=True,
+    )
+
+    def go(ck, **kw):
+        return train(MLP(hidden=16), Ring(4), x, y, checkpoint_dir=ck,
+                     **{**common, **kw})
+
+    d1 = str(tmp_path / "composed")
+    go(d1, **composed)
+    # composed snapshot -> lockstep loop (queues would be dropped)
+    with pytest.raises(RuntimeError, match="staleness"):
+        go(d1, **{**composed, "staleness": 1}, resume=True, epochs=2)
+    # composed snapshot -> monolithic loop (per-bucket slots would be
+    # misread as flat buffers)
+    with pytest.raises(RuntimeError, match="bucketed"):
+        go(d1, **{**composed, "bucketed": None}, resume=True, epochs=2)
+
+    # ...and the grow direction: a lockstep/monolithic snapshot must
+    # refuse the composed loop
+    d2 = str(tmp_path / "mono")
+    go(d2, **{**composed, "staleness": 0, "bucketed": None})
+    with pytest.raises(RuntimeError, match="staleness"):
+        go(d2, **composed, resume=True, epochs=2)
+    d3 = str(tmp_path / "b_only")
+    go(d3, **{**composed, "staleness": 0})
+    with pytest.raises(RuntimeError, match="staleness"):
+        go(d3, **composed, resume=True, epochs=2)
+
+    # same composed layout round-trips
+    _, h = go(d1, **composed, resume=True, epochs=2)
+    assert [r["epoch"] for r in h] == [2]
+
+
 def test_delayed_gossip_resume_matches_uninterrupted(tmp_path):
     """staleness=1 carries its pending exchange in EventState.bufs, which is
     part of the snapshot — an interrupted delayed-gossip run resumes onto

@@ -93,18 +93,23 @@ _PER_RANK_BY_CONFIG = {
 
 def comparable_key(
     rec: Dict[str, Any],
-) -> Optional[Tuple[str, str, str, str, str]]:
+) -> Optional[Tuple[str, str, str, str, str, str]]:
     """Comparability group of a bench record/ledger entry: rounds are
     gated against each other ONLY within (platform, model, config,
-    backend, policy). The backend dimension (vmap single-chip simulator
-    vs shard_map device mesh, ISSUE 14) keeps mesh rows from ever
-    gating against vmap rows — a real-collective step time is not a
+    backend, policy, staleness). The backend dimension (vmap single-chip
+    simulator vs shard_map device mesh, ISSUE 14) keeps mesh rows from
+    ever gating against vmap rows — a real-collective step time is not a
     regression of a batched-simulation one; records predating the
     field were all vmap. The policy dimension (trigger policies,
     ISSUE 16: threshold vs micro vs topk rows from the frontier sweep)
     keeps a sparser policy's sent-bytes/msgs-saved from ever gating
     against a denser one's; records predating the field all ran the
-    default adaptive-threshold trigger."""
+    default adaptive-threshold trigger. The staleness dimension
+    (bounded-async delivery queues, ISSUE 20: EG_BENCH_STALENESS=D
+    rows) keeps a D >= 2 run's step time — which carries the queue
+    commit work and mixes post-arrival buffers — from gating against a
+    lockstep round's; records predating the field all ran lockstep
+    (staleness 0)."""
     plat, model, cfg = (
         rec.get("platform"), rec.get("model"), rec.get("config"),
     )
@@ -114,6 +119,7 @@ def comparable_key(
         str(plat), str(model), str(cfg),
         str(rec.get("backend") or "vmap"),
         str(rec.get("policy") or "default"),
+        str(rec.get("staleness") or 0),
     )
 
 
@@ -151,6 +157,9 @@ def _bench_entry(path: str) -> Dict[str, Any]:
         # SPMD lift that produced the numbers; pre-field records were
         # all the single-chip vmap simulator (ISSUE 14)
         "backend": rec.get("backend", "vmap"),
+        # bounded-async staleness bound of the event legs; pre-field
+        # records all ran lockstep (ISSUE 20)
+        "staleness": rec.get("staleness", 0),
         "passes": rec.get("passes"),
         "collapsed": rec.get("collapsed", False),
         "step_ms": rec.get("step_ms"),

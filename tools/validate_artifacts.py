@@ -602,15 +602,18 @@ AUDIT_SCHEMA = {
         # declared + checked) and the partition_overlap oracle; the
         # ISSUE 17 extension adds the carrier-resident cells
         # (masked-int8 + compact-bf16, EventState.bufs held in the wire
-        # dtype) and the stale_scale_reuse oracle: >= 28 cells,
-        # >= 13 oracles
-        "n_configs": {"type": "integer", "minimum": 28},
-        "n_clean": {"type": "integer", "minimum": 28},
-        "configs": {"type": "array", "minItems": 28, "items": _AUDIT_CELL},
+        # dtype) and the stale_scale_reuse oracle; the ISSUE 20
+        # extension adds the composed overlap-stack cells (bucketed K=4
+        # x staleness=2 x compact-int8 x carrier-resident, plus
+        # sp_eventgrad's payload queues at D=2) and the
+        # bucket_queue_skew oracle: >= 30 cells, >= 14 oracles
+        "n_configs": {"type": "integer", "minimum": 30},
+        "n_clean": {"type": "integer", "minimum": 30},
+        "configs": {"type": "array", "minItems": 30, "items": _AUDIT_CELL},
         # the distinct audit geometries the matrix covered: all four
         "models": {"type": "array", "minItems": 4},
-        "n_oracles": {"type": "integer", "minimum": 13},
-        "n_detected": {"type": "integer", "minimum": 13},
+        "n_oracles": {"type": "integer", "minimum": 14},
+        "n_detected": {"type": "integer", "minimum": 14},
         "oracles": {
             "type": "array",
             "minItems": 13,
@@ -649,7 +652,9 @@ STRAGGLER_ABLATION_SCHEMA = {
         "bench", "schema_version", "topo", "algo", "chaos", "straggler",
         "legs", "lockstep_step_time", "bounded_async_step_time",
         "speedup_vs_lockstep", "bounded_async_beats_lockstep",
-        "acc_gap_pt", "replay_bitwise", "wall_s",
+        "acc_gap_pt", "replay_bitwise", "measured", "measured_ratio",
+        "measured_lockstep_wall_s", "measured_bounded_wall_s",
+        "measured_agrees_with_modeled", "wall_s",
     ],
     "properties": {
         "bench": {"enum": ["straggler_ablation"]},
@@ -700,6 +705,24 @@ STRAGGLER_ABLATION_SCHEMA = {
         "bounded_async_beats_lockstep": {"enum": [True]},
         "acc_gap_pt": {"type": "number", "minimum": 0, "maximum": 0.5},
         "replay_bitwise": {"enum": [True]},
+        # the measured wall-clock leg (ISSUE 20): a threaded per-rank
+        # executor runs the composed config's calibrated per-pass
+        # compute against a busy-wait-throttled straggler and times
+        # lockstep vs bounded-async on a REAL clock. A committed
+        # artifact claiming `measured: true` must show the lockstep
+        # strictly slower (measured_ratio > 1 — minimum 1.0 is the
+        # schema's floor, the tool itself refuses == 1.0) AND agreeing
+        # in direction with the modeled leg
+        "measured": {"enum": [True]},
+        "measured_config": {"type": "string"},
+        "measured_passes": {"type": "integer", "minimum": 1},
+        "measured_compute_s": {"type": "number", "minimum": 0},
+        "measured_lockstep_staleness": {"type": "integer", "minimum": 0},
+        "measured_bounded_staleness": {"type": "integer", "minimum": 2},
+        "measured_lockstep_wall_s": {"type": "number", "minimum": 0},
+        "measured_bounded_wall_s": {"type": "number", "minimum": 0},
+        "measured_ratio": {"type": "number", "minimum": 1.0},
+        "measured_agrees_with_modeled": {"enum": [True]},
         "wall_s": {"type": "number", "minimum": 0},
     },
 }
